@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50/ImageNet-shape training throughput on the local chip.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+vs_baseline is measured against BASELINE.json's north-star target of
+10,000 images/sec aggregate on v5e-64 → 156.25 images/sec/chip (the
+reference's own published numbers are unrecoverable — BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+TARGET_PER_CHIP = 10_000 / 64  # BASELINE.json north star on v5e-64
+
+
+def bench_resnet50(batch_size: int, steps: int = 10, warmup: int = 3) -> float:
+    import jax
+    import numpy as np
+
+    from distributed_tensorflow_framework_tpu.core.config import load_config
+    from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+    from distributed_tensorflow_framework_tpu.data.infeed import to_global
+    from distributed_tensorflow_framework_tpu.train.step import StepBuilder
+
+    cfg = load_config(
+        base={
+            "name": "bench-resnet50",
+            "model": {"name": "resnet50", "num_classes": 1000, "dtype": "bfloat16"},
+            "data": {
+                "name": "synthetic_images",
+                "global_batch_size": batch_size,
+                "image_size": 224,
+                "channels": 3,
+            },
+            "optimizer": {
+                "name": "sgd_momentum",
+                "learning_rate": 0.1,
+                "weight_decay": 0.0001,
+            },
+            "train": {"total_steps": 1000},
+        }
+    )
+    mesh = create_mesh(cfg.mesh)
+    builder = StepBuilder(cfg, mesh)
+    rng = np.random.default_rng(0)
+    host = {
+        "image": rng.standard_normal((batch_size, 224, 224, 3)).astype(np.float32),
+        "label": rng.integers(0, 1000, batch_size).astype(np.int32),
+    }
+    batch = to_global(host, mesh)
+    state = builder.init_state(0, batch)
+    step = builder.make_train_step(batch)
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    return batch_size * steps / dt
+
+
+def main() -> int:
+    import jax
+
+    n_chips = jax.device_count()
+    value = None
+    for bs in (256 * n_chips, 128 * n_chips, 64 * n_chips):
+        try:
+            value = bench_resnet50(bs)
+            break
+        except Exception as e:  # OOM → retry smaller
+            print(f"bench: batch {bs} failed ({type(e).__name__}), retrying",
+                  file=sys.stderr)
+    if value is None:
+        print(json.dumps({"metric": "resnet50_images_per_sec_per_chip",
+                          "value": 0.0, "unit": "images/sec/chip",
+                          "vs_baseline": 0.0}))
+        return 1
+    per_chip = value / n_chips
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / TARGET_PER_CHIP, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

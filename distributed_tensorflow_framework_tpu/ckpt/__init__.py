@@ -1,0 +1,5 @@
+"""Checkpoint/resume."""
+
+from distributed_tensorflow_framework_tpu.ckpt.checkpoint import (  # noqa: F401
+    CheckpointManager,
+)

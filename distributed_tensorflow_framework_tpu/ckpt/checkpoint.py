@@ -1,0 +1,97 @@
+"""Orbax-backed checkpointing of the full training state.
+
+Replaces the reference's ``tf.train.Saver`` under MonitoredTrainingSession
+(SURVEY.md §5 "Checkpoint / resume": chief-only writes, global_step-suffixed
+files, latest-checkpoint auto-restore) with Orbax:
+
+  * step-numbered directories + ``latest_step()`` resolution,
+  * async saves (device→host copy happens synchronously, disk write in the
+    background — the train loop doesn't stall),
+  * saves MORE than the reference: params, BN stats, optimizer state, step,
+    RNG key AND the data-iterator position, so resume is exact
+    (SURVEY.md §7 hard part 3 — tested by tests/test_ckpt.py).
+
+All processes call save/restore (Orbax coordinates internally; process 0
+writes metadata) — the multi-host analogue of "chief writes".
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from distributed_tensorflow_framework_tpu.core.config import CheckpointConfig
+from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
+from distributed_tensorflow_framework_tpu.train.state import TrainState
+
+log = logging.getLogger(__name__)
+
+
+def _pack(state: TrainState) -> Any:
+    """Make the state orbax-serializable (typed PRNG keys → raw key data)."""
+    return state.replace(rng=jax.random.key_data(state.rng))
+
+
+def _unpack(raw: Any, like: TrainState) -> TrainState:
+    impl = jax.random.key_impl(like.rng)
+    return raw.replace(rng=jax.random.wrap_key_data(raw.rng, impl=impl))
+
+
+class CheckpointManager:
+    def __init__(self, config: CheckpointConfig, *, is_chief: bool = True):
+        if not config.directory:
+            raise ValueError("CheckpointConfig.directory must be set")
+        self.config = config
+        self.is_chief = is_chief
+        path = os.path.abspath(config.directory)
+        os.makedirs(path, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            path,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=config.max_to_keep,
+                enable_async_checkpointing=config.async_save,
+            ),
+        )
+
+    def save(self, step: int, state: TrainState, *,
+             dataset_state: dict | None = None, force: bool = False) -> bool:
+        """``dataset_state`` must be the iterator snapshot aligned with
+        ``step`` (see data/infeed.py) — NOT the live dataset's state, which
+        the prefetcher has advanced past the training step."""
+        if step in self._mgr.all_steps():
+            return False  # already saved (e.g. final save on an interval step)
+        args = {"state": ocp.args.StandardSave(_pack(state))}
+        if dataset_state is not None:
+            args["data_iter"] = ocp.args.JsonSave(dataset_state)
+        saved = self._mgr.save(step, args=ocp.args.Composite(**args), force=force)
+        if saved and self.is_chief:
+            log.info("Saved checkpoint at step %d", step)
+        return saved
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, template: TrainState, *,
+                dataset: HostDataset | None = None,
+                step: int | None = None) -> TrainState | None:
+        """Restore into the template's shardings; None if no checkpoint."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        args = {"state": ocp.args.StandardRestore(_pack(template))}
+        if dataset is not None:
+            args["data_iter"] = ocp.args.JsonRestore()
+        restored = self._mgr.restore(step, args=ocp.args.Composite(**args))
+        if dataset is not None and restored.get("data_iter") is not None:
+            dataset.restore(restored["data_iter"])
+        return _unpack(restored["state"], template)
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,61 @@
+"""``train.py`` — the single entrypoint (SURVEY.md §2 row 1 / §3.1).
+
+The reference's train.py parses role flags (--job_name, --task_index,
+--ps_hosts, --worker_hosts) and dispatches PS vs worker; here every process
+runs the same program:
+
+    python train.py --config configs/lenet_mnist.yaml \
+        [--set train.total_steps=100 --set mesh.data=8] [--eval-only]
+
+Multi-host jobs launch the identical command on every host (topology is
+discovered, not configured).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from distributed_tensorflow_framework_tpu.core.config import load_config
+from distributed_tensorflow_framework_tpu.core.metrics import setup_logging
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", type=str, default=None, help="YAML config path")
+    p.add_argument(
+        "--set",
+        dest="overrides",
+        action="append",
+        default=[],
+        metavar="key.path=value",
+        help="config override (repeatable)",
+    )
+    p.add_argument("--eval-only", action="store_true",
+                   help="restore latest checkpoint and evaluate")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    args = parse_args(argv)
+    config = load_config(args.config, overrides=args.overrides)
+    from distributed_tensorflow_framework_tpu.train import Trainer
+
+    trainer = Trainer(config)
+    trainer.build()
+    if args.eval_only:
+        results = trainer.evaluate()
+        logging.getLogger(__name__).info("eval results: %s", results)
+        return 0
+    final = trainer.train()
+    if trainer.config.train.eval_steps > 0:
+        results = trainer.evaluate(step=trainer.host_step)
+        logging.getLogger(__name__).info("final eval: %s", results)
+    logging.getLogger(__name__).info("final train metrics: %s", final)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

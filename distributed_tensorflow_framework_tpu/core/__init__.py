@@ -1,0 +1,22 @@
+"""Core runtime: configuration, mesh construction, PRNG, metrics."""
+
+from distributed_tensorflow_framework_tpu.core.config import (  # noqa: F401
+    CheckpointConfig,
+    DataConfig,
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    TrainConfig,
+    load_config,
+)
+from distributed_tensorflow_framework_tpu.core.mesh import (  # noqa: F401
+    MeshRuntime,
+    create_mesh,
+    initialize_runtime,
+)
+from distributed_tensorflow_framework_tpu.core.prng import (  # noqa: F401
+    fold_in_step,
+    make_root_key,
+    split_for_hosts,
+)

@@ -1,0 +1,229 @@
+"""Typed configuration system.
+
+Replaces the reference's ``tf.app.flags`` global FLAGS (SURVEY.md §2 row 11:
+cluster topology, model, dataset paths, hparams all as process-global flags)
+with typed dataclasses loaded from YAML plus ``key=value`` CLI overrides.
+
+Unlike the reference there are no cluster-topology flags (``--ps_hosts``,
+``--worker_hosts``, ``--job_name``, ``--task_index``): the SPMD runtime
+discovers the slice topology from JAX, and the only topology knob the user
+holds is the logical mesh shape (`MeshConfig`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+
+def _fields(cls) -> dict[str, dataclasses.Field]:
+    return {f.name: f for f in dataclasses.fields(cls)}
+
+
+def _build(cls, data: dict[str, Any]):
+    """Construct a (possibly nested) config dataclass from a plain dict."""
+    if data is None:
+        data = {}
+    kwargs = {}
+    fields = _fields(cls)
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise ValueError(
+            f"Unknown key(s) {sorted(unknown)} for {cls.__name__}; "
+            f"valid keys: {sorted(fields)}"
+        )
+    types = getattr(cls, "__field_types__", {})
+    for name, f in fields.items():
+        if name not in data:
+            continue
+        value = data[name]
+        target = _dataclass_in(types.get(name, f.type))
+        if target is not None and isinstance(value, dict):
+            value = _build(target, value)
+        kwargs[name] = value
+    return cls(**kwargs)
+
+
+def _dataclass_in(tp) -> type | None:
+    """Return the dataclass inside ``tp`` (handles Optional[...] unions)."""
+    import typing
+
+    if dataclasses.is_dataclass(tp):
+        return tp
+    for arg in typing.get_args(tp):
+        if dataclasses.is_dataclass(arg):
+            return arg
+    return None
+
+
+def _annotate_types(cls):
+    """Resolve concrete field types once (handles string annotations)."""
+    import typing
+
+    cls.__field_types__ = typing.get_type_hints(cls)
+    return cls
+
+
+def config_dataclass(cls):
+    return _annotate_types(dataclass(cls))
+
+
+@config_dataclass
+class MeshConfig:
+    """Logical device mesh. Axis sizes of 1 collapse that axis.
+
+    ``data`` is the data-parallel axis (the reference's worker-replica count,
+    SURVEY.md §2 row 3); ``fsdp`` shards params/optimizer state ZeRO-style;
+    ``model`` is tensor parallelism; ``seq`` is sequence/context parallelism
+    for ring attention. -1 for ``data`` means "all remaining devices".
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {"data": self.data, "fsdp": self.fsdp,
+                "model": self.model, "seq": self.seq}
+
+
+@config_dataclass
+class OptimizerConfig:
+    name: str = "sgd_momentum"  # sgd_momentum | adam | adamw | lars
+    learning_rate: float = 0.1
+    warmup_steps: int = 0
+    schedule: str = "constant"  # constant | cosine | staircase | linear
+    # staircase: multiply lr by `decay_factor` at each boundary (in steps).
+    boundaries: list[int] = field(default_factory=list)
+    decay_factor: float = 0.1
+    momentum: float = 0.9
+    nesterov: bool = False
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    grad_clip_norm: float = 0.0  # 0 disables
+    # Shard optimizer state over the fsdp axis even when params are replicated
+    # (cross-replica weight-update sharding; cf. SURVEY.md §7 hard part 5).
+    shard_opt_state: bool = False
+
+
+@config_dataclass
+class ModelConfig:
+    name: str = "lenet5"  # lenet5 | resnet50 | inception_v3 | bert
+    num_classes: int = 10
+    # BatchNorm statistic scope: "global" computes stats over the full
+    # (sharded) batch — XLA inserts the cross-replica reduction; "per_replica"
+    # matches the reference's per-GPU BN via shard_map (SURVEY.md §7 hard
+    # part 2).
+    bn_cross_replica: bool = True
+    dtype: str = "bfloat16"     # compute dtype; params stay float32
+    # BERT-family knobs.
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_seq_len: int = 512
+    # Attention implementation: "xla" (dot-product, XLA-fused) or
+    # "pallas" (fused flash-attention kernel, ops/flash_attention.py) or
+    # "ring" (sequence-parallel ring attention over the seq mesh axis).
+    attention_impl: str = "xla"
+
+
+@config_dataclass
+class DataConfig:
+    name: str = "synthetic_images"  # mnist | cifar10 | imagenet | text_mlm | synthetic_*
+    data_dir: str = ""
+    # Global batch size across all replicas (the reference exposed per-worker
+    # batch; global is the SPMD-native unit — per-host share is derived).
+    global_batch_size: int = 64
+    image_size: int = 28
+    channels: int = 1
+    shuffle_buffer: int = 10_000
+    prefetch: int = 2
+    seed: int = 0
+    # text / MLM
+    seq_len: int = 128
+    mask_prob: float = 0.15
+    # native C++ record reader (ops/native) when available
+    use_native_reader: bool = False
+
+
+@config_dataclass
+class CheckpointConfig:
+    directory: str = ""
+    save_interval_steps: int = 1000
+    max_to_keep: int = 3
+    async_save: bool = True
+    restore: bool = True  # auto-restore latest on startup (MonitoredTrainingSession contract)
+
+
+@config_dataclass
+class TrainConfig:
+    total_steps: int = 100
+    log_interval: int = 10
+    eval_interval: int = 0        # 0 disables mid-training eval
+    eval_steps: int = 10
+    seed: int = 42
+    # "jit" = pjit-style automatic partitioning; "shard_map" = explicit
+    # per-replica code with hand-placed collectives (the closer analogue of
+    # the reference's SyncReplicasOptimizer + NCCL pipeline).
+    spmd_mode: str = "jit"
+    nan_guard: bool = True
+    label_smoothing: float = 0.0
+
+
+@config_dataclass
+class ExperimentConfig:
+    name: str = "experiment"
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    eval_data: DataConfig | None = None
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _set_by_path(data: dict, dotted: str, value: Any) -> None:
+    keys = dotted.split(".")
+    node = data
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+        if not isinstance(node, dict):
+            raise ValueError(f"Override path {dotted!r} collides with non-dict")
+    node[keys[-1]] = value
+
+
+def _parse_scalar(text: str) -> Any:
+    return yaml.safe_load(text)
+
+
+def load_config(
+    path: str | pathlib.Path | None = None,
+    overrides: list[str] | None = None,
+    base: dict[str, Any] | None = None,
+) -> ExperimentConfig:
+    """Load an ExperimentConfig from YAML with ``a.b.c=value`` overrides."""
+    data: dict[str, Any] = dict(base or {})
+    if path is not None:
+        with open(path) as fh:
+            loaded = yaml.safe_load(fh) or {}
+        if not isinstance(loaded, dict):
+            raise ValueError(f"Config file {path} must contain a mapping")
+        data.update(loaded)
+    for item in overrides or []:
+        if "=" not in item:
+            raise ValueError(f"Override {item!r} must look like key.path=value")
+        key, _, raw = item.partition("=")
+        _set_by_path(data, key.strip(), _parse_scalar(raw.strip()))
+    return _build(ExperimentConfig, data)

@@ -1,0 +1,152 @@
+"""Device-mesh construction and distributed runtime initialization.
+
+Replaces the reference's L1 cluster runtime (SURVEY.md §2 rows 1–2:
+``tf.train.ClusterSpec`` + ``tf.train.Server`` per-role launcher and
+``replica_device_setter`` variable placement). There is no parameter-server
+role: every host runs the same SPMD program, parameters live wherever the
+sharding rules put them (replicated, or sharded over the ``fsdp`` axis), and
+the "cluster spec" collapses to one logical `jax.sharding.Mesh`.
+
+Collectives emitted against this mesh ride ICI within a slice and DCN across
+slices — the TPU-native equivalent of the reference's grpc PS transport +
+NCCL all-reduce (SURVEY.md §2 native rows).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_framework_tpu.core.config import MeshConfig
+
+log = logging.getLogger(__name__)
+
+# Axis order matters: data outermost so data-parallel replicas land on
+# distinct slices/hosts first, model/seq innermost so tensor- and
+# sequence-parallel collectives ride the fastest ICI links.
+MESH_AXES = ("data", "fsdp", "seq", "model")
+
+
+def initialize_distributed() -> None:
+    """Initialize multi-host JAX if a cluster environment is detected.
+
+    The reference required the user to pass ``--ps_hosts/--worker_hosts/
+    --job_name/--task_index`` to every process; here multi-host discovery is
+    automatic (TPU metadata / cluster env vars), and single-host runs skip
+    initialization entirely.
+    """
+    # NOTE: must not touch jax.process_count()/devices() here — any backend
+    # query initializes XLA, after which jax.distributed.initialize raises.
+    if jax.distributed.is_initialized():
+        return
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num_procs = os.environ.get("JAX_NUM_PROCESSES")
+    if coord and num_procs:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(num_procs),
+            process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
+        )
+
+
+def create_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the logical mesh from a MeshConfig over available devices.
+
+    Axes with size 1 are kept in the mesh (size-1 axes are free) so that
+    sharding rules can always name all four canonical axes regardless of the
+    physical topology.
+    """
+    config = config or MeshConfig()
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    sizes = config.axis_sizes()
+    fixed = {k: v for k, v in sizes.items() if v != -1}
+    fixed_prod = int(np.prod(list(fixed.values()))) if fixed else 1
+    free = [k for k, v in sizes.items() if v == -1]
+    if len(free) > 1:
+        raise ValueError(f"At most one mesh axis may be -1, got {free}")
+    if free:
+        if n % fixed_prod:
+            raise ValueError(
+                f"{n} devices not divisible by fixed axes {fixed} "
+                f"(product {fixed_prod})"
+            )
+        sizes[free[0]] = n // fixed_prod
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(
+            f"Mesh {sizes} needs {total} devices but {n} are available"
+        )
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    dev_array = np.asarray(devs).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """PartitionSpec sharding the leading batch dim over data(+fsdp) axes."""
+    del mesh
+    return P(("data", "fsdp"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(mesh))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+@dataclasses.dataclass
+class MeshRuntime:
+    """The process's view of the SPMD runtime (replaces ClusterSpec+Server)."""
+
+    mesh: Mesh
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_chief(self) -> bool:
+        """Process 0 — the reference's "chief" worker. It owns checkpoint
+        writes and summary logging (SURVEY.md §2 row 10)."""
+        return self.process_index == 0
+
+    @property
+    def data_parallel_size(self) -> int:
+        return (self.mesh.shape["data"] * self.mesh.shape["fsdp"])
+
+    def describe(self) -> str:
+        return (
+            f"process {self.process_index}/{self.process_count}, "
+            f"{self.local_device_count} local / {self.global_device_count} "
+            f"global devices, mesh {dict(self.mesh.shape)}"
+        )
+
+
+def initialize_runtime(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> MeshRuntime:
+    initialize_distributed()
+    mesh = create_mesh(config, devices=devices)
+    rt = MeshRuntime(
+        mesh=mesh,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )
+    log.info("Mesh runtime: %s", rt.describe())
+    return rt

@@ -1,0 +1,134 @@
+"""Metrics, logging and throughput accounting.
+
+Replaces the reference's ``tf.summary`` + ``SummarySaverHook`` + console
+``tf.logging`` stack (SURVEY.md §5 "Metrics / logging"): a MetricWriter that
+fans out to the console and, when available, a TensorBoard event file
+(written through TF's summary writer — TF is in the image for tf.data), plus
+a ThroughputMeter tracking the BASELINE.json north-star metric
+(images/sec and images/sec/chip).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Mapping
+
+log = logging.getLogger("dtf_tpu")
+
+
+def setup_logging(level: int = logging.INFO) -> None:
+    if not logging.getLogger().handlers:
+        logging.basicConfig(
+            level=level,
+            format="%(asctime)s %(levelname).1s %(name)s] %(message)s",
+        )
+
+
+class MetricWriter:
+    """Console + optional TensorBoard + optional JSONL metric sink.
+
+    Only the chief process writes (reference contract: chief owns summaries,
+    SURVEY.md §2 row 10); non-chief construction yields a no-op writer.
+    """
+
+    def __init__(
+        self,
+        logdir: str | None = None,
+        *,
+        is_chief: bool = True,
+        jsonl: bool = True,
+    ):
+        self._enabled = is_chief
+        self._tb = None
+        self._jsonl_fh = None
+        if not self._enabled:
+            return
+        if logdir:
+            os.makedirs(logdir, exist_ok=True)
+            try:
+                import tensorflow as tf  # noqa: PLC0415 — optional heavy dep
+
+                self._tb = tf.summary.create_file_writer(logdir)
+            except Exception:  # pragma: no cover - TF missing/broken
+                log.warning("TensorBoard writer unavailable; console only")
+            if jsonl:
+                self._jsonl_fh = open(
+                    os.path.join(logdir, "metrics.jsonl"), "a", buffering=1
+                )
+
+    def write(self, step: int, values: Mapping[str, Any]) -> None:
+        if not self._enabled:
+            return
+        scalars = {k: _to_scalar(v) for k, v in values.items()}
+        msg = " ".join(f"{k}={_fmt(v)}" for k, v in scalars.items())
+        log.info("step %d: %s", step, msg)
+        if self._tb is not None:
+            import tensorflow as tf  # noqa: PLC0415
+
+            with self._tb.as_default():
+                for k, v in scalars.items():
+                    if isinstance(v, (int, float)):
+                        tf.summary.scalar(k, v, step=step)
+                self._tb.flush()
+        if self._jsonl_fh is not None:
+            self._jsonl_fh.write(
+                json.dumps({"step": step, **scalars}, default=str) + "\n"
+            )
+
+    def close(self) -> None:
+        if self._jsonl_fh is not None:
+            self._jsonl_fh.close()
+            self._jsonl_fh = None
+
+
+def _to_scalar(v: Any) -> Any:
+    if hasattr(v, "item"):
+        try:
+            return v.item()
+        except Exception:
+            return str(v)
+    return v
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+class ThroughputMeter:
+    """Tracks examples/sec over a sliding window of steps.
+
+    ``examples/sec/chip`` is the tracked BASELINE.json metric; the chip count
+    is the global device count so multi-host numbers are comparable.
+    """
+
+    def __init__(self, num_chips: int):
+        self.num_chips = max(1, num_chips)
+        self._t0: float | None = None
+        self._examples = 0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+        self._examples = 0
+
+    def update(self, batch_examples: int) -> None:
+        if self._t0 is None:
+            self.start()
+        self._examples += batch_examples
+
+    def rates(self) -> dict[str, float]:
+        if self._t0 is None or self._examples == 0:
+            return {"examples_per_sec": 0.0, "examples_per_sec_per_chip": 0.0}
+        dt = max(time.perf_counter() - self._t0, 1e-9)
+        eps = self._examples / dt
+        return {
+            "examples_per_sec": eps,
+            "examples_per_sec_per_chip": eps / self.num_chips,
+        }
+
+    def reset(self) -> None:
+        self.start()

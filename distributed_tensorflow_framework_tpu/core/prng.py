@@ -1,0 +1,40 @@
+"""PRNG discipline.
+
+The reference seeds ``tf.set_random_seed`` globally and relies on per-op
+graph seeds (SURVEY.md §4 "input-pipeline determinism"). JAX keys are
+explicit; the framework's discipline is:
+
+  root key (experiment seed)
+    ├─ fold_in(ROLE_*)            per subsystem (init / dropout / data)
+    ├─ fold_in(step)              per training step
+    └─ fold_in(process_index)     only for host-local streams (data feed)
+
+Device-side keys are never host-dependent so that the SPMD program is
+identical on every host.
+"""
+
+from __future__ import annotations
+
+import jax
+
+ROLE_INIT = 0
+ROLE_DROPOUT = 1
+ROLE_DATA = 2
+ROLE_MASK = 3  # MLM masking
+
+
+def make_root_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def for_role(root: jax.Array, role: int) -> jax.Array:
+    return jax.random.fold_in(root, role)
+
+
+def fold_in_step(key: jax.Array, step) -> jax.Array:
+    return jax.random.fold_in(key, step)
+
+
+def split_for_hosts(key: jax.Array, process_index: int) -> jax.Array:
+    """Host-local stream (data pipelines only — never device compute)."""
+    return jax.random.fold_in(key, process_index)

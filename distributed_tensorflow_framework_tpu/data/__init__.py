@@ -1,0 +1,46 @@
+"""Input pipelines.
+
+SURVEY.md §2 row 5: the reference's L3 is a tf.data pipeline (TFRecord →
+decode/augment → shuffle → batch → prefetch) feeding each worker's GPU.
+Here each *host* runs a tf.data (or pure-numpy synthetic) pipeline producing
+its share of the global batch; `infeed.to_global` assembles the host-local
+shards into one mesh-sharded `jax.Array` (the "per-replica infeed" of
+BASELINE.json's north star).
+
+Factories are registered by name and return a `HostDataset`.
+"""
+
+from __future__ import annotations
+
+from distributed_tensorflow_framework_tpu.core.config import DataConfig
+from distributed_tensorflow_framework_tpu.data.pipeline import (  # noqa: F401
+    HostDataset,
+)
+
+
+def get_dataset(config: DataConfig, *, process_index: int = 0,
+                process_count: int = 1, train: bool = True) -> "HostDataset":
+    name = config.name.lower()
+    if name.startswith("synthetic"):
+        from distributed_tensorflow_framework_tpu.data import synthetic
+
+        if "mlm" in name or "text" in name:
+            return synthetic.synthetic_mlm(config, process_index, process_count)
+        return synthetic.synthetic_images(config, process_index, process_count)
+    if name == "mnist":
+        from distributed_tensorflow_framework_tpu.data import mnist
+
+        return mnist.make_mnist(config, process_index, process_count, train=train)
+    if name == "cifar10":
+        from distributed_tensorflow_framework_tpu.data import cifar
+
+        return cifar.make_cifar10(config, process_index, process_count, train=train)
+    if name == "imagenet":
+        from distributed_tensorflow_framework_tpu.data import imagenet
+
+        return imagenet.make_imagenet(config, process_index, process_count, train=train)
+    if name in ("text_mlm", "mlm"):
+        from distributed_tensorflow_framework_tpu.data import text_mlm
+
+        return text_mlm.make_mlm(config, process_index, process_count, train=train)
+    raise ValueError(f"Unknown dataset {config.name!r}")

@@ -1,0 +1,92 @@
+"""CIFAR-10 pipeline (BASELINE.json config 2).
+
+Reads the python-pickle CIFAR-10 batches (``cifar-10-batches-py``) from
+``data_dir``, with the reference recipe's augmentation: pad-4 + random
+32×32 crop, random horizontal flip, per-image standardization
+(SURVEY.md §2 row 5). Synthetic fallback when absent.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+
+import numpy as np
+
+from distributed_tensorflow_framework_tpu.core.config import DataConfig
+from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset, host_batch_size
+from distributed_tensorflow_framework_tpu.data import synthetic
+
+log = logging.getLogger(__name__)
+
+
+def _load(data_dir: str, train: bool):
+    base = os.path.join(data_dir, "cifar-10-batches-py")
+    names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    xs, ys = [], []
+    for n in names:
+        with open(os.path.join(base, n), "rb") as fh:
+            d = pickle.load(fh, encoding="bytes")
+        xs.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        ys.append(np.asarray(d[b"labels"], dtype=np.int32))
+    return np.concatenate(xs).astype(np.float32), np.concatenate(ys)
+
+
+def make_cifar10(config: DataConfig, process_index: int, process_count: int,
+                 *, train: bool = True) -> HostDataset:
+    base = os.path.join(config.data_dir or "", "cifar-10-batches-py")
+    if not (config.data_dir and os.path.isdir(base)):
+        log.warning("CIFAR-10 not found at %r — synthetic fallback", base)
+        return synthetic.synthetic_images(config, process_index, process_count)
+
+    images, labels = _load(config.data_dir, train)
+    b = host_batch_size(config.global_batch_size, process_count)
+    n = len(images)
+
+    def standardize(batch):
+        mean = batch.mean(axis=(1, 2, 3), keepdims=True)
+        std = batch.std(axis=(1, 2, 3), keepdims=True) + 1e-6
+        return (batch - mean) / std
+
+    def make_iter(state):
+        state.setdefault("epoch", 0)
+        state.setdefault("batch_in_epoch", 0)
+        while True:
+            rng = np.random.default_rng(config.seed * 977 + state["epoch"])
+            perm = rng.permutation(n)
+            shard = perm[process_index::process_count]
+            batches = len(shard) // b
+            for i in range(state["batch_in_epoch"], batches):
+                idx = shard[i * b:(i + 1) * b]
+                x = images[idx]
+                if train:
+                    # pad-4 + random crop + random flip
+                    crop_rng = np.random.default_rng(
+                        (config.seed, state["epoch"], i, process_index)
+                    )
+                    padded = np.pad(
+                        x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect"
+                    )
+                    out = np.empty_like(x)
+                    offs = crop_rng.integers(0, 9, size=(len(x), 2))
+                    flips = crop_rng.random(len(x)) < 0.5
+                    for j in range(len(x)):
+                        oy, ox = offs[j]
+                        img = padded[j, oy:oy + 32, ox:ox + 32]
+                        out[j] = img[:, ::-1] if flips[j] else img
+                    x = out
+                state["batch_in_epoch"] = i + 1
+                yield {"image": standardize(x), "label": labels[idx]}
+            state["epoch"] += 1
+            state["batch_in_epoch"] = 0
+
+    return HostDataset(
+        make_iter,
+        element_spec={
+            "image": ((b, 32, 32, 3), np.float32),
+            "label": ((b,), np.int32),
+        },
+        initial_state={"epoch": 0, "batch_in_epoch": 0},
+        cardinality=n // (b * process_count),
+    )

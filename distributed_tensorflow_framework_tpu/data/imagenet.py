@@ -1,0 +1,131 @@
+"""ImageNet TFRecord pipeline — the north-star input path.
+
+SURVEY.md §2 row 5: TFRecord read → decode/augment (random crop, flip,
+standardization) → shuffle → batch → prefetch. SURVEY.md §7 ranks host-side
+input throughput as hard part #1: at ≥10k images/sec aggregate the decode
+must be parallel and the pipeline must never sync with the device. Knobs
+used: sharded file reading per host, ``interleave`` with parallel reads,
+``num_parallel_calls=AUTOTUNE``, batch-then-prefetch.
+
+Record format: the canonical ImageNet TFRecord keys (``image/encoded``
+JPEG, ``image/class/label`` in [1, 1000]).
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+
+import numpy as np
+
+from distributed_tensorflow_framework_tpu.core.config import DataConfig
+from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset, host_batch_size
+from distributed_tensorflow_framework_tpu.data import synthetic
+from distributed_tensorflow_framework_tpu.data.tfdata import tfdata_to_hostdataset
+
+log = logging.getLogger(__name__)
+
+MEAN_RGB = (0.485 * 255, 0.456 * 255, 0.406 * 255)
+STDDEV_RGB = (0.229 * 255, 0.224 * 255, 0.225 * 255)
+
+
+def _file_pattern(config: DataConfig, train: bool) -> str:
+    sub = "train" if train else "validation"
+    return os.path.join(config.data_dir, f"{sub}-*")
+
+
+def make_imagenet(config: DataConfig, process_index: int, process_count: int,
+                  *, train: bool = True) -> HostDataset:
+    files = sorted(glob.glob(_file_pattern(config, train))) if config.data_dir else []
+    if not files:
+        log.warning(
+            "ImageNet TFRecords not found under %r — synthetic fallback",
+            config.data_dir,
+        )
+        cfg = config
+        return synthetic.synthetic_images(cfg, process_index, process_count)
+
+    import tensorflow as tf
+
+    b = host_batch_size(config.global_batch_size, process_count)
+    size = config.image_size
+
+    def parse(record, seed):
+        feats = tf.io.parse_single_example(
+            record,
+            {
+                "image/encoded": tf.io.FixedLenFeature([], tf.string),
+                "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+            },
+        )
+        label = tf.cast(feats["image/class/label"], tf.int32) - 1  # [1,1000]→[0,999]
+        image_bytes = feats["image/encoded"]
+        if train:
+            # Sampled distorted bounding box crop (the Inception-style crop
+            # of the reference recipe class), decode-and-crop fused so only
+            # the crop window is JPEG-decoded.
+            shape = tf.io.extract_jpeg_shape(image_bytes)
+            bbox = tf.constant([0.0, 0.0, 1.0, 1.0], shape=[1, 1, 4])
+            begin, crop_size, _ = tf.image.stateless_sample_distorted_bounding_box(
+                shape,
+                bounding_boxes=bbox,
+                seed=seed,
+                min_object_covered=0.1,
+                aspect_ratio_range=(3.0 / 4, 4.0 / 3),
+                area_range=(0.08, 1.0),
+                max_attempts=10,
+            )
+            offset_y, offset_x, _ = tf.unstack(begin)
+            target_h, target_w, _ = tf.unstack(crop_size)
+            image = tf.image.decode_and_crop_jpeg(
+                image_bytes,
+                tf.stack([offset_y, offset_x, target_h, target_w]),
+                channels=3,
+            )
+            image = tf.image.resize(image, [size, size], method="bicubic")
+            image = tf.image.stateless_random_flip_left_right(image, seed)
+        else:
+            image = tf.image.decode_jpeg(image_bytes, channels=3)
+            # Central crop to 87.5% then resize (standard eval transform).
+            image = tf.image.central_crop(image, 0.875)
+            image = tf.image.resize(image, [size, size], method="bicubic")
+        image = (tf.cast(image, tf.float32) - MEAN_RGB) / STDDEV_RGB
+        return {"image": image, "label": label}
+
+    def make_ds(seed: int):
+        ds = tf.data.Dataset.from_tensor_slices(files)
+        # Disjoint file shard per host (the reference gave each worker its
+        # own input stream; same contract, derived not configured).
+        ds = ds.shard(process_count, process_index)
+        # deterministic=True everywhere: the skip-count resume contract
+        # (tfdata.py) requires the rebuilt pipeline to replay the identical
+        # record order. Parallel reads still overlap; only output order is
+        # pinned.
+        ds = ds.interleave(
+            lambda f: tf.data.TFRecordDataset(f, buffer_size=16 * 1024 * 1024),
+            cycle_length=16,
+            num_parallel_calls=tf.data.AUTOTUNE,
+            deterministic=True,
+        )
+        if train:
+            ds = ds.shuffle(config.shuffle_buffer, seed=seed,
+                            reshuffle_each_iteration=True)
+            ds = ds.repeat()
+        counter = tf.data.Dataset.counter()
+        ds = tf.data.Dataset.zip((ds, counter)).map(
+            lambda rec, i: parse(rec, tf.stack([tf.cast(i, tf.int32), seed])),
+            num_parallel_calls=tf.data.AUTOTUNE,
+        )
+        ds = ds.batch(b, drop_remainder=True)
+        if not train:
+            ds = ds.repeat()
+        return ds.prefetch(tf.data.AUTOTUNE)
+
+    return tfdata_to_hostdataset(
+        make_ds,
+        element_spec={
+            "image": ((b, size, size, 3), np.float32),
+            "label": ((b,), np.int32),
+        },
+    )

@@ -1,0 +1,61 @@
+"""Host→device infeed: assemble per-host batch shards into global arrays.
+
+The reference feeds each worker's GPU from its local tf.data iterator; the
+SPMD equivalent is `jax.make_array_from_process_local_data`: every host
+contributes its shard and the result is ONE logical array sharded over the
+mesh's data axes (BASELINE.json: "tf.data input pipeline hoisted to the TPU
+host with per-replica infeed").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_framework_tpu.core.mesh import batch_spec
+
+
+def to_global(batch: Mapping[str, np.ndarray], mesh: Mesh,
+              spec: P | None = None) -> dict[str, jax.Array]:
+    """Lift a host-local numpy batch to a mesh-sharded global jax.Array tree."""
+    sharding = NamedSharding(mesh, spec if spec is not None else batch_spec(mesh))
+    return {
+        k: jax.make_array_from_process_local_data(sharding, np.asarray(v))
+        for k, v in batch.items()
+    }
+
+
+def prefetch_to_device(dataset, mesh: Mesh, *, size: int = 2, spec: P | None = None):
+    """Software-pipelined infeed: keep `size` global batches in flight.
+
+    The analogue of tf.data's ``prefetch_to_device`` — device transfer of
+    batch N+1 overlaps step N's compute (SURVEY.md §7 hard part 1: input
+    throughput, not the model, is the usual wall).
+
+    Yields ``(global_batch, iterator_state_snapshot)``. The snapshot is the
+    dataset's state immediately after the yielded batch was pulled from it —
+    i.e. the state to checkpoint so a restore resumes with the NEXT batch.
+    Because the prefetcher runs ahead of training, ``dataset.state()`` itself
+    is not safe to checkpoint (it reflects the prefetched-ahead position);
+    the snapshot is (resume-exactness, SURVEY.md §7 hard part 3).
+    """
+    import collections
+
+    queue: collections.deque = collections.deque()
+    snap = getattr(dataset, "state", lambda: {})
+
+    def enqueue(n: int) -> None:
+        for _ in range(n):
+            try:
+                host_batch = next(dataset)
+            except StopIteration:
+                return
+            queue.append((to_global(host_batch, mesh, spec), snap()))
+
+    enqueue(size)
+    while queue:
+        yield queue.popleft()
+        enqueue(1)

@@ -1,0 +1,69 @@
+"""MNIST pipeline (BASELINE.json config 1).
+
+Reads the standard ``mnist.npz`` (keras layout) from ``data_dir``; in a
+zero-egress environment with no file present it falls back to synthetic
+MNIST-shaped data so the workload still runs end-to-end.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+from distributed_tensorflow_framework_tpu.core.config import DataConfig
+from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset, host_batch_size
+from distributed_tensorflow_framework_tpu.data import synthetic
+
+log = logging.getLogger(__name__)
+
+
+def make_mnist(config: DataConfig, process_index: int, process_count: int,
+               *, train: bool = True) -> HostDataset:
+    path = os.path.join(config.data_dir or "", "mnist.npz")
+    if not (config.data_dir and os.path.exists(path)):
+        log.warning("MNIST not found at %r — using synthetic fallback", path)
+        return synthetic.synthetic_images(config, process_index, process_count)
+
+    with np.load(path) as d:
+        if train:
+            images, labels = d["x_train"], d["y_train"]
+        else:
+            images, labels = d["x_test"], d["y_test"]
+    images = images.astype(np.float32)[..., None] / 255.0
+    # Per-image standardization (the reference recipe's normalization).
+    mean = images.mean(axis=(1, 2, 3), keepdims=True)
+    std = images.std(axis=(1, 2, 3), keepdims=True) + 1e-6
+    images = (images - mean) / std
+    labels = labels.astype(np.int32)
+
+    b = host_batch_size(config.global_batch_size, process_count)
+    n = len(images)
+
+    def make_iter(state):
+        state.setdefault("epoch", 0)
+        state.setdefault("batch_in_epoch", 0)
+        while True:
+            rng = np.random.default_rng(config.seed * 131 + state["epoch"])
+            perm = rng.permutation(n)
+            # Each host reads a disjoint shard of the shuffled epoch.
+            shard = perm[process_index::process_count]
+            batches = len(shard) // b
+            start = state["batch_in_epoch"]
+            for i in range(start, batches):
+                idx = shard[i * b:(i + 1) * b]
+                state["batch_in_epoch"] = i + 1
+                yield {"image": images[idx], "label": labels[idx]}
+            state["epoch"] += 1
+            state["batch_in_epoch"] = 0
+
+    return HostDataset(
+        make_iter,
+        element_spec={
+            "image": ((b, 28, 28, 1), np.float32),
+            "label": ((b,), np.int32),
+        },
+        initial_state={"epoch": 0, "batch_in_epoch": 0},
+        cardinality=n // (b * process_count),
+    )

@@ -1,0 +1,72 @@
+"""Host dataset abstraction + save/restore of iterator position.
+
+A `HostDataset` yields dict batches of numpy arrays sized
+``global_batch_size // process_count`` (this process's share). Iterator
+state is a small dict (epoch, position, rng state) so checkpoints can resume
+the input stream exactly — the contract the reference gets from
+MonitoredTrainingSession+Saver only approximately (SURVEY.md §7 hard
+part 3 demands we do better).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Mapping
+
+import numpy as np
+
+Batch = Mapping[str, np.ndarray]
+
+
+def host_batch_size(global_batch_size: int, process_count: int) -> int:
+    """This host's share of the global batch; rejects non-divisible splits
+    (a silent floor-divide would shrink the actual global batch and skew
+    the LR/throughput accounting)."""
+    if global_batch_size % process_count:
+        raise ValueError(
+            f"global_batch_size {global_batch_size} not divisible by "
+            f"process_count {process_count}"
+        )
+    return global_batch_size // process_count
+
+
+class HostDataset:
+    """A restartable, checkpointable per-host batch stream."""
+
+    def __init__(
+        self,
+        make_iter: Callable[[dict[str, Any]], Iterator[Batch]],
+        *,
+        element_spec: Mapping[str, tuple[tuple[int, ...], Any]],
+        initial_state: dict[str, Any] | None = None,
+        cardinality: int | None = None,
+    ):
+        """
+        Args:
+          make_iter: state-dict → iterator of batches; the iterator must
+            mutate the SAME state dict in place as it advances so that
+            ``state()`` is always current.
+          element_spec: name → (per-host batch shape, dtype).
+          initial_state: starting iterator state.
+          cardinality: batches per epoch per host, if known (None = infinite).
+        """
+        self._make_iter = make_iter
+        self.element_spec = dict(element_spec)
+        self._state: dict[str, Any] = dict(initial_state or {})
+        self._iter: Iterator[Batch] | None = None
+        self.cardinality = cardinality
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Batch:
+        if self._iter is None:
+            self._iter = self._make_iter(self._state)
+        return next(self._iter)
+
+    # -- checkpointable iterator state ------------------------------------
+    def state(self) -> dict[str, Any]:
+        return dict(self._state)
+
+    def restore(self, state: dict[str, Any]) -> None:
+        self._state = dict(state)
+        self._iter = None  # rebuild lazily from restored state

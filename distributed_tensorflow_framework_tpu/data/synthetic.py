@@ -1,0 +1,93 @@
+"""Synthetic data sources — benchmarking and hardware-free tests.
+
+Mirrors the role of the reference's "fake cluster on localhost" smoke path
+(SURVEY.md §4): exercise the full runtime with no dataset on disk. Labels
+are a deterministic function of the image/token content so models can
+actually overfit them in integration tests (loss must go down).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from distributed_tensorflow_framework_tpu.core.config import DataConfig
+from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
+
+
+def _host_batch(config: DataConfig, process_count: int) -> int:
+    g = config.global_batch_size
+    if g % process_count:
+        raise ValueError(
+            f"global_batch_size {g} not divisible by process_count {process_count}"
+        )
+    return g // process_count
+
+
+def synthetic_images(
+    config: DataConfig, process_index: int, process_count: int
+) -> HostDataset:
+    b = _host_batch(config, process_count)
+    h = w = config.image_size
+    c = config.channels
+    num_classes = 10
+
+    def make_iter(state: dict[str, Any]):
+        state.setdefault("step", 0)
+        seed_base = (config.seed * 1_000_003 + process_index) & 0x7FFFFFFF
+        while True:
+            rng = np.random.default_rng(seed_base + state["step"])
+            images = rng.standard_normal((b, h, w, c), dtype=np.float32)
+            # Label = sign pattern of per-image mean: learnable mapping.
+            labels = (
+                (images.mean(axis=(1, 2, 3)) * 37.0).astype(np.int64) % num_classes
+            ).astype(np.int32)
+            labels = np.abs(labels)
+            state["step"] += 1
+            yield {"image": images, "label": labels}
+
+    return HostDataset(
+        make_iter,
+        element_spec={
+            "image": ((b, h, w, c), np.float32),
+            "label": ((b,), np.int32),
+        },
+        initial_state={"step": 0},
+    )
+
+
+def synthetic_mlm(
+    config: DataConfig, process_index: int, process_count: int
+) -> HostDataset:
+    b = _host_batch(config, process_count)
+    s = config.seq_len
+    vocab = 30522
+
+    def make_iter(state: dict[str, Any]):
+        state.setdefault("step", 0)
+        seed_base = (config.seed * 1_000_003 + process_index) & 0x7FFFFFFF
+        mask_id = 103  # BERT [MASK]
+        while True:
+            rng = np.random.default_rng(seed_base + state["step"])
+            tokens = rng.integers(1000, vocab, size=(b, s), dtype=np.int64).astype(np.int32)
+            mask = rng.random((b, s)) < config.mask_prob
+            mask[:, 0] = False
+            input_ids = np.where(mask, mask_id, tokens)
+            targets = np.where(mask, tokens, -1).astype(np.int32)
+            state["step"] += 1
+            yield {
+                "input_ids": input_ids,
+                "targets": targets,
+                "attention_mask": np.ones((b, s), dtype=np.int32),
+            }
+
+    return HostDataset(
+        make_iter,
+        element_spec={
+            "input_ids": ((b, s), np.int32),
+            "targets": ((b, s), np.int32),
+            "attention_mask": ((b, s), np.int32),
+        },
+        initial_state={"step": 0},
+    )

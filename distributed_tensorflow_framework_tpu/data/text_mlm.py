@@ -1,0 +1,112 @@
+"""Text MLM pipeline (BASELINE.json config 5).
+
+Consumes pre-tokenized sequences from TFRecords (``input_ids`` int64 list)
+and applies BERT-style dynamic masking on the host: 15% of positions, of
+which 80% → [MASK], 10% → random token, 10% kept. Synthetic fallback when
+no data is present.
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+
+import numpy as np
+
+from distributed_tensorflow_framework_tpu.core.config import DataConfig
+from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset, host_batch_size
+from distributed_tensorflow_framework_tpu.data import synthetic
+from distributed_tensorflow_framework_tpu.data.tfdata import tfdata_to_hostdataset
+
+log = logging.getLogger(__name__)
+
+MASK_ID = 103
+CLS_ID = 101
+SEP_ID = 102
+VOCAB = 30522
+
+
+def apply_mlm_mask(tokens: np.ndarray, rng: np.random.Generator,
+                   mask_prob: float) -> tuple[np.ndarray, np.ndarray]:
+    """BERT dynamic masking. tokens: (b, s) int32. Returns (inputs, targets);
+    targets are -1 at unmasked positions."""
+    special = (tokens == CLS_ID) | (tokens == SEP_ID) | (tokens == 0)
+    candidates = ~special
+    sel = (rng.random(tokens.shape) < mask_prob) & candidates
+    action = rng.random(tokens.shape)
+    inputs = tokens.copy()
+    inputs[sel & (action < 0.8)] = MASK_ID
+    rand_sel = sel & (action >= 0.8) & (action < 0.9)
+    inputs[rand_sel] = rng.integers(1000, VOCAB, size=int(rand_sel.sum()))
+    targets = np.where(sel, tokens, -1).astype(np.int32)
+    return inputs, targets
+
+
+def make_mlm(config: DataConfig, process_index: int, process_count: int,
+             *, train: bool = True) -> HostDataset:
+    files = (
+        sorted(glob.glob(os.path.join(config.data_dir, "*.tfrecord*")))
+        if config.data_dir else []
+    )
+    if not files:
+        log.warning("MLM TFRecords not found under %r — synthetic fallback",
+                    config.data_dir)
+        return synthetic.synthetic_mlm(config, process_index, process_count)
+
+    import tensorflow as tf
+
+    b = host_batch_size(config.global_batch_size, process_count)
+    s = config.seq_len
+
+    def make_tok_ds(seed: int):
+        ds = tf.data.Dataset.from_tensor_slices(files)
+        ds = ds.shard(process_count, process_index)
+        ds = ds.interleave(
+            tf.data.TFRecordDataset,
+            cycle_length=8,
+            num_parallel_calls=tf.data.AUTOTUNE,
+            deterministic=not train,
+        )
+        def parse(rec):
+            feats = tf.io.parse_single_example(
+                rec, {"input_ids": tf.io.FixedLenFeature([s], tf.int64)}
+            )
+            return {"tokens": tf.cast(feats["input_ids"], tf.int32)}
+        ds = ds.map(parse, num_parallel_calls=tf.data.AUTOTUNE)
+        if train:
+            ds = ds.shuffle(config.shuffle_buffer, seed=seed).repeat()
+        else:
+            ds = ds.repeat()
+        return ds.batch(b, drop_remainder=True).prefetch(tf.data.AUTOTUNE)
+
+    base = tfdata_to_hostdataset(
+        make_tok_ds,
+        element_spec={"tokens": ((b, s), np.int32)},
+    )
+
+    # Wrap with host-side dynamic masking (rng keyed off batch counter so
+    # restores re-create identical masks).
+    def make_iter(state):
+        base.restore(state.get("inner", base.state()))
+        for batch in base:
+            state["inner"] = base.state()
+            rng = np.random.default_rng(
+                (config.seed, state["inner"].get("batches", 0), process_index)
+            )
+            inputs, targets = apply_mlm_mask(batch["tokens"], rng, config.mask_prob)
+            yield {
+                "input_ids": inputs,
+                "targets": targets,
+                "attention_mask": (batch["tokens"] != 0).astype(np.int32),
+            }
+
+    return HostDataset(
+        make_iter,
+        element_spec={
+            "input_ids": ((b, s), np.int32),
+            "targets": ((b, s), np.int32),
+            "attention_mask": ((b, s), np.int32),
+        },
+        initial_state={"inner": base.state()},
+    )

@@ -1,0 +1,55 @@
+"""tf.data → HostDataset adapter.
+
+The reference's input pipeline is tf.data end-to-end (SURVEY.md §2 row 5).
+TF (CPU-only) is in the image precisely for this: TFRecord readers, JPEG
+decode and augmentation run on the host CPU; JAX only ever sees the final
+numpy batches.
+
+Iterator checkpointing: tf.data iterators aren't portably serializable, so
+the adapter records ``batches`` consumed and, on restore, rebuilds the
+(seed-deterministic) pipeline and skips that many batches. Skip cost is
+IO-bound only and amortized over a restart. This is strictly stronger than
+the reference's contract (MonitoredTrainingSession restarts re-read the
+stream from wherever the input threads happen to be).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
+
+
+def tfdata_to_hostdataset(
+    make_batched_ds: Callable[[int], Any],
+    *,
+    element_spec: dict,
+    cardinality: int | None = None,
+) -> HostDataset:
+    """Wrap a factory of batched+repeated tf.data datasets.
+
+    Args:
+      make_batched_ds: seed → batched, repeated, deterministic tf.data
+        Dataset yielding dict elements matching element_spec.
+      element_spec: name → (per-host batch shape, numpy dtype).
+    """
+
+    def make_iter(state: dict[str, Any]):
+        state.setdefault("batches", 0)
+        state.setdefault("seed", 0)
+        ds = make_batched_ds(int(state["seed"]))
+        skip = int(state["batches"])
+        if skip:
+            ds = ds.skip(skip)
+        for elem in ds.as_numpy_iterator():
+            state["batches"] += 1
+            yield {k: np.asarray(v) for k, v in elem.items()}
+
+    return HostDataset(
+        make_iter,
+        element_spec=element_spec,
+        initial_state={"batches": 0, "seed": 0},
+        cardinality=cardinality,
+    )

@@ -1,0 +1,71 @@
+"""Model zoo: the reference's model families, rebuilt as Flax modules.
+
+SURVEY.md §2 rows 6–8 + BASELINE.json configs: LeNet-5 (MNIST smoke test),
+ResNet-50 (CIFAR-10 and ImageNet variants, fused/cross-replica BN),
+Inception-v3 (the reference's async-PS workload, here sync replicas), and
+BERT-base MLM (the new-build transformer workload).
+
+``get_model(config)`` is the registry — the analogue of the reference's
+model-name flag dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from distributed_tensorflow_framework_tpu.core.config import ModelConfig
+
+
+def get_model(config: ModelConfig, *, bn_axis_name=None) -> Any:
+    """Build a Flax module from a ModelConfig (name-based dispatch).
+
+    ``bn_axis_name`` is only set when the caller will run the model inside
+    shard_map and wants cross-replica BN statistics (see
+    models/layers.py docstring); under jit it must stay None.
+    """
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(config.dtype)
+    name = config.name.lower()
+    if name in ("lenet", "lenet5", "lenet-5"):
+        from distributed_tensorflow_framework_tpu.models.lenet import LeNet5
+
+        return LeNet5(num_classes=config.num_classes, dtype=dtype)
+    if name in ("resnet50", "resnet-50"):
+        from distributed_tensorflow_framework_tpu.models.resnet import ResNet50
+
+        return ResNet50(
+            num_classes=config.num_classes,
+            dtype=dtype,
+            bn_axis_name=bn_axis_name,
+        )
+    if name in ("resnet50_cifar", "resnet-50-cifar"):
+        from distributed_tensorflow_framework_tpu.models.resnet import ResNet50Cifar
+
+        return ResNet50Cifar(
+            num_classes=config.num_classes,
+            dtype=dtype,
+            bn_axis_name=bn_axis_name,
+        )
+    if name in ("inception_v3", "inception-v3", "inceptionv3"):
+        from distributed_tensorflow_framework_tpu.models.inception import InceptionV3
+
+        return InceptionV3(
+            num_classes=config.num_classes,
+            dtype=dtype,
+            bn_axis_name=bn_axis_name,
+        )
+    if name in ("bert", "bert_base", "bert-base"):
+        from distributed_tensorflow_framework_tpu.models.bert import BertForMLM
+
+        return BertForMLM(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_layers,
+            num_heads=config.num_heads,
+            mlp_dim=config.mlp_dim,
+            max_seq_len=config.max_seq_len,
+            dtype=dtype,
+            attention_impl=config.attention_impl,
+        )
+    raise ValueError(f"Unknown model {config.name!r}")

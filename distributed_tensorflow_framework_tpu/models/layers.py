@@ -1,0 +1,107 @@
+"""Shared layer building blocks.
+
+The key design point is BatchNorm statistic scope (SURVEY.md §7 hard part 2).
+The reference's fused BN is per-GPU: each replica normalizes with its own
+minibatch statistics. Under this framework:
+
+  * In the jit/pjit path the batch is one logical array sharded over the
+    ``data`` axis, so plain `nn.BatchNorm` statistics are **global** — XLA
+    inserts the cross-replica reduction automatically. This is cross-replica
+    ("sync") BN by construction.
+  * In the shard_map path the code is per-replica, so `nn.BatchNorm` without
+    an ``axis_name`` reproduces the reference's per-replica semantics, and
+    passing ``axis_name=('data','fsdp')`` (threaded via the module's
+    ``bn_axis_name``) upgrades it to cross-replica.
+
+Models expose ``cross_replica_bn`` and receive the runtime's axis names via
+`flax`'s module attribute; the train step decides what to pass based on
+``TrainConfig.spmd_mode``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+# Initializers matching the reference recipe class: He/variance-scaling for
+# conv (the TF slim/layers default for ResNet), zeros for BN beta, ones gamma.
+conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+dense_kernel_init = nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal")
+
+
+class BatchNorm(nn.Module):
+    """BatchNorm with switchable cross-replica statistics.
+
+    ``axis_name`` is only set when running under shard_map (see module
+    docstring); ``scale_init`` supports the zero-init-gamma trick for the
+    last BN of each residual block.
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Dtype = jnp.float32
+    axis_name: str | Sequence[str] | None = None
+    scale_init: Callable = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.BatchNorm(
+            use_running_average=self.use_running_average,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.axis_name,
+            scale_init=self.scale_init,
+            name="bn",
+        )(x)
+
+
+class ConvBN(nn.Module):
+    """Conv → BN → (optional) ReLU — the reference's fused conv/BN unit.
+
+    On TPU the fusion the reference gets from cuDNN fused-BN comes from XLA:
+    the BN scale/shift and ReLU fuse into the convolution's epilogue
+    (SURVEY.md §2 native rows "cuDNN conv" / "fused batch-norm").
+    """
+
+    features: int
+    kernel_size: tuple[int, int] = (3, 3)
+    strides: tuple[int, int] = (1, 1)
+    padding: str | Sequence[tuple[int, int]] = "SAME"
+    use_relu: bool = True
+    train: bool = True
+    dtype: Dtype = jnp.float32
+    bn_axis_name: str | Sequence[str] | None = None
+    zero_init_gamma: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(
+            self.features,
+            self.kernel_size,
+            strides=self.strides,
+            padding=self.padding,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=conv_kernel_init,
+            name="conv",
+        )(x)
+        x = BatchNorm(
+            use_running_average=not self.train,
+            dtype=self.dtype,
+            axis_name=self.bn_axis_name,
+            scale_init=(
+                nn.initializers.zeros if self.zero_init_gamma
+                else nn.initializers.ones
+            ),
+        )(x)
+        if self.use_relu:
+            x = nn.relu(x)
+        return x

@@ -1,0 +1,45 @@
+"""LeNet-5 — the reference's MNIST smoke-test model.
+
+SURVEY.md §2 row 6 / BASELINE.json config 1: "LeNet-5 on MNIST, single
+worker (CPU-runnable smoke test)". Classic conv(6)→pool→conv(16)→pool→
+dense(120)→dense(84)→dense(classes) topology; runs in seconds on CPU and
+exercises the full runtime (mesh, collectives, loop, checkpointing).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_framework_tpu.models.layers import dense_kernel_init
+
+
+class LeNet5(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        del train  # no BN/dropout in the classic topology
+        x = x.astype(self.dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype,
+                    param_dtype=jnp.float32, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype,
+                    param_dtype=jnp.float32, name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(120, dtype=self.dtype, param_dtype=jnp.float32,
+                     kernel_init=dense_kernel_init, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(84, dtype=self.dtype, param_dtype=jnp.float32,
+                     kernel_init=dense_kernel_init, name="fc2")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, kernel_init=dense_kernel_init,
+                     name="logits")(x)
+        return x

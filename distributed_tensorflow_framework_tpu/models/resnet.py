@@ -1,0 +1,104 @@
+"""ResNet-50 — the headline model (SURVEY.md §2 row 7).
+
+Bottleneck-v1.5 topology (stride-2 in the 3×3 conv): conv7×7/s2 → BN →
+relu → maxpool/2 → stages [3,4,6,3] of 1×1/3×3/1×1 bottlenecks with
+residual adds → global average pool → dense(classes). The reference builds
+this from TF layers over cuDNN conv + fused BN; here every conv lowers to
+an MXU convolution and BN+relu fuse into the conv epilogue via XLA.
+
+TPU-specific choices:
+  * compute in bfloat16, params + BN stats in float32 (MXU-native mixed
+    precision; the reference is fp32-only on V100);
+  * zero-init of the last BN gamma in each block (standard large-batch
+    recipe — identity residual branches at init);
+  * ``bn_axis_name`` threads shard_map axis names for cross-replica BN
+    (SURVEY.md §7 hard part 2); under jit, BN stats are global already.
+
+``ResNet50Cifar`` swaps the 7×7/s2+maxpool stem for a 3×3/s1 stem — the
+standard CIFAR variant (config 2 of BASELINE.json).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_framework_tpu.models.layers import ConvBN, dense_kernel_init
+
+
+class Bottleneck(nn.Module):
+    features: int          # bottleneck width; output is 4x this
+    strides: tuple[int, int] = (1, 1)
+    train: bool = True
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = ConvBN(self.features, (1, 1), train=self.train, dtype=self.dtype,
+                   bn_axis_name=self.bn_axis_name, name="conv1")(x)
+        y = ConvBN(self.features, (3, 3), strides=self.strides,
+                   train=self.train, dtype=self.dtype,
+                   bn_axis_name=self.bn_axis_name, name="conv2")(y)
+        y = ConvBN(4 * self.features, (1, 1), use_relu=False,
+                   train=self.train, dtype=self.dtype,
+                   bn_axis_name=self.bn_axis_name, zero_init_gamma=True,
+                   name="conv3")(y)
+        if residual.shape != y.shape:
+            residual = ConvBN(4 * self.features, (1, 1), strides=self.strides,
+                              use_relu=False, train=self.train,
+                              dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+                              name="proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    cifar_stem: bool = False
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: Any = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = ConvBN(self.width, (3, 3), train=train, dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name, name="stem")(x)
+        else:
+            x = ConvBN(self.width, (7, 7), strides=(2, 2), train=train,
+                       dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+                       name="stem")(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, size in enumerate(self.stage_sizes):
+            for block in range(size):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = Bottleneck(
+                    self.width * 2 ** stage,
+                    strides=strides,
+                    train=train,
+                    dtype=self.dtype,
+                    bn_axis_name=self.bn_axis_name,
+                    name=f"stage{stage + 1}_block{block + 1}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, kernel_init=dense_kernel_init,
+                     name="classifier")(x.astype(jnp.float32))
+        return x
+
+
+def ResNet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+             bn_axis_name: Any = None) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
+                  dtype=dtype, bn_axis_name=bn_axis_name)
+
+
+def ResNet50Cifar(num_classes: int = 10, dtype: Any = jnp.bfloat16,
+                  bn_axis_name: Any = None) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
+                  cifar_stem=True, dtype=dtype, bn_axis_name=bn_axis_name)

@@ -1,0 +1,19 @@
+"""Parallelism: sharding rules, collectives, shard_map training path.
+
+The reference's parallelism story is synchronous data parallelism
+(SyncReplicasOptimizer + NCCL all-reduce) plus an async parameter-server
+mode (SURVEY.md §2 rows 3–4). Here:
+
+  sharding.py     param/batch PartitionSpec rules: DP (replicated params),
+                  FSDP (ZeRO-style), TP (megatron-style for transformer
+                  blocks) — all expressed against the canonical 4-axis mesh
+  collectives.py  thin named wrappers over psum/pmean/all_gather/ppermute/
+                  reduce_scatter (the XLA/ICI equivalent of NCCL calls)
+  ring.py         ring attention: sequence-parallel exact attention via
+                  ppermute over the ``seq`` axis (long-context support)
+"""
+
+from distributed_tensorflow_framework_tpu.parallel.sharding import (  # noqa: F401
+    infer_param_specs,
+    shard_pytree,
+)

@@ -1,0 +1,57 @@
+"""Named collective wrappers — the XLA/ICI analogue of the NCCL call sites.
+
+The reference's gradient aggregation is NCCL all-reduce hidden inside
+``SyncReplicasOptimizer`` (SURVEY.md §2 row 3 + native rows); its variable
+traffic is grpc to the PS. Under SPMD both collapse into XLA collectives
+emitted inside jit/shard_map and scheduled on ICI (intra-slice) or DCN
+(inter-slice) by the compiler. These wrappers exist so call sites name the
+intent (``allreduce_gradients``) rather than the primitive, and so the
+shard_map training path reads like the reference's pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DATA_AXES = ("data", "fsdp")
+
+
+def allreduce_gradients(grads: Any, axis_names: Sequence[str] = DATA_AXES) -> Any:
+    """Mean-reduce gradients across data-parallel replicas (sync-DP core)."""
+    return jax.tree.map(lambda g: lax.pmean(g, axis_names), grads)
+
+
+def psum(x: Any, axis_names: Sequence[str] | str) -> Any:
+    return jax.tree.map(lambda v: lax.psum(v, axis_names), x)
+
+
+def pmean(x: Any, axis_names: Sequence[str] | str) -> Any:
+    return jax.tree.map(lambda v: lax.pmean(v, axis_names), x)
+
+
+def all_gather(x: jax.Array, axis_name: str, *, axis: int = 0, tiled: bool = True) -> jax.Array:
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x: jax.Array, axis_name: str, *, scatter_axis: int = 0) -> jax.Array:
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ppermute_shift(x: jax.Array, axis_name: str, *, shift: int = 1) -> jax.Array:
+    """Ring shift: send to (i + shift) mod N — the ring-attention primitive."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str) -> jax.Array:
+    return lax.axis_index(axis_name)
+
+
+def global_norm(tree: Any) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))

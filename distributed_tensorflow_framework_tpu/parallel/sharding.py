@@ -1,0 +1,135 @@
+"""Parameter and activation sharding rules.
+
+Replaces ``tf.train.replica_device_setter`` (SURVEY.md §2 row 2): instead of
+pinning variables to parameter-server processes, every parameter gets a
+`PartitionSpec` over the canonical mesh axes:
+
+  * **DP** (reference parity): all params replicated, batch sharded over
+    ``data`` — XLA turns the grad mean into a cross-replica-sum over ICI,
+    which is the SyncReplicasOptimizer+NCCL pipeline with zero user code.
+  * **FSDP**: each param's largest divisible axis additionally sharded over
+    ``fsdp`` (ZeRO-3-style; cf. SURVEY.md §7 hard part 5 / the
+    cross-replica weight-update sharding paper in PAPERS.md).
+  * **TP**: transformer kernels get megatron-style column/row splits over
+    ``model`` via name-pattern rules.
+
+Rules are name-pattern based so models don't need flax partitioning
+metadata threaded through every module (they may still provide it; explicit
+metadata wins).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Megatron-style TP rules for the transformer models: column-parallel QKV and
+# MLP-in (shard output features), row-parallel attn-out and MLP-out (shard
+# input features). Patterns are matched against "/".join(param path).
+TP_RULES: list[tuple[str, tuple[str | None, ...]]] = [
+    (r".*(query|key|value|qkv)/kernel$", (None, "model")),
+    (r".*attn_out/kernel$", ("model", None)),
+    (r".*mlp_in/kernel$", (None, "model")),
+    (r".*mlp_out/kernel$", ("model", None)),
+    (r".*embed/embedding$", (None, "model")),
+]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _apply_tp(path: str, shape: tuple[int, ...], mesh: Mesh) -> P | None:
+    tp = mesh.shape.get("model", 1)
+    if tp <= 1:
+        return None
+    for pattern, spec in TP_RULES:
+        if re.match(pattern, path):
+            # Drop axes that don't divide evenly (falls back to replication
+            # on that dim rather than failing).
+            fixed = []
+            for dim, axis in zip(shape, spec):
+                if axis is not None and dim % mesh.shape[axis] == 0:
+                    fixed.append(axis)
+                else:
+                    fixed.append(None)
+            return P(*fixed)
+    return None
+
+
+def _apply_fsdp(spec: P | None, shape: tuple[int, ...], mesh: Mesh) -> P | None:
+    fsdp = mesh.shape.get("fsdp", 1)
+    if fsdp <= 1:
+        return spec
+    dims = spec if spec is not None else (None,) * len(shape)
+    dims = tuple(dims) + (None,) * (len(shape) - len(tuple(dims)))
+    # Shard the largest still-unsharded divisible dim over fsdp.
+    best, best_size = -1, 0
+    for i, (dim, axis) in enumerate(zip(shape, dims)):
+        if axis is None and dim % fsdp == 0 and dim > best_size:
+            best, best_size = i, dim
+    if best < 0:
+        return spec
+    new = list(dims)
+    new[best] = "fsdp"
+    return P(*new)
+
+
+def infer_param_specs(
+    params: Any,
+    mesh: Mesh,
+    *,
+    fsdp: bool | None = None,
+    tensor_parallel: bool | None = None,
+) -> Any:
+    """PartitionSpec pytree for a param pytree under the given mesh.
+
+    Defaults: TP rules apply iff the mesh's ``model`` axis > 1; FSDP applies
+    iff the ``fsdp`` axis > 1. Anything unmatched is replicated — the
+    reference-parity DP layout.
+    """
+    use_tp = tensor_parallel if tensor_parallel is not None else mesh.shape.get("model", 1) > 1
+    use_fsdp = fsdp if fsdp is not None else mesh.shape.get("fsdp", 1) > 1
+
+    def rule(path, leaf) -> P:
+        shape = tuple(np.shape(leaf))
+        spec: P | None = None
+        if use_tp:
+            spec = _apply_tp(_path_str(path), shape, mesh)
+        if use_fsdp:
+            spec = _apply_fsdp(spec, shape, mesh)
+        if spec is None:
+            spec = P()
+        return spec
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def specs_to_shardings(specs: Any, mesh: Mesh) -> Any:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """Place a host pytree onto the mesh with the given specs."""
+    shardings = specs_to_shardings(specs, mesh)
+    return jax.tree.map(jax.device_put, tree, shardings)
+
+
+def tree_map_specs(fn: Callable[[P], P], specs: Any) -> Any:
+    return jax.tree.map(fn, specs, is_leaf=lambda x: isinstance(x, P))

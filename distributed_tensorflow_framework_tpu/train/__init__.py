@@ -1,0 +1,11 @@
+"""Training runtime: jitted steps, schedules, loop, hooks.
+
+Replaces the reference's L2 (SURVEY.md §2 rows 3, 9, 10): loss + optimizer
+wrapping (SyncReplicasOptimizer in sync mode), MonitoredTrainingSession's
+step loop, and its hook set (StopAtStep, NaN guard, checkpoint, summaries).
+The sync-replica barrier disappears: a jitted step over a sharded batch is
+synchronous by construction.
+"""
+
+from distributed_tensorflow_framework_tpu.train.state import TrainState  # noqa: F401
+from distributed_tensorflow_framework_tpu.train.loop import Trainer  # noqa: F401

@@ -1,0 +1,118 @@
+"""Training hooks — MonitoredTrainingSession's hook set, SPMD-style.
+
+SURVEY.md §2 row 10: the reference's loop runs under
+MonitoredTrainingSession with StopAtStepHook, NanTensorHook, checkpoint
+saver and summary saver hooks. Same extension points here, as plain Python
+objects driven by the Trainer. Hooks only ever touch host-side metric
+values (already-fetched scalars) so they never force extra device syncs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Protocol
+
+import math
+
+
+class Hook(Protocol):
+    def on_start(self, trainer: Any) -> None: ...
+    def after_step(self, trainer: Any, step: int,
+                   metrics: Mapping[str, float] | None) -> None: ...
+    def on_end(self, trainer: Any) -> None: ...
+
+
+class BaseHook:
+    def on_start(self, trainer) -> None:
+        pass
+
+    def after_step(self, trainer, step, metrics) -> None:
+        pass
+
+    def on_end(self, trainer) -> None:
+        pass
+
+
+class NaNGuardHook(BaseHook):
+    """NanTensorHook analogue: abort when the loss goes non-finite.
+
+    Checks only at metric-fetch steps (metrics is None otherwise) to avoid
+    per-step device→host syncs.
+    """
+
+    def after_step(self, trainer, step, metrics) -> None:
+        if metrics is None:
+            return
+        loss = metrics.get("loss")
+        if loss is not None and not math.isfinite(float(loss)):
+            raise FloatingPointError(
+                f"Non-finite loss {loss} at step {step} — aborting "
+                f"(NaNGuardHook; reference NanTensorHook contract)"
+            )
+
+
+class ThroughputHook(BaseHook):
+    """Tracks examples/sec(/chip) — the BASELINE.json tracked metric."""
+
+    def __init__(self, batch_size: int, num_chips: int):
+        from distributed_tensorflow_framework_tpu.core.metrics import ThroughputMeter
+
+        self.batch_size = batch_size
+        self.meter = ThroughputMeter(num_chips)
+
+    def on_start(self, trainer) -> None:
+        self.meter.start()
+
+    def after_step(self, trainer, step, metrics) -> None:
+        self.meter.update(self.batch_size)
+
+    def rates(self) -> dict[str, float]:
+        return self.meter.rates()
+
+
+class LoggingHook(BaseHook):
+    def __init__(self, writer, interval: int, throughput: ThroughputHook | None = None):
+        self.writer = writer
+        self.interval = max(1, interval)
+        self.throughput = throughput
+
+    def after_step(self, trainer, step, metrics) -> None:
+        # The Trainer only fetches metrics at its own log cadence; the
+        # interval here additionally guards custom loops that fetch more
+        # often (final step always logs).
+        if metrics is None:
+            return
+        if step % self.interval and step < trainer.config.train.total_steps:
+            return
+        out = dict(metrics)
+        if self.throughput is not None:
+            out.update(self.throughput.rates())
+            self.throughput.meter.reset()
+        self.writer.write(step, out)
+
+
+class CheckpointHook(BaseHook):
+    def __init__(self, manager, interval: int):
+        self.manager = manager
+        self.interval = max(1, interval)
+
+    def after_step(self, trainer, step, metrics) -> None:
+        if step > 0 and step % self.interval == 0:
+            self.manager.save(step, trainer.state,
+                              dataset_state=trainer.data_ckpt_state)
+
+    def on_end(self, trainer) -> None:
+        self.manager.save(int(trainer.host_step), trainer.state,
+                          dataset_state=trainer.data_ckpt_state, force=True)
+        self.manager.wait_until_finished()
+
+
+class EvalHook(BaseHook):
+    """Mid-training eval — the reference's eval loop (SURVEY.md §3.4)."""
+
+    def __init__(self, eval_fn, interval: int):
+        self.eval_fn = eval_fn
+        self.interval = max(1, interval)
+
+    def after_step(self, trainer, step, metrics) -> None:
+        if step > 0 and step % self.interval == 0:
+            self.eval_fn(step)

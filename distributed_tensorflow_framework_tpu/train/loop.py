@@ -1,0 +1,159 @@
+"""The training loop — MonitoredTrainingSession, SPMD-style.
+
+SURVEY.md §3.1: the reference's hot loop is ``while not stop:
+session.run(train_op)`` under MonitoredTrainingSession (checkpoint restore
+on start, hooks each step, chief-only services). The Trainer keeps that
+contract: build → maybe-restore → step loop with hooks → final save, with
+two differences that matter on TPU:
+
+  * metrics are fetched only at log intervals — each step returns device
+    arrays that are NOT synced unless a hook needs them, so the loop stays
+    ahead of the device (async dispatch);
+  * there are no session/graph handles: the "session" is a compiled
+    function and the "server" is the mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+
+from distributed_tensorflow_framework_tpu.core.config import ExperimentConfig
+from distributed_tensorflow_framework_tpu.core.mesh import MeshRuntime, initialize_runtime
+from distributed_tensorflow_framework_tpu.core.metrics import MetricWriter, setup_logging
+from distributed_tensorflow_framework_tpu.data import get_dataset
+from distributed_tensorflow_framework_tpu.data.infeed import prefetch_to_device, to_global
+from distributed_tensorflow_framework_tpu.train import hooks as hooks_lib
+from distributed_tensorflow_framework_tpu.train.step import StepBuilder
+
+log = logging.getLogger(__name__)
+
+
+class Trainer:
+    def __init__(self, config: ExperimentConfig, runtime: MeshRuntime | None = None):
+        setup_logging()
+        self.config = config
+        self.runtime = runtime or initialize_runtime(config.mesh)
+        self.mesh = self.runtime.mesh
+        self.dataset = get_dataset(
+            config.data,
+            process_index=self.runtime.process_index,
+            process_count=self.runtime.process_count,
+        )
+        self.builder = StepBuilder(config, self.mesh)
+        self.writer = MetricWriter(
+            logdir=(config.checkpoint.directory or None),
+            is_chief=self.runtime.is_chief,
+        )
+        self.state: Any = None
+        self.host_step = 0
+        self._ckpt_manager = None
+        # Iterator snapshot aligned with host_step (see data/infeed.py).
+        self.data_ckpt_state: dict = self.dataset.state()
+
+    # -------------------------------------------------------------- setup --
+    def build(self) -> None:
+        # Peek one batch for shapes, then restore the stream to the start.
+        start_state = self.dataset.state()
+        host_batch = next(self.dataset)
+        self.dataset.restore(start_state)
+        sample = to_global(host_batch, self.mesh)
+        self.state = self.builder.init_state(self.config.train.seed, sample)
+        self.train_step = self.builder.make_train_step(sample)
+        self.eval_step = self.builder.make_eval_step(sample)
+        # Checkpoint manager + auto-restore (MonitoredTrainingSession
+        # contract: restore latest from checkpoint_dir if present).
+        if self.config.checkpoint.directory:
+            from distributed_tensorflow_framework_tpu.ckpt import CheckpointManager
+
+            self._ckpt_manager = CheckpointManager(
+                self.config.checkpoint, is_chief=self.runtime.is_chief
+            )
+            if self.config.checkpoint.restore:
+                restored = self._ckpt_manager.restore(self.state, dataset=self.dataset)
+                if restored is not None:
+                    self.state = restored
+                    self.host_step = int(jax.device_get(self.state.step))
+                    log.info("Restored checkpoint at step %d", self.host_step)
+
+    def default_hooks(self) -> list:
+        cfg = self.config
+        tp = hooks_lib.ThroughputHook(
+            batch_size=cfg.data.global_batch_size,
+            num_chips=self.runtime.global_device_count,
+        )
+        hooks = [tp, hooks_lib.LoggingHook(self.writer, cfg.train.log_interval, tp)]
+        if cfg.train.nan_guard:
+            hooks.append(hooks_lib.NaNGuardHook())
+        if self._ckpt_manager is not None:
+            hooks.append(
+                hooks_lib.CheckpointHook(
+                    self._ckpt_manager, cfg.checkpoint.save_interval_steps
+                )
+            )
+        if cfg.train.eval_interval > 0:
+            hooks.append(hooks_lib.EvalHook(self.evaluate, cfg.train.eval_interval))
+        return hooks
+
+    # --------------------------------------------------------------- train --
+    def train(self, hooks: list | None = None) -> dict[str, float]:
+        if self.state is None:
+            self.build()
+        cfg = self.config.train
+        hooks = self.default_hooks() if hooks is None else hooks
+        for h in hooks:
+            h.on_start(self)
+
+        last_metrics: dict[str, float] = {}
+        infeed = prefetch_to_device(
+            self.dataset, self.mesh, size=self.config.data.prefetch
+        )
+        while self.host_step < cfg.total_steps:
+            batch, self.data_ckpt_state = next(infeed)
+            self.state, metrics = self.train_step(self.state, batch)
+            self.host_step += 1
+            fetch = (
+                self.host_step % cfg.log_interval == 0
+                or self.host_step >= cfg.total_steps
+            )
+            host_metrics = None
+            if fetch:
+                # Only here does the host sync with the device; off-interval
+                # steps dispatch asynchronously.
+                host_metrics = {
+                    k: float(v) for k, v in jax.device_get(metrics).items()
+                }
+                last_metrics = host_metrics
+            for h in hooks:
+                h.after_step(self, self.host_step, host_metrics)
+        for h in hooks:
+            h.on_end(self)
+        return last_metrics
+
+    # ---------------------------------------------------------------- eval --
+    def evaluate(self, step: int | None = None, num_batches: int | None = None) -> dict[str, float]:
+        if self.state is None:
+            self.build()
+        eval_cfg = self.config.eval_data or self.config.data
+        ds = get_dataset(
+            eval_cfg,
+            process_index=self.runtime.process_index,
+            process_count=self.runtime.process_count,
+            train=False,
+        )
+        n = num_batches or self.config.train.eval_steps
+        totals: dict[str, float] = {}
+        count = 0
+        for i, (batch, _) in enumerate(prefetch_to_device(ds, self.mesh, size=2)):
+            if i >= n:
+                break
+            m = jax.device_get(self.eval_step(self.state, batch))
+            for k, v in m.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+            count += 1
+        results = {f"eval_{k}": v / max(count, 1) for k, v in totals.items()}
+        if step is not None:
+            self.writer.write(step, results)
+        return results

@@ -1,0 +1,51 @@
+"""Loss functions and in-step metrics.
+
+SURVEY.md §2 row 9: softmax cross-entropy (+ weight decay, handled in the
+optimizer chain) and top-1/top-5 metrics for the image models; masked-LM
+cross-entropy for the BERT workload. All functions are pure and jit-safe;
+losses are means over the *global* batch so that data-parallel gradient
+aggregation is exactly the reference's SyncReplicasOptimizer mean.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def classification_loss(
+    logits: jax.Array, labels: jax.Array, *, label_smoothing: float = 0.0
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    num_classes = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    if label_smoothing > 0:
+        onehot = optax.smooth_labels(
+            jax.nn.one_hot(labels, num_classes), label_smoothing
+        )
+        losses = optax.softmax_cross_entropy(logits, onehot)
+    else:
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    loss = losses.mean()
+    top1 = (jnp.argmax(logits, axis=-1) == labels).mean()
+    metrics = {"loss": loss, "top1": top1}
+    if num_classes > 5:
+        top5_preds = jax.lax.top_k(logits, 5)[1]
+        metrics["top5"] = (top5_preds == labels[:, None]).any(axis=-1).mean()
+    return loss, metrics
+
+
+def mlm_loss(
+    logits: jax.Array, targets: jax.Array
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Masked-LM CE. ``targets`` holds the original token at masked
+    positions and -1 elsewhere."""
+    logits = logits.astype(jnp.float32)
+    mask = (targets >= 0).astype(jnp.float32)
+    safe_targets = jnp.maximum(targets, 0)
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, safe_targets)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (losses * mask).sum() / denom
+    correct = (jnp.argmax(logits, axis=-1) == safe_targets).astype(jnp.float32)
+    acc = (correct * mask).sum() / denom
+    return loss, {"loss": loss, "mlm_acc": acc}

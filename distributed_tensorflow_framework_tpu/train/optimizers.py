@@ -1,0 +1,75 @@
+"""Optimizer construction from config.
+
+The reference wraps a base optimizer (momentum SGD / RMSProp family) in
+SyncReplicasOptimizer for gradient aggregation (SURVEY.md §2 row 3). Here
+aggregation is the mesh's job; this module only builds the local update
+rule as an optax chain: grad-clip → base update → weight decay → lr
+schedule.
+
+Weight decay follows the recipe convention: applied to conv/dense kernels,
+not to BN params or biases.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import optax
+
+from distributed_tensorflow_framework_tpu.core.config import OptimizerConfig
+from distributed_tensorflow_framework_tpu.train.schedules import make_schedule
+
+
+def _decay_mask(params: Any) -> Any:
+    """True where weight decay applies: rank≥2 kernels, not BN/bias."""
+    import jax
+    import numpy as np
+
+    def keep(path, leaf) -> bool:
+        names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+        if any(str(n) in ("bn", "scale", "bias") for n in names):
+            return False
+        return np.ndim(leaf) >= 2
+
+    return jax.tree_util.tree_map_with_path(keep, params)
+
+
+def make_optimizer(
+    config: OptimizerConfig, total_steps: int
+) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    sched = make_schedule(config, total_steps)
+    chain = []
+    if config.grad_clip_norm > 0:
+        chain.append(optax.clip_by_global_norm(config.grad_clip_norm))
+    name = config.name.lower()
+    if name in ("sgd", "sgd_momentum", "momentum"):
+        if config.weight_decay > 0:
+            chain.append(optax.add_decayed_weights(config.weight_decay, mask=_decay_mask))
+        chain.append(optax.sgd(sched, momentum=config.momentum, nesterov=config.nesterov))
+    elif name == "adam":
+        if config.weight_decay > 0:
+            chain.append(optax.add_decayed_weights(config.weight_decay, mask=_decay_mask))
+        chain.append(optax.adam(sched, b1=config.beta1, b2=config.beta2, eps=config.eps))
+    elif name == "adamw":
+        chain.append(
+            optax.adamw(
+                sched,
+                b1=config.beta1,
+                b2=config.beta2,
+                eps=config.eps,
+                weight_decay=config.weight_decay,
+                mask=_decay_mask,
+            )
+        )
+    elif name == "lars":
+        chain.append(
+            optax.lars(
+                sched,
+                weight_decay=config.weight_decay,
+                weight_decay_mask=_decay_mask,
+                momentum=config.momentum,
+            )
+        )
+    else:
+        raise ValueError(f"Unknown optimizer {config.name!r}")
+    return optax.chain(*chain), sched

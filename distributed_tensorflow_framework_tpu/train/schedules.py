@@ -1,0 +1,38 @@
+"""Learning-rate schedules.
+
+The reference class of recipes uses warmup + staircase decay for ResNet
+(the classic ImageNet 30/60/80-epoch drops) and exponential/constant for
+the smaller configs (SURVEY.md §2 row 9 context). All schedules here are
+optax schedules usable inside jit.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from distributed_tensorflow_framework_tpu.core.config import OptimizerConfig
+
+
+def make_schedule(config: OptimizerConfig, total_steps: int) -> optax.Schedule:
+    base = config.learning_rate
+    decay_steps = max(1, total_steps - config.warmup_steps)
+    if config.schedule == "constant":
+        sched = optax.constant_schedule(base)
+    elif config.schedule == "cosine":
+        sched = optax.cosine_decay_schedule(base, decay_steps)
+    elif config.schedule == "linear":
+        sched = optax.linear_schedule(base, 0.0, decay_steps)
+    elif config.schedule == "staircase":
+        # Config boundaries are absolute global steps; join_schedules feeds
+        # the post-warmup schedule (step - warmup_steps), so shift them.
+        boundaries = {
+            int(b) - config.warmup_steps: config.decay_factor
+            for b in config.boundaries
+        }
+        sched = optax.piecewise_constant_schedule(base, boundaries)
+    else:
+        raise ValueError(f"Unknown schedule {config.schedule!r}")
+    if config.warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, base, config.warmup_steps)
+        sched = optax.join_schedules([warmup, sched], [config.warmup_steps])
+    return sched

@@ -1,0 +1,36 @@
+"""Training state pytree.
+
+The SPMD replacement for the reference's PS-resident variable set +
+``global_step`` + optimizer slots (SURVEY.md §2 rows 2–3): params, BN
+running stats, optimizer state, step counter and the dropout RNG key in one
+checkpointable pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array          # int32 scalar — the reference's global_step
+    params: Any
+    batch_stats: Any         # BN running stats ({} for BN-free models)
+    opt_state: optax.OptState
+    rng: jax.Array           # dropout/noise root key (device-side)
+
+    @classmethod
+    def create(cls, *, params, batch_stats, tx: optax.GradientTransformation,
+               rng: jax.Array) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=tx.init(params),
+            rng=rng,
+        )

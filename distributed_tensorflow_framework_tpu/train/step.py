@@ -1,0 +1,243 @@
+"""Train/eval step construction — the framework's hot loop.
+
+Replaces SURVEY.md §3.1's per-step pipeline (read vars from PS over grpc →
+local fwd/bwd → NCCL grad aggregation → chief applies update → sync token)
+with ONE compiled SPMD program in two selectable flavors:
+
+  * ``spmd_mode="jit"``: the batch is a global array sharded over the data
+    axes; the loss is a mean over the global batch, so XLA emits the
+    cross-replica-sum for the gradients automatically. BN statistics are
+    global (cross-replica) by construction.
+  * ``spmd_mode="shard_map"``: per-replica code with explicit
+    `pmean(grads)` — structurally the closest analogue of the reference's
+    SyncReplicasOptimizer+NCCL pipeline, and the mode in which per-replica
+    BN (the reference's exact semantics) is expressible.
+
+Both modes produce bitwise-identical parameter trajectories for BN-free
+models (tested in tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_framework_tpu.core.config import ExperimentConfig
+from distributed_tensorflow_framework_tpu.core import prng
+from distributed_tensorflow_framework_tpu.core.mesh import batch_spec
+from distributed_tensorflow_framework_tpu.models import get_model
+from distributed_tensorflow_framework_tpu.parallel import sharding as shd
+from distributed_tensorflow_framework_tpu.parallel import collectives as coll
+from distributed_tensorflow_framework_tpu.train import losses
+from distributed_tensorflow_framework_tpu.train.optimizers import make_optimizer
+from distributed_tensorflow_framework_tpu.train.state import TrainState
+
+DATA_AXES = ("data", "fsdp")
+
+
+def task_for_model(name: str) -> str:
+    return "mlm" if "bert" in name.lower() else "classification"
+
+
+def model_inputs(task: str, batch: Any) -> tuple:
+    if task == "mlm":
+        return (batch["input_ids"],)
+    return (batch["image"],)
+
+
+class StepBuilder:
+    """Builds the compiled init / train_step / eval_step for a workload."""
+
+    def __init__(self, config: ExperimentConfig, mesh: Mesh):
+        self.config = config
+        self.mesh = mesh
+        self.task = task_for_model(config.model.name)
+        self.shard_map_mode = config.train.spmd_mode == "shard_map"
+        # BN axis name: only meaningful under shard_map (under jit, stats
+        # are global automatically; see models/layers.py docstring).
+        bn_axis = None
+        if self.shard_map_mode and config.model.bn_cross_replica:
+            bn_axis = DATA_AXES
+        self.model = get_model(config.model, bn_axis_name=bn_axis)
+        self.tx, self.schedule = make_optimizer(
+            config.optimizer, config.train.total_steps
+        )
+        self._state_specs = None
+
+    # ------------------------------------------------------------- init --
+    def _create_state(self, seed_arr: jax.Array, batch: Any) -> TrainState:
+        root = jax.random.key(seed_arr[0])
+        init_rng = prng.for_role(root, prng.ROLE_INIT)
+        dropout_root = prng.for_role(root, prng.ROLE_DROPOUT)
+        inputs = model_inputs(self.task, batch)
+        variables = self.model.init(
+            {"params": init_rng, "dropout": dropout_root}, *inputs, train=False
+        )
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        return TrainState.create(
+            params=params, batch_stats=batch_stats, tx=self.tx, rng=dropout_root
+        )
+
+    def state_specs(self, sample_batch: Any) -> Any:
+        if self._state_specs is None:
+            seed = jnp.zeros((1,), jnp.uint32)
+            shapes = jax.eval_shape(self._create_state, seed, sample_batch)
+            if self.shard_map_mode:
+                # The explicit-collective path is pure DP (reference
+                # semantics): params fully replicated. FSDP/TP layouts are
+                # the jit path's job.
+                self._state_specs = jax.tree.map(lambda _: P(), shapes)
+            else:
+                self._state_specs = shd.infer_param_specs(shapes, self.mesh)
+        return self._state_specs
+
+    def init_state(self, seed: int, sample_batch: Any) -> TrainState:
+        """Create the sharded TrainState directly on the mesh (params are
+        materialized device-side with their final shardings — no host
+        round-trip)."""
+        specs = self.state_specs(sample_batch)
+        out_sh = shd.specs_to_shardings(specs, self.mesh)
+        create = jax.jit(self._create_state, out_shardings=out_sh)
+        seed_arr = jnp.asarray([seed], jnp.uint32)
+        return create(seed_arr, sample_batch)
+
+    # ------------------------------------------------------- train step --
+    def _has_bn(self, state: TrainState) -> bool:
+        return bool(jax.tree.leaves(state.batch_stats))
+
+    def _loss_and_updates(self, state: TrainState, batch: Any):
+        """Shared fwd/bwd/update body (identical in both SPMD modes)."""
+        step_rng = prng.fold_in_step(state.rng, state.step)
+        has_bn = self._has_bn(state)
+        inputs = model_inputs(self.task, batch)
+
+        def loss_fn(params):
+            variables = {"params": params}
+            if has_bn:
+                variables["batch_stats"] = state.batch_stats
+            out = self.model.apply(
+                variables,
+                *inputs,
+                train=True,
+                mutable=["batch_stats"] if has_bn else False,
+                rngs={"dropout": step_rng},
+            )
+            if has_bn:
+                logits, new_model_state = out
+            else:
+                logits, new_model_state = out, {}
+            if self.task == "mlm":
+                loss, metrics = losses.mlm_loss(logits, batch["targets"])
+            else:
+                loss, metrics = losses.classification_loss(
+                    logits,
+                    batch["label"],
+                    label_smoothing=self.config.train.label_smoothing,
+                )
+            return loss, (metrics, new_model_state)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (_, (metrics, new_model_state)), grads = grad_fn(state.params)
+        return grads, metrics, new_model_state
+
+    def _apply_updates(self, state, grads, metrics, new_model_state):
+        updates, new_opt_state = self.tx.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = coll.global_norm(grads)
+        metrics["learning_rate"] = self.schedule(state.step)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            batch_stats=new_model_state.get("batch_stats", state.batch_stats),
+        )
+        return new_state, metrics
+
+    def _train_step_jit(self, state: TrainState, batch: Any):
+        grads, metrics, new_model_state = self._loss_and_updates(state, batch)
+        # Loss is a global-batch mean → grads already carry the
+        # cross-replica-sum; no explicit collective needed.
+        return self._apply_updates(state, grads, metrics, new_model_state)
+
+    def _train_step_replica(self, state: TrainState, batch: Any):
+        grads, metrics, new_model_state = self._loss_and_updates(state, batch)
+        # Explicit sync-DP: mean grads across replicas — the NCCL all-reduce
+        # site of the reference (SURVEY.md §2 row 3).
+        grads = coll.allreduce_gradients(grads, DATA_AXES)
+        metrics = coll.pmean(metrics, DATA_AXES)
+        if self._has_bn(state):
+            # Running stats were updated from per/cross-replica batch stats;
+            # average them so replicas stay consistent.
+            new_model_state = dict(new_model_state)
+            new_model_state["batch_stats"] = coll.pmean(
+                new_model_state["batch_stats"], DATA_AXES
+            )
+        return self._apply_updates(state, grads, metrics, new_model_state)
+
+    def make_train_step(self, sample_batch: Any) -> Callable:
+        specs = self.state_specs(sample_batch)
+        state_sh = shd.specs_to_shardings(specs, self.mesh)
+        batch_sh = jax.tree.map(
+            lambda _: NamedSharding(self.mesh, batch_spec(self.mesh)), sample_batch
+        )
+        if not self.shard_map_mode:
+            return jax.jit(
+                self._train_step_jit,
+                in_shardings=(state_sh, batch_sh),
+                out_shardings=(state_sh, None),
+                donate_argnums=(0,),
+            )
+
+        state_P = specs
+        batch_P = jax.tree.map(lambda _: batch_spec(self.mesh), sample_batch)
+        # check_vma=False: with vma tracking on, jax's autodiff inserts the
+        # cross-replica psum for replicated params itself and our explicit
+        # pmean would double-count. The explicit-collective mode exists to
+        # mirror the reference's SyncReplicasOptimizer pipeline, so we keep
+        # the collectives visible and own them.
+        mapped = jax.shard_map(
+            self._train_step_replica,
+            mesh=self.mesh,
+            in_specs=(state_P, batch_P),
+            out_specs=(state_P, P()),
+            check_vma=False,
+        )
+        return jax.jit(
+            mapped,
+            in_shardings=(state_sh, batch_sh),
+            out_shardings=(state_sh, None),
+            donate_argnums=(0,),
+        )
+
+    # -------------------------------------------------------- eval step --
+    def _eval_step(self, state: TrainState, batch: Any):
+        has_bn = self._has_bn(state)
+        variables = {"params": state.params}
+        if has_bn:
+            variables["batch_stats"] = state.batch_stats
+        inputs = model_inputs(self.task, batch)
+        logits = self.model.apply(variables, *inputs, train=False)
+        if self.task == "mlm":
+            _, metrics = losses.mlm_loss(logits, batch["targets"])
+        else:
+            _, metrics = losses.classification_loss(logits, batch["label"])
+        return metrics
+
+    def make_eval_step(self, sample_batch: Any) -> Callable:
+        specs = self.state_specs(sample_batch)
+        state_sh = shd.specs_to_shardings(specs, self.mesh)
+        batch_sh = jax.tree.map(
+            lambda _: NamedSharding(self.mesh, batch_spec(self.mesh)), sample_batch
+        )
+        return jax.jit(
+            self._eval_step, in_shardings=(state_sh, batch_sh), out_shardings=None
+        )

@@ -1,0 +1,33 @@
+"""Test harness: 8 virtual CPU devices.
+
+SURVEY.md §4 "Multi-replica without hardware": the TPU analogue of the
+reference's fake-cluster-on-localhost trick is
+``--xla_force_host_platform_device_count=8`` — real psum/shard_map/pjit
+semantics, no TPU required. Env vars MUST be set before jax initializes,
+hence this module-level block.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The environment's TPU-tunnel sitecustomize force-sets
+# jax_platforms="axon,cpu" via jax.config at interpreter start, which beats
+# the env var; override it back to CPU-only before any backend initializes
+# so tests never occupy the real chip.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,48 @@
+"""Config system tests (SURVEY.md §2 row 11 replacement)."""
+
+import pytest
+
+from distributed_tensorflow_framework_tpu.core.config import (
+    ExperimentConfig,
+    load_config,
+)
+
+
+def test_default_config():
+    cfg = load_config()
+    assert isinstance(cfg, ExperimentConfig)
+    assert cfg.model.name == "lenet5"
+    assert cfg.mesh.data == -1
+
+
+def test_yaml_and_overrides(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text(
+        """
+name: lenet-mnist
+model:
+  name: lenet5
+  num_classes: 10
+data:
+  name: mnist
+  global_batch_size: 128
+optimizer:
+  name: sgd_momentum
+  learning_rate: 0.01
+train:
+  total_steps: 500
+"""
+    )
+    cfg = load_config(p, overrides=["train.total_steps=7", "optimizer.learning_rate=0.5", "mesh.data=4", "mesh.fsdp=2"])
+    assert cfg.name == "lenet-mnist"
+    assert cfg.train.total_steps == 7
+    assert cfg.optimizer.learning_rate == 0.5
+    assert cfg.mesh.data == 4 and cfg.mesh.fsdp == 2
+    assert cfg.data.global_batch_size == 128
+
+
+def test_unknown_key_rejected(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("modell: {name: lenet5}\n")
+    with pytest.raises(ValueError, match="Unknown key"):
+        load_config(p)

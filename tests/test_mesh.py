@@ -1,0 +1,40 @@
+"""Mesh construction tests (SURVEY.md §2 rows 1–2 replacement)."""
+
+import pytest
+
+from distributed_tensorflow_framework_tpu.core.config import MeshConfig
+from distributed_tensorflow_framework_tpu.core.mesh import (
+    batch_sharding,
+    create_mesh,
+    initialize_runtime,
+)
+
+
+def test_default_mesh_uses_all_devices(devices):
+    mesh = create_mesh()
+    assert mesh.devices.size == 8
+    assert dict(mesh.shape) == {"data": 8, "fsdp": 1, "seq": 1, "model": 1}
+
+
+def test_explicit_axes(devices):
+    mesh = create_mesh(MeshConfig(data=2, fsdp=2, model=2, seq=1))
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "seq": 1, "model": 2}
+
+
+def test_free_axis_inference(devices):
+    mesh = create_mesh(MeshConfig(data=-1, model=2))
+    assert mesh.shape["data"] == 4
+
+
+def test_bad_shape_raises(devices):
+    with pytest.raises(ValueError):
+        create_mesh(MeshConfig(data=3, model=2))  # 6 != 8
+
+
+def test_runtime(devices):
+    rt = initialize_runtime(MeshConfig(data=8))
+    assert rt.is_chief
+    assert rt.global_device_count == 8
+    assert rt.data_parallel_size == 8
+    sh = batch_sharding(rt.mesh)
+    assert sh.spec == sh.spec  # constructible

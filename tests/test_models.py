@@ -1,0 +1,64 @@
+"""Model golden tests: output shapes/dtypes + parameter counts
+(SURVEY.md §4 "Unit" row). Golden param counts pin the topologies to their
+canonical definitions (ResNet-50 = 25.56M params at 1000 classes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_framework_tpu.core.config import ModelConfig
+from distributed_tensorflow_framework_tpu.models import get_model
+
+
+def param_count(params) -> int:
+    return sum(np.prod(p.shape) for p in jax.tree.leaves(params))
+
+
+def init_model(config: ModelConfig, input_shape, input_dtype=jnp.float32):
+    model = get_model(config)
+    rng = jax.random.key(0)
+    if config.name == "bert":
+        x = jnp.ones(input_shape, jnp.int32)
+    else:
+        x = jnp.ones(input_shape, input_dtype)
+    variables = jax.eval_shape(
+        lambda: model.init({"params": rng, "dropout": rng}, x, train=False)
+    )
+    return model, variables
+
+
+def test_lenet_shapes_and_params():
+    cfg = ModelConfig(name="lenet5", num_classes=10, dtype="float32")
+    model = get_model(cfg)
+    rng = jax.random.key(0)
+    x = jnp.ones((2, 28, 28, 1))
+    variables = model.init({"params": rng}, x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    # conv1 6*(5*5*1+1)=156; conv2 16*(5*5*6+1)=2416; fc 400*120+120,
+    # 120*84+84, 84*10+10 → 61706 total (classic LeNet-5 with 28x28 input).
+    assert param_count(variables["params"]) == 61706
+
+
+def test_resnet50_param_count():
+    cfg = ModelConfig(name="resnet50", num_classes=1000, dtype="bfloat16")
+    model, variables = init_model(cfg, (1, 224, 224, 3))
+    # Canonical ResNet-50: 25.557M params (incl. BN scale/bias).
+    count = param_count(variables["params"])
+    assert count == 25_557_032, f"got {count}"
+
+
+def test_resnet50_forward_shape_dtype(devices):
+    cfg = ModelConfig(name="resnet50_cifar", num_classes=10, dtype="bfloat16")
+    model = get_model(cfg)
+    rng = jax.random.key(0)
+    x = jnp.ones((4, 32, 32, 3), jnp.float32)
+    variables = model.init({"params": rng}, x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32  # classifier head promotes to fp32
+    assert "batch_stats" in variables  # BN present
+    # bf16 compute path: stem conv kernel stays fp32 (param_dtype)
+    assert variables["params"]["stem"]["conv"]["kernel"].dtype == jnp.float32

@@ -1,0 +1,33 @@
+"""LR schedule tests."""
+
+import numpy as np
+
+from distributed_tensorflow_framework_tpu.core.config import OptimizerConfig
+from distributed_tensorflow_framework_tpu.train.schedules import make_schedule
+
+
+def test_warmup_then_staircase_boundaries_absolute():
+    cfg = OptimizerConfig(
+        name="sgd_momentum",
+        learning_rate=1.0,
+        warmup_steps=100,
+        schedule="staircase",
+        boundaries=[200, 300],
+        decay_factor=0.1,
+    )
+    sched = make_schedule(cfg, total_steps=400)
+    np.testing.assert_allclose(float(sched(0)), 0.0)
+    np.testing.assert_allclose(float(sched(50)), 0.5)
+    np.testing.assert_allclose(float(sched(100)), 1.0)
+    # Boundaries are absolute global steps: first drop AT step 200.
+    np.testing.assert_allclose(float(sched(199)), 1.0)
+    np.testing.assert_allclose(float(sched(201)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(301)), 0.01, rtol=1e-6)
+
+
+def test_cosine_with_warmup():
+    cfg = OptimizerConfig(learning_rate=2.0, warmup_steps=10, schedule="cosine")
+    sched = make_schedule(cfg, total_steps=110)
+    np.testing.assert_allclose(float(sched(10)), 2.0)
+    assert float(sched(60)) < 2.0
+    np.testing.assert_allclose(float(sched(110)), 0.0, atol=1e-6)

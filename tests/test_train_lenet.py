@@ -1,0 +1,69 @@
+"""End-to-end minimum slice: LeNet on synthetic data, 8 virtual replicas.
+
+BASELINE.json config 1 ("LeNet-5 on MNIST, single worker, CPU-runnable
+smoke test") generalized to 8 fake replicas — exercises mesh, infeed,
+jitted step, collectives, hooks and the loop with zero TPU dependency
+(SURVEY.md §7 "minimum end-to-end slice").
+"""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_framework_tpu.core.config import load_config
+from distributed_tensorflow_framework_tpu.train import Trainer
+
+
+def lenet_config(**overrides):
+    base = {
+        "name": "lenet-synthetic",
+        "model": {"name": "lenet5", "num_classes": 10, "dtype": "float32"},
+        "data": {
+            "name": "synthetic_images",
+            "global_batch_size": 64,
+            "image_size": 28,
+            "channels": 1,
+        },
+        "optimizer": {"name": "sgd_momentum", "learning_rate": 0.05},
+        "train": {"total_steps": 30, "log_interval": 10, "seed": 0},
+    }
+    cfg = load_config(base=base)
+    for k, v in overrides.items():
+        parts = k.split(".")
+        obj = cfg
+        for p in parts[:-1]:
+            obj = getattr(obj, p)
+        setattr(obj, parts[-1], v)
+    return cfg
+
+
+@pytest.mark.parametrize("spmd_mode", ["jit", "shard_map"])
+def test_lenet_loss_decreases(devices, spmd_mode, tmp_path):
+    cfg = lenet_config(**{"train.spmd_mode": spmd_mode})
+    trainer = Trainer(cfg)
+    trainer.build()
+    first = trainer.evaluate(num_batches=4)
+    metrics = trainer.train()
+    final = trainer.evaluate(num_batches=4)
+    assert np.isfinite(metrics["loss"])
+    assert final["eval_loss"] < first["eval_loss"], (
+        f"loss did not drop: {first} -> {final}"
+    )
+
+
+def test_jit_and_shard_map_agree(devices):
+    """Sync-DP invariant (SURVEY.md §4 numerics parity): the explicit
+    shard_map pipeline and the implicit jit pipeline produce the same
+    parameters for a BN-free model."""
+    import jax
+
+    results = {}
+    for mode in ["jit", "shard_map"]:
+        cfg = lenet_config(**{"train.spmd_mode": mode, "train.total_steps": 5})
+        t = Trainer(cfg)
+        t.train()
+        results[mode] = jax.device_get(t.state.params)
+
+    flat_a = jax.tree.leaves(results["jit"])
+    flat_b = jax.tree.leaves(results["shard_map"])
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)

@@ -1,0 +1,12 @@
+#!/usr/bin/env python
+"""Root entrypoint — mirrors the reference's top-level train.py.
+
+See distributed_tensorflow_framework_tpu/cli/train.py for flags.
+"""
+
+import sys
+
+from distributed_tensorflow_framework_tpu.cli.train import main
+
+if __name__ == "__main__":
+    sys.exit(main())
