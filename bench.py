@@ -18,7 +18,7 @@ import time
 TARGET_PER_CHIP = 10_000 / 64  # BASELINE.json north star on v5e-64
 
 
-def bench_resnet50(batch_size: int, steps: int = 10, warmup: int = 3) -> float:
+def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3) -> float:
     import jax
     import numpy as np
 
@@ -56,13 +56,21 @@ def bench_resnet50(batch_size: int, steps: int = 10, warmup: int = 3) -> float:
     state = builder.init_state(0, batch)
     step = builder.make_train_step(batch)
 
+    # NOTE: sync via device_get of a VALUE, not block_until_ready — the
+    # latter returns early through the axon remote-execution tunnel and
+    # inflates throughput ~10x. Fetch a param leaf so the barrier includes
+    # the final step's optimizer update, not just its forward pass.
+    def sync(s):
+        leaf = jax.tree.leaves(s.params)[0]
+        jax.device_get(leaf)
+
     for _ in range(warmup):
         state, metrics = step(state, batch)
-    jax.block_until_ready(state.params)
+    sync(state)
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, batch)
-    jax.block_until_ready(state.params)
+    sync(state)
     dt = time.perf_counter() - t0
     return batch_size * steps / dt
 

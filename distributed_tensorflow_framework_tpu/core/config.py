@@ -145,12 +145,14 @@ class DataConfig:
     global_batch_size: int = 64
     image_size: int = 28
     channels: int = 1
+    num_classes: int = 10  # label range (synthetic data / sanity checks)
     shuffle_buffer: int = 10_000
     prefetch: int = 2
     seed: int = 0
     # text / MLM
     seq_len: int = 128
     mask_prob: float = 0.15
+    vocab_size: int = 30522  # must match ModelConfig.vocab_size
     # native C++ record reader (ops/native) when available
     use_native_reader: bool = False
 
@@ -177,6 +179,10 @@ class TrainConfig:
     spmd_mode: str = "jit"
     nan_guard: bool = True
     label_smoothing: float = 0.0
+    # XPlane trace capture over steps [profile_start, profile_stop);
+    # 0/0 disables (SURVEY.md §5 tracing).
+    profile_start: int = 0
+    profile_stop: int = 0
 
 
 @config_dataclass

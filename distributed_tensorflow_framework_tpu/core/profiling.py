@@ -1,0 +1,68 @@
+"""Tracing / profiling (SURVEY.md §5 "Tracing / profiling").
+
+The reference's option here is TF1 ``tf.RunMetadata`` + timeline JSON /
+``tf.profiler``; the TPU-native equivalents are XPlane traces viewable in
+TensorBoard/Perfetto plus lightweight step annotations:
+
+  * ``trace(logdir)``       — context manager around a window of steps
+                              (``jax.profiler.start_trace``/``stop_trace``)
+  * ``annotate(name)``      — named region inside a traced window
+                              (``jax.profiler.TraceAnnotation``)
+  * ``StepTimer``           — host-side per-phase wall timing that works
+                              without any trace infrastructure (printed by
+                              the metric writer)
+
+The Trainer exposes ``--set train.profile_steps=[start,stop]`` via
+ProfileHook in train/hooks.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture an XPlane trace for everything inside the block."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region visible in the trace viewer."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Accumulates host-side wall time per named phase."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def means(self) -> dict[str, float]:
+        return {
+            f"time_{k}_ms": 1000.0 * v / max(self.counts[k], 1)
+            for k, v in self.totals.items()
+        }
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()

@@ -31,7 +31,7 @@ def synthetic_images(
     b = _host_batch(config, process_count)
     h = w = config.image_size
     c = config.channels
-    num_classes = 10
+    num_classes = config.num_classes
 
     def make_iter(state: dict[str, Any]):
         state.setdefault("step", 0)
@@ -39,11 +39,12 @@ def synthetic_images(
         while True:
             rng = np.random.default_rng(seed_base + state["step"])
             images = rng.standard_normal((b, h, w, c), dtype=np.float32)
-            # Label = sign pattern of per-image mean: learnable mapping.
-            labels = (
-                (images.mean(axis=(1, 2, 3)) * 37.0).astype(np.int64) % num_classes
+            # Label = argmax over the first num_classes pixels: uniform over
+            # classes, perfectly learnable, and stable at any image size
+            # (a per-image-mean hash degenerates by CLT as size grows).
+            labels = np.argmax(
+                images.reshape(b, -1)[:, :num_classes], axis=1
             ).astype(np.int32)
-            labels = np.abs(labels)
             state["step"] += 1
             yield {"image": images, "label": labels}
 
@@ -62,7 +63,8 @@ def synthetic_mlm(
 ) -> HostDataset:
     b = _host_batch(config, process_count)
     s = config.seq_len
-    vocab = 30522
+    vocab = config.vocab_size
+    lo = min(1000, vocab // 2)  # keep low ids free for specials
 
     def make_iter(state: dict[str, Any]):
         state.setdefault("step", 0)
@@ -70,7 +72,7 @@ def synthetic_mlm(
         mask_id = 103  # BERT [MASK]
         while True:
             rng = np.random.default_rng(seed_base + state["step"])
-            tokens = rng.integers(1000, vocab, size=(b, s), dtype=np.int64).astype(np.int32)
+            tokens = rng.integers(lo, vocab, size=(b, s), dtype=np.int64).astype(np.int32)
             mask = rng.random((b, s)) < config.mask_prob
             mask[:, 0] = False
             input_ids = np.where(mask, mask_id, tokens)

@@ -28,9 +28,11 @@ VOCAB = 30522
 
 
 def apply_mlm_mask(tokens: np.ndarray, rng: np.random.Generator,
-                   mask_prob: float) -> tuple[np.ndarray, np.ndarray]:
+                   mask_prob: float, vocab_size: int = VOCAB
+                   ) -> tuple[np.ndarray, np.ndarray]:
     """BERT dynamic masking. tokens: (b, s) int32. Returns (inputs, targets);
-    targets are -1 at unmasked positions."""
+    targets are -1 at unmasked positions. Random-replacement tokens are
+    drawn from [lo, vocab_size) so they stay inside the embedding table."""
     special = (tokens == CLS_ID) | (tokens == SEP_ID) | (tokens == 0)
     candidates = ~special
     sel = (rng.random(tokens.shape) < mask_prob) & candidates
@@ -38,7 +40,8 @@ def apply_mlm_mask(tokens: np.ndarray, rng: np.random.Generator,
     inputs = tokens.copy()
     inputs[sel & (action < 0.8)] = MASK_ID
     rand_sel = sel & (action >= 0.8) & (action < 0.9)
-    inputs[rand_sel] = rng.integers(1000, VOCAB, size=int(rand_sel.sum()))
+    lo = min(1000, vocab_size // 2)
+    inputs[rand_sel] = rng.integers(lo, vocab_size, size=int(rand_sel.sum()))
     targets = np.where(sel, tokens, -1).astype(np.int32)
     return inputs, targets
 
@@ -53,6 +56,9 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
         log.warning("MLM TFRecords not found under %r — synthetic fallback",
                     config.data_dir)
         return synthetic.synthetic_mlm(config, process_index, process_count)
+
+    if config.use_native_reader:
+        return _make_mlm_native(config, files, process_index, process_count)
 
     import tensorflow as tf
 
@@ -94,7 +100,9 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
             rng = np.random.default_rng(
                 (config.seed, state["inner"].get("batches", 0), process_index)
             )
-            inputs, targets = apply_mlm_mask(batch["tokens"], rng, config.mask_prob)
+            inputs, targets = apply_mlm_mask(batch["tokens"], rng,
+                                             config.mask_prob,
+                                             config.vocab_size)
             yield {
                 "input_ids": inputs,
                 "targets": targets,
@@ -109,4 +117,64 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
             "attention_mask": ((b, s), np.int32),
         },
         initial_state={"inner": base.state()},
+    )
+
+
+def _make_mlm_native(config: DataConfig, files: list[str],
+                     process_index: int, process_count: int) -> HostDataset:
+    """MLM pipeline on the C++ record reader (data/native_reader.py).
+
+    The reader decodes TFRecord framing and parses the fixed-schema
+    Example in native threads; Python only applies the dynamic mask. Record
+    order is file order (deterministic), so resume = skip N batches within
+    the epoch.
+    """
+    from distributed_tensorflow_framework_tpu.data.native_reader import (
+        NativeRecordReader,
+    )
+
+    b = host_batch_size(config.global_batch_size, process_count)
+    s = config.seq_len
+    shard = files[process_index::process_count] or files[:1]
+
+    def make_iter(state):
+        state.setdefault("epoch", 0)
+        state.setdefault("batch_in_epoch", 0)
+        state.setdefault("total_batches", 0)
+        while True:
+            reader = NativeRecordReader(shard)
+            it = reader.batches_i32("input_ids", b, s)
+            skip = state["batch_in_epoch"]
+            for i, tokens in enumerate(it):
+                if i < skip:
+                    continue
+                rng = np.random.default_rng(
+                    (config.seed, state["epoch"], i, process_index)
+                )
+                inputs, targets = apply_mlm_mask(tokens, rng, config.mask_prob,
+                                                 config.vocab_size)
+                state["batch_in_epoch"] = i + 1
+                state["total_batches"] += 1
+                yield {
+                    "input_ids": inputs,
+                    "targets": targets,
+                    "attention_mask": (tokens != 0).astype(np.int32),
+                }
+            reader.close()
+            if state["batch_in_epoch"] == 0 and skip == 0:
+                raise RuntimeError(
+                    f"native MLM shard {shard!r} yielded no full batch of "
+                    f"{b} records — shard too small for this process count"
+                )
+            state["epoch"] += 1
+            state["batch_in_epoch"] = 0
+
+    return HostDataset(
+        make_iter,
+        element_spec={
+            "input_ids": ((b, s), np.int32),
+            "targets": ((b, s), np.int32),
+            "attention_mask": ((b, s), np.int32),
+        },
+        initial_state={"epoch": 0, "batch_in_epoch": 0, "total_batches": 0},
     )

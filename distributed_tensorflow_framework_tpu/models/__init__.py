@@ -16,12 +16,14 @@ from typing import Any
 from distributed_tensorflow_framework_tpu.core.config import ModelConfig
 
 
-def get_model(config: ModelConfig, *, bn_axis_name=None) -> Any:
+def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
     """Build a Flax module from a ModelConfig (name-based dispatch).
 
     ``bn_axis_name`` is only set when the caller will run the model inside
     shard_map and wants cross-replica BN statistics (see
-    models/layers.py docstring); under jit it must stay None.
+    models/layers.py docstring); under jit it must stay None. ``mesh`` is
+    required only for BERT with ``attention_impl="ring"`` (sequence-parallel
+    attention needs the physical mesh for its nested shard_map).
     """
     import jax.numpy as jnp
 
@@ -67,5 +69,6 @@ def get_model(config: ModelConfig, *, bn_axis_name=None) -> Any:
             max_seq_len=config.max_seq_len,
             dtype=dtype,
             attention_impl=config.attention_impl,
+            mesh=mesh,
         )
     raise ValueError(f"Unknown model {config.name!r}")

@@ -1,0 +1,152 @@
+"""BERT-base for masked-LM pretraining (BASELINE.json config 5).
+
+Transformer encoder exercising the MXU (attention + MLP matmuls) and the
+Adam all-reduce path. Post-LayerNorm BERT topology: token+position
+embeddings → N×(MHA → add&norm → MLP → add&norm) → tied-embedding MLM head.
+
+Parallelism hooks:
+  * Parameter names are chosen to match ``parallel/sharding.py``'s TP
+    rules: ``query/key/value`` (column-parallel), ``attn_out``
+    (row-parallel), ``mlp_in``/``mlp_out``, ``embed/embedding`` — setting
+    mesh axis ``model>1`` shards the transformer megatron-style with no
+    model changes.
+  * ``attention_impl``: "xla" (jnp einsum attention, XLA-fused),
+    "pallas" (ops/flash_attention.py fused online-softmax kernel),
+    "ring" (parallel/ring.py sequence-parallel ring attention over the
+    ``seq`` mesh axis, for long-context).
+
+Param count pinned by test: 109.5M (BERT-base, tied MLM head).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_framework_tpu.models.layers import dense_kernel_init
+
+
+def dot_product_attention(q, k, v, *, mask=None, dtype=jnp.float32):
+    """Reference XLA attention. q,k,v: (B, S, H, D); mask: (B, 1, 1, S)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "xla"
+    mesh: Any = None  # required for attention_impl="ring"
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        b, s, h = x.shape
+        head_dim = h // self.num_heads
+        dense = lambda name: nn.Dense(  # noqa: E731
+            h, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=dense_kernel_init, name=name,
+        )
+        q = dense("query")(x).reshape(b, s, self.num_heads, head_dim)
+        k = dense("key")(x).reshape(b, s, self.num_heads, head_dim)
+        v = dense("value")(x).reshape(b, s, self.num_heads, head_dim)
+
+        if self.attention_impl == "pallas":
+            from distributed_tensorflow_framework_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+
+            out = flash_attention(q, k, v, mask=mask)
+        elif self.attention_impl == "ring":
+            from distributed_tensorflow_framework_tpu.parallel.ring import (
+                ring_attention_sharded,
+            )
+
+            out = ring_attention_sharded(q, k, v, mesh=self.mesh, mask=mask)
+        else:
+            out = dot_product_attention(q, k, v, mask=mask, dtype=self.dtype)
+        out = out.reshape(b, s, h)
+        return nn.Dense(h, dtype=self.dtype, param_dtype=jnp.float32,
+                        kernel_init=dense_kernel_init, name="attn_out")(out)
+
+
+class EncoderLayer(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "xla"
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, mask=None, *, train: bool = True):
+        attn = MultiHeadAttention(
+            self.num_heads, dtype=self.dtype,
+            attention_impl=self.attention_impl, mesh=self.mesh, name="attn",
+        )(x, mask)
+        attn = nn.Dropout(self.dropout_rate, deterministic=not train)(attn)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x + attn)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     kernel_init=dense_kernel_init, name="mlp_in")(x)
+        y = nn.gelu(y, approximate=True)
+        y = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=jnp.float32,
+                     kernel_init=dense_kernel_init, name="mlp_out")(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln2")(x + y)
+
+
+class BertForMLM(nn.Module):
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_seq_len: int = 512
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "xla"
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, *, train: bool = True):
+        b, s = input_ids.shape
+        embed = nn.Embed(self.vocab_size, self.hidden_size,
+                         param_dtype=jnp.float32, dtype=self.dtype,
+                         embedding_init=nn.initializers.normal(0.02),
+                         name="embed")
+        x = embed(input_ids)
+        pos = self.param(
+            "pos_embedding", nn.initializers.normal(0.02),
+            (self.max_seq_len, self.hidden_size), jnp.float32,
+        )
+        x = x + pos[None, :s, :].astype(self.dtype)
+        x = nn.LayerNorm(dtype=jnp.float32, name="embed_ln")(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = x.astype(self.dtype)
+
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        for i in range(self.num_layers):
+            x = EncoderLayer(
+                self.num_heads, self.mlp_dim, self.dropout_rate,
+                dtype=self.dtype, attention_impl=self.attention_impl,
+                mesh=self.mesh, name=f"layer{i}",
+            )(x, mask, train=train)
+
+        # MLM head: dense → gelu → LN → tied-embedding projection + bias.
+        x = nn.Dense(self.hidden_size, dtype=self.dtype,
+                     param_dtype=jnp.float32, kernel_init=dense_kernel_init,
+                     name="mlm_transform")(x)
+        x = nn.gelu(x, approximate=True)
+        x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
+        logits = embed.attend(x.astype(jnp.float32))
+        bias = self.param("mlm_bias", nn.initializers.zeros,
+                          (self.vocab_size,), jnp.float32)
+        return logits + bias

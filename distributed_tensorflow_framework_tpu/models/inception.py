@@ -1,0 +1,208 @@
+"""Inception-v3 — the reference's async-PS workload (SURVEY.md §2 row 8).
+
+Canonical Inception-v3 topology (299×299 input): stem of 3×3 convs →
+3× InceptionA (35×35) → ReductionA → 4× InceptionB (17×17) → ReductionB →
+2× InceptionC (8×8) → global pool → dense(classes), with an optional
+auxiliary classifier off the last 17×17 block. All branches are ConvBN
+units, so the same cross-replica-BN switch as ResNet applies.
+
+In the reference this model runs ASYNC parameter-server training; per
+BASELINE.json's north star the capability maps to synchronous TPU replicas
+(SURVEY.md §2 row 4 + §7 hard part 4) — nothing in the model itself changes,
+only the optimizer semantics (see configs/inception_v3.yaml).
+
+Param count pinned by test: 23.83M (1000 classes, with aux head).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_framework_tpu.models.layers import ConvBN, dense_kernel_init
+
+
+class _C(nn.Module):
+    """ConvBN shorthand with Inception's 'same/valid' conventions."""
+
+    features: int
+    kernel: tuple[int, int]
+    strides: tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    train: bool = True
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        return ConvBN(
+            self.features, self.kernel, strides=self.strides,
+            padding=self.padding, train=self.train, dtype=self.dtype,
+            bn_axis_name=self.bn_axis_name, name="convbn",
+        )(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    train: bool = True
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        kw = dict(train=self.train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        b1 = _C(64, (1, 1), **kw, name="b1x1")(x)
+        b2 = _C(48, (1, 1), **kw, name="b5x5_1")(x)
+        b2 = _C(64, (5, 5), **kw, name="b5x5_2")(b2)
+        b3 = _C(64, (1, 1), **kw, name="b3x3dbl_1")(x)
+        b3 = _C(96, (3, 3), **kw, name="b3x3dbl_2")(b3)
+        b3 = _C(96, (3, 3), **kw, name="b3x3dbl_3")(b3)
+        b4 = _C(self.pool_features, (1, 1), **kw, name="bpool")(_avg_pool_same(x))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionA(nn.Module):
+    train: bool = True
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        kw = dict(train=self.train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        b1 = _C(384, (3, 3), strides=(2, 2), padding="VALID", **kw, name="b3x3")(x)
+        b2 = _C(64, (1, 1), **kw, name="b3x3dbl_1")(x)
+        b2 = _C(96, (3, 3), **kw, name="b3x3dbl_2")(b2)
+        b2 = _C(96, (3, 3), strides=(2, 2), padding="VALID", **kw, name="b3x3dbl_3")(b2)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionB(nn.Module):
+    channels_7x7: int
+    train: bool = True
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        kw = dict(train=self.train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        c = self.channels_7x7
+        b1 = _C(192, (1, 1), **kw, name="b1x1")(x)
+        b2 = _C(c, (1, 1), **kw, name="b7x7_1")(x)
+        b2 = _C(c, (1, 7), **kw, name="b7x7_2")(b2)
+        b2 = _C(192, (7, 1), **kw, name="b7x7_3")(b2)
+        b3 = _C(c, (1, 1), **kw, name="b7x7dbl_1")(x)
+        b3 = _C(c, (7, 1), **kw, name="b7x7dbl_2")(b3)
+        b3 = _C(c, (1, 7), **kw, name="b7x7dbl_3")(b3)
+        b3 = _C(c, (7, 1), **kw, name="b7x7dbl_4")(b3)
+        b3 = _C(192, (1, 7), **kw, name="b7x7dbl_5")(b3)
+        b4 = _C(192, (1, 1), **kw, name="bpool")(_avg_pool_same(x))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionB(nn.Module):
+    train: bool = True
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        kw = dict(train=self.train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        b1 = _C(192, (1, 1), **kw, name="b3x3_1")(x)
+        b1 = _C(320, (3, 3), strides=(2, 2), padding="VALID", **kw, name="b3x3_2")(b1)
+        b2 = _C(192, (1, 1), **kw, name="b7x7x3_1")(x)
+        b2 = _C(192, (1, 7), **kw, name="b7x7x3_2")(b2)
+        b2 = _C(192, (7, 1), **kw, name="b7x7x3_3")(b2)
+        b2 = _C(192, (3, 3), strides=(2, 2), padding="VALID", **kw, name="b7x7x3_4")(b2)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    train: bool = True
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        kw = dict(train=self.train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        b1 = _C(320, (1, 1), **kw, name="b1x1")(x)
+        b2 = _C(384, (1, 1), **kw, name="b3x3_1")(x)
+        b2a = _C(384, (1, 3), **kw, name="b3x3_2a")(b2)
+        b2b = _C(384, (3, 1), **kw, name="b3x3_2b")(b2)
+        b3 = _C(448, (1, 1), **kw, name="b3x3dbl_1")(x)
+        b3 = _C(384, (3, 3), **kw, name="b3x3dbl_2")(b3)
+        b3a = _C(384, (1, 3), **kw, name="b3x3dbl_3a")(b3)
+        b3b = _C(384, (3, 1), **kw, name="b3x3dbl_3b")(b3)
+        b4 = _C(192, (1, 1), **kw, name="bpool")(_avg_pool_same(x))
+        return jnp.concatenate([b1, b2a, b2b, b3a, b3b, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    aux_head: bool = True
+    dropout_rate: float = 0.2
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: Any = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        kw = dict(train=train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = x.astype(self.dtype)
+        x = _C(32, (3, 3), strides=(2, 2), padding="VALID", **kw, name="stem1")(x)
+        x = _C(32, (3, 3), padding="VALID", **kw, name="stem2")(x)
+        x = _C(64, (3, 3), **kw, name="stem3")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = _C(80, (1, 1), padding="VALID", **kw, name="stem4")(x)
+        x = _C(192, (3, 3), padding="VALID", **kw, name="stem5")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        x = InceptionA(32, **kw, name="mixed1")(x)
+        x = InceptionA(64, **kw, name="mixed2")(x)
+        x = InceptionA(64, **kw, name="mixed3")(x)
+        x = ReductionA(**kw, name="reduce1")(x)
+        x = InceptionB(128, **kw, name="mixed4")(x)
+        x = InceptionB(160, **kw, name="mixed5")(x)
+        x = InceptionB(160, **kw, name="mixed6")(x)
+        x = InceptionB(192, **kw, name="mixed7")(x)
+
+        # Built whenever aux_head is on (params must exist at init regardless
+        # of mode); returned only in train mode — XLA dead-code-eliminates
+        # the branch in eval.
+        aux = None
+        if self.aux_head:
+            # Canonical 299px input: 17×17 map → pool → 5×5 → conv VALID →
+            # 1×1. Smaller debug inputs would produce empty (0×0) maps, so
+            # fall back to SAME at each stage.
+            pool_pad = "VALID" if x.shape[1] >= 5 and x.shape[2] >= 5 else "SAME"
+            a = nn.avg_pool(x, (5, 5), strides=(3, 3), padding=pool_pad)
+            a = _C(128, (1, 1), **kw, name="aux_proj")(a)
+            conv_pad = "VALID" if a.shape[1] >= 5 and a.shape[2] >= 5 else "SAME"
+            a = _C(768, (5, 5), padding=conv_pad, **kw, name="aux_conv")(a)
+            a = jnp.mean(a, axis=(1, 2))
+            aux = nn.Dense(self.num_classes, dtype=jnp.float32,
+                           param_dtype=jnp.float32,
+                           kernel_init=dense_kernel_init,
+                           name="aux_classifier")(a.astype(jnp.float32))
+
+        x = ReductionB(**kw, name="reduce2")(x)
+        x = InceptionC(**kw, name="mixed8")(x)
+        x = InceptionC(**kw, name="mixed9")(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32,
+                          param_dtype=jnp.float32,
+                          kernel_init=dense_kernel_init,
+                          name="classifier")(x.astype(jnp.float32))
+        if aux is not None and train:
+            # Caller folds aux into the loss with the canonical 0.4 weight
+            # (see train/step.py); eval mode never returns aux.
+            return {"logits": logits, "aux_logits": aux}
+        return logits

@@ -1,0 +1,326 @@
+// Native TFRecord reader with background prefetch.
+//
+// The reference leans on the TF C++ runtime for its input path (SURVEY.md
+// §2 row 5 / L0: the repo's Python tf.data graph executes in native
+// threads). This is the equivalent native substrate for this framework:
+// a C++ reader thread pool that decodes the TFRecord framing (length +
+// masked crc32c + payload), optionally parses the fixed-schema
+// tf.train.Example used by the MLM pipeline, and hands whole batches to
+// Python through a lock-free-enough ring buffer — so the Python side does
+// a single memcpy per batch instead of per-record framing work under the
+// GIL.
+//
+// Exposed C ABI (consumed by ctypes in data/native_reader.py):
+//   rr_open(paths, n_paths, prefetch)            -> handle
+//   rr_next_record(h, &buf, &len)                -> 1 ok, 0 EOF, <0 error
+//   rr_free(buf)
+//   rr_next_batch_i32(h, key, out, batch, width) -> 1 ok, 0 EOF, <0 error
+//   rr_close(h)
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 -pthread record_reader.cc
+//        -o librecord_reader.so
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- crc32c --
+// Castagnoli CRC (the TFRecord checksum), software table version.
+uint32_t kCrcTable[256];
+std::once_flag kCrcOnce;
+
+void InitCrcTable() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k)
+      c = (c & 1) ? (0x82f63b78u ^ (c >> 1)) : (c >> 1);
+    kCrcTable[i] = c;
+  }
+}
+
+uint32_t Crc32c(const char* data, size_t n) {
+  std::call_once(kCrcOnce, InitCrcTable);
+  uint32_t c = 0xffffffffu;
+  for (size_t i = 0; i < n; ++i)
+    c = kCrcTable[(c ^ static_cast<uint8_t>(data[i])) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
+
+uint32_t MaskedCrc(const char* data, size_t n) {
+  uint32_t crc = Crc32c(data, n);
+  return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+}
+
+// ------------------------------------------------------------ ring buffer --
+struct Record {
+  std::vector<char> bytes;
+};
+
+struct Reader {
+  std::vector<std::string> paths;
+  size_t prefetch;
+  std::deque<Record> queue;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::thread worker;
+  std::atomic<bool> done{false}, stop{false};
+  std::string error;
+
+  ~Reader() {
+    {
+      // Set stop under the lock: the worker checks the predicate while
+      // holding mu inside cv.wait, so an unlocked store+notify can land
+      // between its predicate check and its sleep (lost wakeup → join
+      // hangs forever).
+      std::lock_guard<std::mutex> lock(mu);
+      stop = true;
+    }
+    cv_push.notify_all();
+    cv_pop.notify_all();
+    if (worker.joinable()) worker.join();
+  }
+};
+
+void ReadLoop(Reader* r) {
+  for (const auto& path : r->paths) {
+    if (r->stop) break;
+    FILE* f = std::fopen(path.c_str(), "rb");
+    if (!f) {
+      std::lock_guard<std::mutex> lock(r->mu);
+      r->error = "open failed: " + path;
+      break;
+    }
+    while (!r->stop) {
+      char header[12];
+      size_t got = std::fread(header, 1, 12, f);
+      if (got == 0) break;  // clean EOF
+      if (got != 12) {
+        std::lock_guard<std::mutex> lock(r->mu);
+        r->error = "truncated header: " + path;
+        break;
+      }
+      uint64_t len;
+      std::memcpy(&len, header, 8);
+      uint32_t len_crc;
+      std::memcpy(&len_crc, header + 8, 4);
+      if (MaskedCrc(header, 8) != len_crc) {
+        std::lock_guard<std::mutex> lock(r->mu);
+        r->error = "length crc mismatch: " + path;
+        break;
+      }
+      Record rec;
+      rec.bytes.resize(len);
+      if (std::fread(rec.bytes.data(), 1, len, f) != len) {
+        std::lock_guard<std::mutex> lock(r->mu);
+        r->error = "truncated payload: " + path;
+        break;
+      }
+      char footer[4];
+      if (std::fread(footer, 1, 4, f) != 4) {
+        std::lock_guard<std::mutex> lock(r->mu);
+        r->error = "truncated footer: " + path;
+        break;
+      }
+      uint32_t data_crc;
+      std::memcpy(&data_crc, footer, 4);
+      if (MaskedCrc(rec.bytes.data(), len) != data_crc) {
+        std::lock_guard<std::mutex> lock(r->mu);
+        r->error = "payload crc mismatch: " + path;
+        break;
+      }
+      std::unique_lock<std::mutex> lock(r->mu);
+      r->cv_push.wait(lock, [r] {
+        return r->queue.size() < r->prefetch || r->stop;
+      });
+      if (r->stop) break;
+      r->queue.push_back(std::move(rec));
+      r->cv_pop.notify_one();
+    }
+    std::fclose(f);
+    {
+      std::lock_guard<std::mutex> lock(r->mu);
+      if (!r->error.empty()) break;
+    }
+  }
+  r->done = true;
+  r->cv_pop.notify_all();
+}
+
+// ------------------------------------------------- minimal Example parser --
+// Parses tf.train.Example just enough to pull one named Int64List feature.
+// Wire layout (all protobuf):
+//   Example        { features = 1 (msg) }
+//   Features       { feature  = 1 (map<string, Feature>) }
+//   map entry      { key = 1 (string), value = 2 (Feature msg) }
+//   Feature        { int64_list = 3 (msg) }  [bytes_list=1, float_list=2]
+//   Int64List      { value = 1 (repeated varint, possibly packed) }
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t Varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  void Skip(uint32_t wire_type) {
+    switch (wire_type) {
+      case 0: Varint(); break;
+      case 1: p += 8; break;
+      case 2: { uint64_t n = Varint(); p += n; break; }
+      case 5: p += 4; break;
+      default: ok = false;
+    }
+    if (p > end) ok = false;
+  }
+};
+
+// Extract int64s for `key` into out (up to width); returns count or -1.
+int ParseExampleInt64(const char* data, size_t size, const char* key,
+                      int32_t* out, int width) {
+  Cursor ex{reinterpret_cast<const uint8_t*>(data),
+            reinterpret_cast<const uint8_t*>(data) + size};
+  size_t key_len = std::strlen(key);
+  while (ex.ok && ex.p < ex.end) {
+    uint64_t tag = ex.Varint();
+    if (!ex.ok) return -1;
+    if ((tag >> 3) != 1 || (tag & 7) != 2) { ex.Skip(tag & 7); continue; }
+    uint64_t features_len = ex.Varint();
+    Cursor feats{ex.p, ex.p + features_len};
+    ex.p += features_len;
+    while (feats.ok && feats.p < feats.end) {
+      uint64_t ftag = feats.Varint();
+      if (!feats.ok) return -1;
+      if ((ftag >> 3) != 1 || (ftag & 7) != 2) { feats.Skip(ftag & 7); continue; }
+      uint64_t entry_len = feats.Varint();
+      Cursor entry{feats.p, feats.p + entry_len};
+      feats.p += entry_len;
+      bool key_match = false;
+      Cursor value{nullptr, nullptr};
+      while (entry.ok && entry.p < entry.end) {
+        uint64_t etag = entry.Varint();
+        if (!entry.ok) return -1;
+        if ((etag >> 3) == 1 && (etag & 7) == 2) {
+          uint64_t n = entry.Varint();
+          key_match = (n == key_len &&
+                       std::memcmp(entry.p, key, key_len) == 0);
+          entry.p += n;
+        } else if ((etag >> 3) == 2 && (etag & 7) == 2) {
+          uint64_t n = entry.Varint();
+          value = Cursor{entry.p, entry.p + n};
+          entry.p += n;
+        } else {
+          entry.Skip(etag & 7);
+        }
+      }
+      if (!key_match || value.p == nullptr) continue;
+      // value: Feature { int64_list = 3 }
+      while (value.ok && value.p < value.end) {
+        uint64_t vtag = value.Varint();
+        if (!value.ok) return -1;
+        if ((vtag >> 3) != 3 || (vtag & 7) != 2) { value.Skip(vtag & 7); continue; }
+        uint64_t list_len = value.Varint();
+        Cursor list{value.p, value.p + list_len};
+        value.p += list_len;
+        int count = 0;
+        while (list.ok && list.p < list.end && count < width) {
+          uint64_t ltag = list.Varint();
+          if (!list.ok) return -1;
+          if ((ltag >> 3) != 1) { list.Skip(ltag & 7); continue; }
+          if ((ltag & 7) == 2) {  // packed
+            uint64_t n = list.Varint();
+            const uint8_t* stop_at = list.p + n;
+            while (list.ok && list.p < stop_at && count < width)
+              out[count++] = static_cast<int32_t>(list.Varint());
+          } else {  // single varint
+            out[count++] = static_cast<int32_t>(list.Varint());
+          }
+        }
+        return list.ok ? count : -1;
+      }
+    }
+  }
+  return 0;  // key not found
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rr_open(const char** paths, int n_paths, int prefetch) {
+  auto* r = new Reader();
+  for (int i = 0; i < n_paths; ++i) r->paths.emplace_back(paths[i]);
+  r->prefetch = prefetch > 0 ? prefetch : 256;
+  r->worker = std::thread(ReadLoop, r);
+  return r;
+}
+
+// Pops one record; caller owns *buf (free with rr_free).
+int rr_next_record(void* h, char** buf, long* len) {
+  auto* r = static_cast<Reader*>(h);
+  std::unique_lock<std::mutex> lock(r->mu);
+  r->cv_pop.wait(lock, [r] {
+    return !r->queue.empty() || r->done || r->stop;
+  });
+  if (!r->error.empty()) return -1;
+  if (r->queue.empty()) return 0;  // EOF
+  Record rec = std::move(r->queue.front());
+  r->queue.pop_front();
+  r->cv_push.notify_one();
+  lock.unlock();
+  *len = static_cast<long>(rec.bytes.size());
+  *buf = static_cast<char*>(std::malloc(rec.bytes.size()));
+  std::memcpy(*buf, rec.bytes.data(), rec.bytes.size());
+  return 1;
+}
+
+void rr_free(char* buf) { std::free(buf); }
+
+// Fills out[batch][width] with the named Int64List feature of the next
+// `batch` records. Returns 1 ok, 0 EOF (not enough records), <0 error.
+int rr_next_batch_i32(void* h, const char* key, int32_t* out, int batch,
+                      int width) {
+  auto* r = static_cast<Reader*>(h);
+  for (int i = 0; i < batch; ++i) {
+    char* buf = nullptr;
+    long len = 0;
+    int rc = rr_next_record(h, &buf, &len);
+    if (rc <= 0) return rc;
+    int got = ParseExampleInt64(buf, len, key, out + i * width, width);
+    std::free(buf);
+    if (got < 0) return -2;
+    if (got < width)  // pad short sequences with zeros
+      std::memset(out + i * width + got, 0, sizeof(int32_t) * (width - got));
+  }
+  (void)r;
+  return 1;
+}
+
+const char* rr_error(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  std::lock_guard<std::mutex> lock(r->mu);
+  return r->error.empty() ? nullptr : r->error.c_str();
+}
+
+void rr_close(void* h) { delete static_cast<Reader*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference's hot kernels are closed-source cuDNN/NCCL binaries linked
+through the TF wheel (SURVEY.md §2 native rows). Convolution/BN come free
+from XLA on TPU; the kernels here cover the ops where a hand-fused Pallas
+implementation beats naive XLA:
+
+  flash_attention.py   fused attention (no HBM S×S materialization)
+"""

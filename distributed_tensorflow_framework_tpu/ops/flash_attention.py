@@ -1,0 +1,135 @@
+"""Fused attention Pallas kernel.
+
+Computes softmax(qkᵀ/√d)·v with the S×S score matrix living only in VMEM —
+one HBM read of q/k/v and one write of the output per (batch, head, q-block)
+program, the memory-optimal pattern for self-attention at BERT-scale
+sequence lengths. XLA alone materializes (or at best tiles) the score
+tensor through HBM for the unfused einsum+softmax+einsum chain; this kernel
+is the TPU analogue of the reference's fused cuDNN attention path would-be
+(the reference predates flash attention; SURVEY.md §5 long-context row).
+
+Shapes: q, k, v are (B, S, H, D); grid is (B, H, S/BLOCK_Q); each program
+holds its q block and the full K/V for that head in VMEM (fine to S≈4K;
+beyond that use ring attention over the ``seq`` mesh axis or the xla impl).
+
+The kernel runs in interpret mode off-TPU so the CPU test mesh exercises
+the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+BLOCK_Q = 128
+# Whole-K VMEM budget: S*D*4B*2 (K and V, f32 upcast) + scores BLOCK_Q*S*4B
+# must fit in ~16MB with double buffering.
+MAX_SEQ_VMEM = 4096
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale: float):
+    q = q_ref[0, 0].astype(jnp.float32)          # (BQ, D)
+    k = k_ref[0, 0].astype(jnp.float32)          # (S, D)
+    v = v_ref[0, 0].astype(jnp.float32)          # (S, D)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                     # (BQ, S)
+    s = s + bias_ref[0][None, :]                  # additive mask bias
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / l                                         # (BQ, D)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def _xla_reference(q, k, v, bias):
+    """Plain-XLA attention on the (B,H,S,D) layout — the autodiff source of
+    truth for the backward pass (forward runs the fused kernel; backward
+    rematerializes through this, trading HBM for FLOPs exactly like
+    jax.checkpoint would)."""
+    d = q.shape[-1]
+    s = jax.lax.dot_general(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        (((3,), (3,)), ((0, 1), (0, 1))),
+    ) / (d ** 0.5)                                  # (B,H,S,S)
+    s = s + bias[:, None, None, :]
+    p = jax.nn.softmax(s, axis=-1)
+    return jax.lax.dot_general(
+        p, v.astype(jnp.float32),
+        (((3,), (2,)), ((0, 1), (0, 1))),
+    ).astype(q.dtype)                               # (B,H,S,D)
+
+
+@jax.custom_vjp
+def _fused(q, k, v, bias):
+    interpret = jax.default_backend() != "tpu"
+    return _flash_attention(q, k, v, bias, interpret=interpret)
+
+
+def _fused_fwd(q, k, v, bias):
+    return _fused(q, k, v, bias), (q, k, v, bias)
+
+
+def _fused_bwd(res, g):
+    q, k, v, bias = res
+    _, vjp = jax.vjp(_xla_reference, q, k, v, bias)
+    return vjp(g)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _flash_attention(q, k, v, bias, *, interpret: bool):
+    b, h, s, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    block_q = min(BLOCK_Q, s)
+    grid = (b, h, s // block_q)
+    return pl.pallas_call(
+        functools.partial(_attn_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, s), lambda bi, hi, qi: (bi, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)
+        ),
+        interpret=interpret,
+    )(q, k, v, bias)
+
+
+def flash_attention(q, k, v, *, mask=None):
+    """Fused attention. q,k,v: (B, S, H, D); mask: (B,1,1,S) bool or None.
+
+    Returns (B, S, H, D) in q's dtype.
+    """
+    b, s, hh, d = q.shape
+    if s > MAX_SEQ_VMEM:
+        raise ValueError(
+            f"flash_attention holds full K/V in VMEM; seq {s} > "
+            f"{MAX_SEQ_VMEM}. Use attention_impl='ring' for long context."
+        )
+    if s % min(BLOCK_Q, s):
+        raise ValueError(f"seq len {s} must be a multiple of {BLOCK_Q}")
+    # (B, S, H, D) → (B, H, S, D) for contiguous per-head blocks.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    if mask is not None:
+        bias = jnp.where(mask[:, 0, 0, :], 0.0, NEG_INF).astype(jnp.float32)
+    else:
+        bias = jnp.zeros((b, s), jnp.float32)
+    out = _fused(qt, kt, vt, bias)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,104 @@
+"""Ring attention — sequence-parallel exact attention over the ``seq`` axis.
+
+Long-context support (absent from the reference, which is conv-net DP only —
+SURVEY.md §5 "Long-context" row — but first-class here): the sequence is
+sharded over the ``seq`` mesh axis; each device holds its local Q/K/V shard
+and the K/V shards rotate around the ring via ``ppermute`` while every
+device accumulates its queries' attention over the full sequence with an
+online (flash-style) softmax. Communication rides ICI neighbor links and
+overlaps with the per-chunk attention compute; peak memory per device is
+O(S/n · S/n) scores instead of O(S²).
+
+``ring_attention`` is the per-shard body (call inside shard_map);
+``ring_attention_sharded`` wraps it for use from jit-level code (e.g. the
+BERT module with ``attention_impl="ring"``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _chunk_scores(q, k, v, bias, scale):
+    """Unnormalized attention stats for one K/V chunk.
+
+    q: (B, Sq, H, D); k,v: (B, Sk, H, D); bias: (B, Sk) additive mask →
+    (max (B,H,Sq,1), exp-sum (B,H,Sq,1), weighted-v (B,Sq,H,D)).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    s = s + bias[:, None, None, :]
+    m = jnp.max(s, axis=-1, keepdims=True)                   # (B,H,Sq,1)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)                   # (B,H,Sq,1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m, l, pv
+
+
+def ring_attention(q, k, v, bias, *, axis_name: str = "seq"):
+    """Exact attention with K/V rotating around the ring. Per-shard code —
+    must run inside shard_map with q,k,v sharded over ``axis_name`` on the
+    sequence dim. Shapes per shard: (B, S/n, H, D); ``bias`` is the
+    additive key-mask shard (B, S/n) and rotates with its K/V."""
+    n = lax.axis_size(axis_name)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    m0, l0, pv0 = _chunk_scores(q, k, v, bias, scale)
+
+    def body(i, carry):
+        m, l, pv, k_cur, v_cur, b_cur = carry
+        # Rotate K/V (and their mask shard) to the next ring position; the
+        # send overlaps with the local chunk's attention compute below (XLA
+        # schedules the collective-permute concurrently with the
+        # independent einsum).
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        b_nxt = lax.ppermute(b_cur, axis_name, perm)
+        m_c, l_c, pv_c = _chunk_scores(q, k_nxt, v_nxt, b_nxt, scale)
+        # Online-softmax merge of the running stats with the new chunk.
+        m_new = jnp.maximum(m, m_c)
+        a = jnp.exp(m - m_new)
+        b = jnp.exp(m_c - m_new)
+        l_new = l * a + l_c * b
+        # pv carries (B,Sq,H,D); scale factors are (B,H,Sq,1) → align axes.
+        a_t = a.transpose(0, 2, 1, 3)  # (B,Sq,H,1)
+        b_t = b.transpose(0, 2, 1, 3)
+        pv_new = pv * a_t + pv_c * b_t
+        return m_new, l_new, pv_new, k_nxt, v_nxt, b_nxt
+
+    m, l, pv, _, _, _ = lax.fori_loop(0, n - 1, body, (m0, l0, pv0, k, v, bias))
+    out = pv / l.transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, *, mesh, mask=None, axis_name: str = "seq"):
+    """jit-level wrapper: shard q,k,v over the seq axis and run the ring.
+
+    Usable inside an outer jit (nested shard_map); batch stays sharded over
+    the data axes, heads/features replicated across ``seq``. ``mask`` is the
+    (B,1,1,S) bool key mask (as produced by the BERT module) or None.
+    """
+    if mesh is None:
+        raise ValueError("ring attention needs the physical mesh "
+                         "(pass mesh= to the model)")
+    b, s = q.shape[0], q.shape[1]
+    if mask is not None:
+        bias = jnp.where(mask[:, 0, 0, :], 0.0,
+                         jnp.finfo(jnp.float32).min).astype(jnp.float32)
+    else:
+        bias = jnp.zeros((b, s), jnp.float32)
+    spec = P(("data", "fsdp"), axis_name, None, None)
+    bias_spec = P(("data", "fsdp"), axis_name)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, bias_spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, bias)

@@ -42,12 +42,16 @@ class NaNGuardHook(BaseHook):
     def after_step(self, trainer, step, metrics) -> None:
         if metrics is None:
             return
-        loss = metrics.get("loss")
-        if loss is not None and not math.isfinite(float(loss)):
-            raise FloatingPointError(
-                f"Non-finite loss {loss} at step {step} — aborting "
-                f"(NaNGuardHook; reference NanTensorHook contract)"
-            )
+        for name, v in metrics.items():
+            try:
+                val = float(v)  # accepts python/numpy scalars + 0-d arrays
+            except (TypeError, ValueError):
+                continue
+            if not math.isfinite(val):
+                raise FloatingPointError(
+                    f"Non-finite metric {name}={v} at step {step} — aborting "
+                    f"(NaNGuardHook; reference NanTensorHook contract)"
+                )
 
 
 class ThroughputHook(BaseHook):
@@ -104,6 +108,37 @@ class CheckpointHook(BaseHook):
         self.manager.save(int(trainer.host_step), trainer.state,
                           dataset_state=trainer.data_ckpt_state, force=True)
         self.manager.wait_until_finished()
+
+
+class ProfileHook(BaseHook):
+    """Captures an XPlane trace over steps [start, stop) — the analogue of
+    the reference's tf.profiler/timeline option (SURVEY.md §5)."""
+
+    def __init__(self, logdir: str, start: int, stop: int):
+        self.logdir = logdir
+        # after_step first fires at step=1, so a start of 0 means "from the
+        # beginning"; the trace then covers steps (start, stop].
+        self.start = max(1, start)
+        self.stop = stop
+        self._active = False
+
+    def after_step(self, trainer, step, metrics) -> None:
+        import jax
+
+        if step >= self.start and step < self.stop and not self._active:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif step >= self.stop and self._active:
+            jax.block_until_ready(trainer.state.params)
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def on_end(self, trainer) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
 
 
 class EvalHook(BaseHook):

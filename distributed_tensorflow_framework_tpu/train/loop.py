@@ -95,6 +95,17 @@ class Trainer:
             )
         if cfg.train.eval_interval > 0:
             hooks.append(hooks_lib.EvalHook(self.evaluate, cfg.train.eval_interval))
+        if cfg.train.profile_stop > cfg.train.profile_start and self.runtime.is_chief:
+            import os
+
+            trace_dir = os.path.join(
+                cfg.checkpoint.directory or "/tmp/dtf_tpu", "traces"
+            )
+            hooks.append(
+                hooks_lib.ProfileHook(
+                    trace_dir, cfg.train.profile_start, cfg.train.profile_stop
+                )
+            )
         return hooks
 
     # --------------------------------------------------------------- train --

@@ -46,6 +46,8 @@ def task_for_model(name: str) -> str:
 
 def model_inputs(task: str, batch: Any) -> tuple:
     if task == "mlm":
+        if "attention_mask" in batch:
+            return (batch["input_ids"], batch["attention_mask"])
         return (batch["input_ids"],)
     return (batch["image"],)
 
@@ -63,7 +65,7 @@ class StepBuilder:
         bn_axis = None
         if self.shard_map_mode and config.model.bn_cross_replica:
             bn_axis = DATA_AXES
-        self.model = get_model(config.model, bn_axis_name=bn_axis)
+        self.model = get_model(config.model, bn_axis_name=bn_axis, mesh=mesh)
         self.tx, self.schedule = make_optimizer(
             config.optimizer, config.train.total_steps
         )
@@ -135,11 +137,25 @@ class StepBuilder:
             if self.task == "mlm":
                 loss, metrics = losses.mlm_loss(logits, batch["targets"])
             else:
+                aux_logits = None
+                if isinstance(logits, dict):  # Inception aux head
+                    aux_logits = logits.get("aux_logits")
+                    logits = logits["logits"]
                 loss, metrics = losses.classification_loss(
                     logits,
                     batch["label"],
                     label_smoothing=self.config.train.label_smoothing,
                 )
+                if aux_logits is not None:
+                    aux_loss, _ = losses.classification_loss(
+                        aux_logits,
+                        batch["label"],
+                        label_smoothing=self.config.train.label_smoothing,
+                    )
+                    # Canonical Inception-v3 auxiliary weighting.
+                    loss = loss + 0.4 * aux_loss
+                    metrics["aux_loss"] = aux_loss
+                    metrics["total_loss"] = loss
             return loss, (metrics, new_model_state)
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)

@@ -1,0 +1,54 @@
+"""Subprocess worker for the multi-process (DCN-path) test.
+
+SURVEY.md §4 "Multi-process without a cluster": N local processes with
+jax.distributed.initialize exercise the cross-host code paths (env-var
+topology discovery, per-host data sharding, global-array assembly, psum
+across processes) without a real multi-host slice.
+
+Usage: python distributed_worker.py <port> <num_procs> <proc_id>
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    port, num_procs, proc_id = sys.argv[1:4]
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = num_procs
+    os.environ["JAX_PROCESS_ID"] = proc_id
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_tensorflow_framework_tpu.core.config import load_config
+    from distributed_tensorflow_framework_tpu.core.mesh import initialize_runtime
+    from distributed_tensorflow_framework_tpu.train import Trainer
+
+    cfg = load_config(base={
+        "name": "mp-lenet",
+        "mesh": {"data": -1},
+        "model": {"name": "lenet5", "num_classes": 10, "dtype": "float32"},
+        "data": {"name": "synthetic_images", "global_batch_size": 32,
+                 "image_size": 28, "channels": 1},
+        "optimizer": {"name": "sgd_momentum", "learning_rate": 0.05},
+        "train": {"total_steps": 5, "log_interval": 5, "seed": 0},
+    })
+    runtime = initialize_runtime(cfg.mesh)
+    assert runtime.process_count == int(num_procs), runtime.process_count
+    assert runtime.global_device_count == 2 * int(num_procs)
+
+    trainer = Trainer(cfg, runtime)
+    metrics = trainer.train()
+    # Every process must agree on the (replicated) loss.
+    print(f"RESULT process={proc_id} loss={metrics['loss']:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""End-to-end train smoke for the non-LeNet workloads (tiny shapes):
+Inception-v3 with aux loss, BERT MLM with each attention impl, and BERT
+tensor-parallel over the model axis."""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_framework_tpu.core.config import load_config
+from distributed_tensorflow_framework_tpu.train import Trainer
+
+
+def tiny_bert_base(**model_overrides):
+    model = {
+        "name": "bert", "vocab_size": 512, "hidden_size": 64,
+        "num_layers": 2, "num_heads": 4, "mlp_dim": 128,
+        "max_seq_len": 128, "dtype": "float32", "attention_impl": "xla",
+    }
+    model.update(model_overrides)
+    return {
+        "name": "bert-tiny",
+        "model": model,
+        "data": {
+            "name": "synthetic_mlm", "global_batch_size": 16, "seq_len": 128,
+            "vocab_size": 512,
+        },
+        "optimizer": {"name": "adamw", "learning_rate": 3e-3,
+                      "grad_clip_norm": 1.0},
+        "train": {"total_steps": 10, "log_interval": 5, "seed": 1},
+    }
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas", "ring"])
+def test_bert_trains(devices, impl):
+    base = tiny_bert_base(attention_impl=impl)
+    if impl == "ring":
+        base["mesh"] = {"data": 1, "seq": 8}
+    cfg = load_config(base=base)
+    t = Trainer(cfg)
+    metrics = t.train()
+    assert np.isfinite(metrics["loss"])
+    # vocab 512 → random CE ≈ ln(512) ≈ 6.24; must have moved down.
+    assert metrics["loss"] < 6.0, metrics
+
+
+def test_bert_tensor_parallel(devices):
+    """model=4 TP: megatron-style sharded QKV/MLP; loss matches DP run."""
+    import jax
+
+    results = {}
+    for mesh in ({"data": 8}, {"data": 2, "model": 4}):
+        base = tiny_bert_base()
+        base["mesh"] = mesh
+        cfg = load_config(base=base)
+        t = Trainer(cfg)
+        metrics = t.train()
+        results[str(mesh)] = metrics["loss"]
+    a, b = results.values()
+    np.testing.assert_allclose(a, b, rtol=1e-3)
+
+
+def test_inception_trains(devices):
+    cfg = load_config(base={
+        "name": "inception-tiny",
+        "model": {"name": "inception_v3", "num_classes": 10, "dtype": "float32"},
+        "data": {
+            "name": "synthetic_images", "global_batch_size": 16,
+            "image_size": 96, "channels": 3,
+        },
+        "optimizer": {"name": "sgd_momentum", "learning_rate": 0.01},
+        "train": {"total_steps": 3, "log_interval": 1, "seed": 0},
+    })
+    t = Trainer(cfg)
+    metrics = t.train()
+    assert np.isfinite(metrics["loss"])
+    assert "aux_loss" in metrics  # aux head active in training
