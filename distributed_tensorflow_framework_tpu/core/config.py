@@ -78,18 +78,24 @@ class MeshConfig:
 
     ``data`` is the data-parallel axis (the reference's worker-replica count,
     SURVEY.md §2 row 3); ``fsdp`` shards params/optimizer state ZeRO-style;
-    ``model`` is tensor parallelism; ``seq`` is sequence/context parallelism
-    for ring attention. -1 for ``data`` means "all remaining devices".
+    ``expert`` is expert parallelism (MoE experts sharded, all_to_all
+    dispatch — the batch is also sharded over it, so it doubles as extra
+    data parallelism for the dense params); ``pipe`` is pipeline parallelism
+    (layer stages, microbatched); ``model`` is tensor parallelism; ``seq``
+    is sequence/context parallelism for ring attention. -1 for ``data``
+    means "all remaining devices".
     """
 
     data: int = -1
     fsdp: int = 1
+    expert: int = 1
+    pipe: int = 1
     model: int = 1
     seq: int = 1
 
     def axis_sizes(self) -> dict[str, int]:
-        return {"data": self.data, "fsdp": self.fsdp,
-                "model": self.model, "seq": self.seq}
+        return {"data": self.data, "fsdp": self.fsdp, "expert": self.expert,
+                "pipe": self.pipe, "model": self.model, "seq": self.seq}
 
 
 @config_dataclass
@@ -130,10 +136,23 @@ class ModelConfig:
     num_heads: int = 12
     mlp_dim: int = 3072
     max_seq_len: int = 512
+    dropout_rate: float = 0.1
     # Attention implementation: "xla" (dot-product, XLA-fused) or
     # "pallas" (fused flash-attention kernel, ops/flash_attention.py) or
     # "ring" (sequence-parallel ring attention over the seq mesh axis).
     attention_impl: str = "xla"
+    # Mixture-of-Experts (models/moe.py): 0 = dense FFN everywhere; >0 =
+    # every `moe_every`-th encoder layer uses an expert-parallel MoE FFN
+    # routed top-`expert_topk` with per-group capacity `capacity_factor`.
+    num_experts: int = 0
+    moe_every: int = 2
+    expert_topk: int = 2
+    capacity_factor: float = 1.25
+    # Pipeline parallelism (parallel/pipeline.py): >1 splits the encoder
+    # stack into this many stages over the `pipe` mesh axis (must equal the
+    # mesh's pipe size) with microbatched GPipe scheduling.
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 0  # 0 → defaults to pipeline_stages
 
 
 @config_dataclass
@@ -179,6 +198,11 @@ class TrainConfig:
     spmd_mode: str = "jit"
     nan_guard: bool = True
     label_smoothing: float = 0.0
+    # Weight of the MoE load-balancing aux loss (Switch Transformer uses 0.01).
+    moe_aux_weight: float = 0.01
+    # Gradient accumulation: split each global batch into this many
+    # microbatches, scan fwd/bwd accumulating grads, apply once.
+    grad_accum_steps: int = 1
     # XPlane trace capture over steps [profile_start, profile_stop);
     # 0/0 disables (SURVEY.md §5 tracing).
     profile_start: int = 0
@@ -211,7 +235,15 @@ def _set_by_path(data: dict, dotted: str, value: Any) -> None:
 
 
 def _parse_scalar(text: str) -> Any:
-    return yaml.safe_load(text)
+    value = yaml.safe_load(text)
+    # YAML 1.1 reads "1e-3" (no decimal point) as a *string*; CLI overrides
+    # mean numbers when they look like numbers, so coerce.
+    if isinstance(value, str):
+        try:
+            return float(value)
+        except ValueError:
+            pass
+    return value
 
 
 def load_config(

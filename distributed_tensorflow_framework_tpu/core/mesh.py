@@ -29,8 +29,10 @@ log = logging.getLogger(__name__)
 
 # Axis order matters: data outermost so data-parallel replicas land on
 # distinct slices/hosts first, model/seq innermost so tensor- and
-# sequence-parallel collectives ride the fastest ICI links.
-MESH_AXES = ("data", "fsdp", "seq", "model")
+# sequence-parallel collectives ride the fastest ICI links; expert/pipe sit
+# between (all_to_all and stage ppermute traffic is lighter than TP
+# all_reduce but heavier than DP grad reduction per step).
+MESH_AXES = ("data", "fsdp", "expert", "pipe", "seq", "model")
 
 
 def initialize_distributed() -> None:
@@ -93,9 +95,15 @@ def create_mesh(
 
 
 def batch_spec(mesh: Mesh) -> P:
-    """PartitionSpec sharding the leading batch dim over data(+fsdp) axes."""
+    """PartitionSpec sharding the leading batch dim over the data-like axes.
+
+    ``expert`` participates: for MoE runs the batch is sharded over it too
+    (it acts as extra data parallelism for the dense params; the MoE
+    dispatch einsum moves tokens expert-ward via all_to_all). ``pipe``/
+    ``seq``/``model`` never shard the batch dim.
+    """
     del mesh
-    return P(("data", "fsdp"))
+    return P(("data", "fsdp", "expert"))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -124,7 +132,11 @@ class MeshRuntime:
 
     @property
     def data_parallel_size(self) -> int:
-        return (self.mesh.shape["data"] * self.mesh.shape["fsdp"])
+        return (
+            self.mesh.shape["data"]
+            * self.mesh.shape["fsdp"]
+            * self.mesh.shape["expert"]
+        )
 
     def describe(self) -> str:
         return (

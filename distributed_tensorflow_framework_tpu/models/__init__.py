@@ -67,8 +67,13 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             num_heads=config.num_heads,
             mlp_dim=config.mlp_dim,
             max_seq_len=config.max_seq_len,
+            dropout_rate=config.dropout_rate,
             dtype=dtype,
             attention_impl=config.attention_impl,
             mesh=mesh,
+            num_experts=config.num_experts,
+            moe_every=config.moe_every,
+            expert_topk=config.expert_topk,
+            capacity_factor=config.capacity_factor,
         )
     raise ValueError(f"Unknown model {config.name!r}")

@@ -83,6 +83,10 @@ class EncoderLayer(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_impl: str = "xla"
     mesh: Any = None
+    # MoE FFN (models/moe.py): 0 = dense MLP; >0 = expert-parallel MoE.
+    num_experts: int = 0
+    expert_topk: int = 2
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = True):
@@ -92,13 +96,23 @@ class EncoderLayer(nn.Module):
         )(x, mask)
         attn = nn.Dropout(self.dropout_rate, deterministic=not train)(attn)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x + attn)
-        y = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32,
-                     kernel_init=dense_kernel_init, name="mlp_in")(x)
-        y = nn.gelu(y, approximate=True)
-        y = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=jnp.float32,
-                     kernel_init=dense_kernel_init, name="mlp_out")(y)
+        aux_loss = jnp.zeros((), jnp.float32)
+        if self.num_experts > 0:
+            from distributed_tensorflow_framework_tpu.models.moe import MoEMlp
+
+            y, aux_loss = MoEMlp(
+                num_experts=self.num_experts, mlp_dim=self.mlp_dim,
+                topk=self.expert_topk, capacity_factor=self.capacity_factor,
+                dtype=self.dtype, name="moe",
+            )(x)
+        else:
+            y = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32,
+                         kernel_init=dense_kernel_init, name="mlp_in")(x)
+            y = nn.gelu(y, approximate=True)
+            y = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=jnp.float32,
+                         kernel_init=dense_kernel_init, name="mlp_out")(y)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
-        return nn.LayerNorm(dtype=jnp.float32, name="ln2")(x + y)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln2")(x + y), aux_loss
 
 
 class BertForMLM(nn.Module):
@@ -112,6 +126,13 @@ class BertForMLM(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_impl: str = "xla"
     mesh: Any = None
+    # MoE: with num_experts>0, every `moe_every`-th layer (from the top of
+    # each group) uses an expert-parallel FFN; returns a dict with the
+    # load-balancing aux loss alongside the logits.
+    num_experts: int = 0
+    moe_every: int = 2
+    expert_topk: int = 2
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, *, train: bool = True):
@@ -133,12 +154,25 @@ class BertForMLM(nn.Module):
         mask = None
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
+        aux_total = jnp.zeros((), jnp.float32)
+        n_moe = 0
         for i in range(self.num_layers):
-            x = EncoderLayer(
+            use_moe = (
+                self.num_experts > 0
+                and i % max(self.moe_every, 1) == max(self.moe_every, 1) - 1
+            )
+            x, aux = EncoderLayer(
                 self.num_heads, self.mlp_dim, self.dropout_rate,
                 dtype=self.dtype, attention_impl=self.attention_impl,
-                mesh=self.mesh, name=f"layer{i}",
+                mesh=self.mesh,
+                num_experts=self.num_experts if use_moe else 0,
+                expert_topk=self.expert_topk,
+                capacity_factor=self.capacity_factor,
+                name=f"layer{i}",
             )(x, mask, train=train)
+            if use_moe:
+                aux_total = aux_total + aux
+                n_moe += 1
 
         # MLM head: dense → gelu → LN → tied-embedding projection + bias.
         x = nn.Dense(self.hidden_size, dtype=self.dtype,
@@ -149,4 +183,10 @@ class BertForMLM(nn.Module):
         logits = embed.attend(x.astype(jnp.float32))
         bias = self.param("mlm_bias", nn.initializers.zeros,
                           (self.vocab_size,), jnp.float32)
-        return logits + bias
+        logits = logits + bias
+        if self.num_experts > 0:
+            return {
+                "logits": logits,
+                "moe_aux_loss": aux_total / max(n_moe, 1),
+            }
+        return logits

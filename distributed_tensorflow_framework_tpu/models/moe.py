@@ -1,0 +1,146 @@
+"""Mixture-of-Experts FFN with expert parallelism (GShard/Switch-style).
+
+The reference framework has no MoE (SURVEY.md §2 parallelism inventory —
+expert parallel: NO); this extends the capability surface the TPU-native
+way: experts live on a dedicated ``expert`` mesh axis, tokens are routed by
+a learned top-k gate, and the dispatch/combine einsums against
+expert-sharded weights make XLA emit ``all_to_all`` collectives over ICI —
+the idiomatic pjit MoE (no hand-written routing RPCs).
+
+Design points:
+  * **Dense dispatch** (one-hot dispatch/combine tensors) with a static
+    per-group capacity — shapes are static so everything jits; tokens over
+    capacity are dropped (standard GShard semantics) and their combine
+    weight is zero, which keeps the layer differentiable.
+  * **Grouping**: the batch dim is the dispatch group — capacity is
+    ``ceil(topk * seq / num_experts * capacity_factor)`` per example.
+  * **Load-balancing aux loss** (Switch Transformer): E * Σ_e me·ce where
+    me = mean gate prob, ce = fraction of tokens whose first choice is e.
+    Perfectly balanced routing gives 1.0.
+  * Gating math runs in float32 regardless of compute dtype.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_framework_tpu.models.layers import dense_kernel_init
+
+expert_kernel_init = nn.initializers.variance_scaling(
+    1.0, "fan_in", "truncated_normal", in_axis=-2, out_axis=-1
+)
+
+
+def topk_dispatch(
+    gate_logits: jax.Array,  # (B, S, E) float32
+    topk: int,
+    capacity: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k routing with per-group (= per-batch-row) capacity.
+
+    Returns ``(dispatch, combine, aux_loss)`` where dispatch/combine are
+    (B, S, E, C) one-hot/weighted one-hot tensors and aux_loss is the
+    scalar load-balancing loss.
+    """
+    b, s, e = gate_logits.shape
+    if not 1 <= topk <= e:
+        raise ValueError(
+            f"topk={topk} must be in [1, num_experts={e}] — above e, argmax "
+            f"over the exhausted gate would silently re-dispatch to expert 0"
+        )
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    dispatch = jnp.zeros((b, s, e, capacity), jnp.float32)
+    gate_weights = jnp.zeros((b, s, e), jnp.float32)
+    # Tokens already claimed per (group, expert) by earlier choices.
+    claimed = jnp.zeros((b, e), jnp.float32)
+    remaining = probs
+    first_mask = None
+    for _ in range(topk):
+        choice = jnp.argmax(remaining, axis=-1)  # (B, S)
+        mask = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # (B, S, E)
+        if first_mask is None:
+            first_mask = mask
+        # Position of each token within its chosen expert's buffer.
+        pos = jnp.cumsum(mask, axis=1) - 1.0 + claimed[:, None, :]
+        mask = mask * (pos < capacity)
+        claimed = claimed + mask.sum(axis=1)
+        gate_weights = gate_weights + probs * mask
+        pos_in = (pos * mask).sum(axis=-1)  # (B, S)
+        cap_oh = jax.nn.one_hot(pos_in.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)
+        cap_oh = cap_oh * mask.sum(axis=-1, keepdims=True)
+        dispatch = dispatch + mask[..., None] * cap_oh[..., None, :]
+        remaining = remaining * (1.0 - jax.nn.one_hot(choice, e,
+                                                      dtype=jnp.float32))
+
+    if topk == 1:
+        # Switch-style: scale by the RAW top-1 prob. Normalizing would make
+        # the weight identically 1, killing the router's task-loss gradient
+        # (it would then learn only from the aux loss).
+        combine = dispatch * gate_weights[..., None]
+    else:
+        # GShard top-k: normalize selected gate probs to sum to 1 per token.
+        denom = gate_weights.sum(axis=-1, keepdims=True)
+        gate_weights = gate_weights / jnp.maximum(denom, 1e-9)
+        combine = dispatch * gate_weights[..., None]
+
+    me = probs.mean(axis=(0, 1))          # (E,) mean gate prob
+    ce = first_mask.mean(axis=(0, 1))     # (E,) first-choice fraction
+    aux_loss = e * jnp.sum(me * ce)
+    return dispatch, combine, aux_loss
+
+
+class MoEMlp(nn.Module):
+    """Expert-parallel MLP block replacing the dense transformer FFN.
+
+    Expert weights ``wi`` (E, H, F) / ``wo`` (E, F, H) are sharded
+    ``P("expert", ...)`` by parallel/sharding.py's MoE rules (plus megatron
+    column/row splits over ``model`` when TP is on); the dispatch einsum
+    below then lowers to an XLA all_to_all between the data and expert
+    shards.
+    """
+
+    num_experts: int
+    mlp_dim: int
+    topk: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+        b, s, h = x.shape
+        e = self.num_experts
+        capacity = max(
+            self.topk,
+            int(math.ceil(self.topk * s / e * self.capacity_factor)),
+        )
+        gate_logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
+            kernel_init=dense_kernel_init, name="gate",
+        )(x.astype(jnp.float32))
+        dispatch, combine, aux_loss = topk_dispatch(
+            gate_logits, self.topk, capacity
+        )
+
+        wi = self.param("wi", expert_kernel_init, (e, h, self.mlp_dim),
+                        jnp.float32)
+        wo = self.param("wo", expert_kernel_init, (e, self.mlp_dim, h),
+                        jnp.float32)
+        # (B,S,E,C) × (B,S,H) → (B,E,C,H): the all_to_all site (tokens move
+        # from data shards to expert shards).
+        xe = jnp.einsum("bsec,bsh->bech", dispatch.astype(self.dtype),
+                        x.astype(self.dtype))
+        he = nn.gelu(
+            jnp.einsum("bech,ehf->becf", xe, wi.astype(self.dtype)),
+            approximate=True,
+        )
+        oe = jnp.einsum("becf,efh->bech", he, wo.astype(self.dtype))
+        # Combine: expert shards → data shards (the return all_to_all).
+        out = jnp.einsum("bsec,bech->bsh", combine.astype(self.dtype), oe)
+        return out, aux_loss

@@ -38,6 +38,15 @@ TP_RULES: list[tuple[str, tuple[str | None, ...]]] = [
     (r".*embed/embedding$", (None, "model")),
 ]
 
+# MoE expert weights (models/moe.py): leading num_experts dim over the
+# ``expert`` axis, hidden dims megatron-split over ``model`` (column-parallel
+# wi, row-parallel wo). The gate stays replicated. Applied whenever the
+# pattern matches — on an expert=1 mesh the axis is a no-op.
+MOE_RULES: list[tuple[str, tuple[str | None, ...]]] = [
+    (r".*moe/wi$", ("expert", None, "model")),
+    (r".*moe/wo$", ("expert", "model", None)),
+]
+
 
 def _path_str(path) -> str:
     parts = []
@@ -51,22 +60,34 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
-def _apply_tp(path: str, shape: tuple[int, ...], mesh: Mesh) -> P | None:
-    tp = mesh.shape.get("model", 1)
-    if tp <= 1:
-        return None
-    for pattern, spec in TP_RULES:
+def _match_rules(
+    path: str,
+    shape: tuple[int, ...],
+    mesh: Mesh,
+    rules: list[tuple[str, tuple[str | None, ...]]],
+) -> P | None:
+    for pattern, spec in rules:
         if re.match(pattern, path):
-            # Drop axes that don't divide evenly (falls back to replication
-            # on that dim rather than failing).
+            # Drop axes that are absent/trivial in the mesh or don't divide
+            # evenly (falls back to replication on that dim, not failure).
             fixed = []
             for dim, axis in zip(shape, spec):
-                if axis is not None and dim % mesh.shape[axis] == 0:
+                if (
+                    axis is not None
+                    and mesh.shape.get(axis, 1) > 1
+                    and dim % mesh.shape[axis] == 0
+                ):
                     fixed.append(axis)
                 else:
                     fixed.append(None)
             return P(*fixed)
     return None
+
+
+def _apply_tp(path: str, shape: tuple[int, ...], mesh: Mesh) -> P | None:
+    if mesh.shape.get("model", 1) <= 1:
+        return None
+    return _match_rules(path, shape, mesh, TP_RULES)
 
 
 def _apply_fsdp(spec: P | None, shape: tuple[int, ...], mesh: Mesh) -> P | None:
@@ -105,9 +126,12 @@ def infer_param_specs(
 
     def rule(path, leaf) -> P:
         shape = tuple(np.shape(leaf))
-        spec: P | None = None
-        if use_tp:
-            spec = _apply_tp(_path_str(path), shape, mesh)
+        p = _path_str(path)
+        # Expert weights first: their layout is fixed by the MoE dispatch
+        # regardless of whether TP is on.
+        spec: P | None = _match_rules(p, shape, mesh, MOE_RULES)
+        if spec is None and use_tp:
+            spec = _apply_tp(p, shape, mesh)
         if use_fsdp:
             spec = _apply_fsdp(spec, shape, mesh)
         if spec is None:

@@ -35,13 +35,20 @@ def classification_loss(
     return loss, metrics
 
 
+def mlm_mask(targets: jax.Array) -> jax.Array:
+    """1.0 at masked (predicted) positions, 0.0 elsewhere — the single
+    definition of the '-1 means unmasked' sentinel, shared with the
+    grad-accumulation microbatch weighting (train/step.py)."""
+    return (targets >= 0).astype(jnp.float32)
+
+
 def mlm_loss(
     logits: jax.Array, targets: jax.Array
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Masked-LM CE. ``targets`` holds the original token at masked
     positions and -1 elsewhere."""
     logits = logits.astype(jnp.float32)
-    mask = (targets >= 0).astype(jnp.float32)
+    mask = mlm_mask(targets)
     safe_targets = jnp.maximum(targets, 0)
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, safe_targets)
     denom = jnp.maximum(mask.sum(), 1.0)

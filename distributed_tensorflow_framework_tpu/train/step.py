@@ -60,6 +60,21 @@ class StepBuilder:
         self.mesh = mesh
         self.task = task_for_model(config.model.name)
         self.shard_map_mode = config.train.spmd_mode == "shard_map"
+        if self.shard_map_mode and mesh.shape.get("expert", 1) > 1:
+            raise ValueError(
+                "spmd_mode='shard_map' is the pure-DP reference-parity path; "
+                "expert parallelism (mesh.expert>1) requires spmd_mode='jit'"
+            )
+        if (
+            mesh.shape.get("pipe", 1) > 1
+            or config.model.pipeline_stages > 1
+            or config.model.pipeline_microbatches > 0
+        ):
+            raise NotImplementedError(
+                "pipeline parallelism (mesh.pipe / pipeline_stages / "
+                "pipeline_microbatches) lands in parallel/pipeline.py — "
+                "not wired up yet"
+            )
         # BN axis name: only meaningful under shard_map (under jit, stats
         # are global automatically; see models/layers.py docstring).
         bn_axis = None
@@ -114,7 +129,14 @@ class StepBuilder:
         return bool(jax.tree.leaves(state.batch_stats))
 
     def _loss_and_updates(self, state: TrainState, batch: Any):
-        """Shared fwd/bwd/update body (identical in both SPMD modes)."""
+        """Shared fwd/bwd body (identical in both SPMD modes), with
+        optional gradient accumulation over microbatches."""
+        accum = self.config.train.grad_accum_steps
+        if accum <= 1:
+            return self._microbatch_grads(state, batch)
+        return self._accumulated_grads(state, batch, accum)
+
+    def _microbatch_grads(self, state: TrainState, batch: Any):
         step_rng = prng.fold_in_step(state.rng, state.step)
         has_bn = self._has_bn(state)
         inputs = model_inputs(self.task, batch)
@@ -135,7 +157,15 @@ class StepBuilder:
             else:
                 logits, new_model_state = out, {}
             if self.task == "mlm":
+                moe_aux = None
+                if isinstance(logits, dict):  # MoE model: logits + aux loss
+                    moe_aux = logits.get("moe_aux_loss")
+                    logits = logits["logits"]
                 loss, metrics = losses.mlm_loss(logits, batch["targets"])
+                if moe_aux is not None:
+                    loss = loss + self.config.train.moe_aux_weight * moe_aux
+                    metrics["moe_aux_loss"] = moe_aux
+                    metrics["total_loss"] = loss
             else:
                 aux_logits = None
                 if isinstance(logits, dict):  # Inception aux head
@@ -160,6 +190,67 @@ class StepBuilder:
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         (_, (metrics, new_model_state)), grads = grad_fn(state.params)
+        return grads, metrics, new_model_state
+
+    def _microbatch_weight(self, mb: Any) -> jax.Array:
+        """Each microbatch's share of the full-batch loss denominator.
+
+        Classification losses are means over examples (equal microbatches →
+        equal weights); MLM normalizes by the masked-token count, which
+        varies per microbatch under dynamic masking — weighting by it makes
+        the accumulated gradient exactly the full-batch gradient."""
+        if self.task == "mlm":
+            return losses.mlm_mask(mb["targets"]).sum()
+        return jnp.float32(1.0)
+
+    def _accumulated_grads(self, state: TrainState, batch: Any, accum: int):
+        """Split the batch into `accum` microbatches, scan fwd/bwd
+        accumulating the denominator-weighted gradient sum — numerically
+        the full-batch gradient at 1/accum the activation memory. BN
+        running stats thread through the scan sequentially; the dropout
+        rng differs per microbatch (step folded with the microbatch
+        index). The MoE aux loss becomes a weighted mean of per-microbatch
+        aux losses (routing capacity is per-microbatch under accumulation,
+        so this is the quantity its gradient actually regularizes)."""
+
+        def split(path, x):
+            if x.shape[0] % accum:
+                raise ValueError(
+                    f"grad_accum_steps={accum} does not divide batch leaf "
+                    f"{shd._path_str(path)} of size {x.shape[0]}"
+                )
+            return x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+
+        micro = jax.tree_util.tree_map_with_path(split, batch)
+        first = jax.tree.map(lambda x: x[0], micro)
+        st0 = state.replace(step=state.step * accum)
+        g_shape, m_shape, _ = jax.eval_shape(self._microbatch_grads, st0, first)
+        zeros = lambda tree: jax.tree.map(  # noqa: E731
+            lambda s: jnp.zeros(s.shape, s.dtype), tree
+        )
+
+        def body(carry, xs):
+            stats, grads_sum, metrics_sum, w_sum = carry
+            i, mb = xs
+            st = state.replace(batch_stats=stats, step=state.step * accum + i)
+            g, m, ms = self._microbatch_grads(st, mb)
+            w = self._microbatch_weight(mb)
+            return (
+                ms.get("batch_stats", stats),
+                jax.tree.map(lambda a, b: a + w * b, grads_sum, g),
+                jax.tree.map(lambda a, b: a + w * b, metrics_sum, m),
+                w_sum + w,
+            ), None
+
+        carry0 = (state.batch_stats, zeros(g_shape), zeros(m_shape),
+                  jnp.float32(0.0))
+        (stats, grads, metrics, w_sum), _ = jax.lax.scan(
+            body, carry0, (jnp.arange(accum), micro)
+        )
+        inv = 1.0 / jnp.maximum(w_sum, 1e-9)
+        grads = jax.tree.map(lambda g: g * inv, grads)
+        metrics = jax.tree.map(lambda m: m * inv, metrics)
+        new_model_state = {"batch_stats": stats} if self._has_bn(state) else {}
         return grads, metrics, new_model_state
 
     def _apply_updates(self, state, grads, metrics, new_model_state):
@@ -243,6 +334,8 @@ class StepBuilder:
         inputs = model_inputs(self.task, batch)
         logits = self.model.apply(variables, *inputs, train=False)
         if self.task == "mlm":
+            if isinstance(logits, dict):  # MoE model: drop aux for eval
+                logits = logits["logits"]
             _, metrics = losses.mlm_loss(logits, batch["targets"])
         else:
             _, metrics = losses.classification_loss(logits, batch["label"])

@@ -13,12 +13,14 @@ from distributed_tensorflow_framework_tpu.core.mesh import (
 def test_default_mesh_uses_all_devices(devices):
     mesh = create_mesh()
     assert mesh.devices.size == 8
-    assert dict(mesh.shape) == {"data": 8, "fsdp": 1, "seq": 1, "model": 1}
+    assert dict(mesh.shape) == {"data": 8, "fsdp": 1, "expert": 1, "pipe": 1,
+                                "seq": 1, "model": 1}
 
 
 def test_explicit_axes(devices):
     mesh = create_mesh(MeshConfig(data=2, fsdp=2, model=2, seq=1))
-    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "seq": 1, "model": 2}
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "expert": 1, "pipe": 1,
+                                "seq": 1, "model": 2}
 
 
 def test_free_axis_inference(devices):
