@@ -58,6 +58,25 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             bn_axis_name=bn_axis_name,
         )
     if name in ("bert", "bert_base", "bert-base"):
+        if config.pipeline_stages > 1:
+            from distributed_tensorflow_framework_tpu.parallel.pipeline import (
+                PipelinedBert,
+            )
+
+            return PipelinedBert(
+                vocab_size=config.vocab_size,
+                hidden_size=config.hidden_size,
+                num_layers=config.num_layers,
+                num_heads=config.num_heads,
+                mlp_dim=config.mlp_dim,
+                max_seq_len=config.max_seq_len,
+                dropout_rate=config.dropout_rate,
+                dtype=dtype,
+                mesh=mesh,
+                num_stages=config.pipeline_stages,
+                num_microbatches=config.pipeline_microbatches,
+                attention_impl=config.attention_impl,
+            )
         from distributed_tensorflow_framework_tpu.models.bert import BertForMLM
 
         return BertForMLM(

@@ -115,6 +115,55 @@ class EncoderLayer(nn.Module):
         return nn.LayerNorm(dtype=jnp.float32, name="ln2")(x + y), aux_loss
 
 
+class BertEmbed(nn.Module):
+    """Token + position embedding front. Returns the activations AND the
+    raw embedding table so the caller can tie the MLM projection to it."""
+
+    vocab_size: int
+    hidden_size: int
+    max_seq_len: int
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, *, train: bool = True):
+        s = input_ids.shape[1]
+        embed = nn.Embed(self.vocab_size, self.hidden_size,
+                         param_dtype=jnp.float32, dtype=self.dtype,
+                         embedding_init=nn.initializers.normal(0.02),
+                         name="embed")
+        x = embed(input_ids)
+        pos = self.param(
+            "pos_embedding", nn.initializers.normal(0.02),
+            (self.max_seq_len, self.hidden_size), jnp.float32,
+        )
+        x = x + pos[None, :s, :].astype(self.dtype)
+        x = nn.LayerNorm(dtype=jnp.float32, name="embed_ln")(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return x.astype(self.dtype), embed.embedding
+
+
+class MLMHead(nn.Module):
+    """MLM head: transform → gelu → LN → tied-embedding projection + bias.
+    The embedding table is passed in (tying is the caller's wiring)."""
+
+    vocab_size: int
+    hidden_size: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, embedding):
+        x = nn.Dense(self.hidden_size, dtype=self.dtype,
+                     param_dtype=jnp.float32, kernel_init=dense_kernel_init,
+                     name="mlm_transform")(x)
+        x = nn.gelu(x, approximate=True)
+        x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
+        logits = x.astype(jnp.float32) @ embedding.astype(jnp.float32).T
+        bias = self.param("mlm_bias", nn.initializers.zeros,
+                          (self.vocab_size,), jnp.float32)
+        return logits + bias
+
+
 class BertForMLM(nn.Module):
     vocab_size: int = 30522
     hidden_size: int = 768
@@ -136,20 +185,10 @@ class BertForMLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, *, train: bool = True):
-        b, s = input_ids.shape
-        embed = nn.Embed(self.vocab_size, self.hidden_size,
-                         param_dtype=jnp.float32, dtype=self.dtype,
-                         embedding_init=nn.initializers.normal(0.02),
-                         name="embed")
-        x = embed(input_ids)
-        pos = self.param(
-            "pos_embedding", nn.initializers.normal(0.02),
-            (self.max_seq_len, self.hidden_size), jnp.float32,
-        )
-        x = x + pos[None, :s, :].astype(self.dtype)
-        x = nn.LayerNorm(dtype=jnp.float32, name="embed_ln")(x)
-        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = x.astype(self.dtype)
+        x, emb_table = BertEmbed(
+            self.vocab_size, self.hidden_size, self.max_seq_len,
+            self.dropout_rate, self.dtype, name="embed_block",
+        )(input_ids, train=train)
 
         mask = None
         if attention_mask is not None:
@@ -174,16 +213,8 @@ class BertForMLM(nn.Module):
                 aux_total = aux_total + aux
                 n_moe += 1
 
-        # MLM head: dense → gelu → LN → tied-embedding projection + bias.
-        x = nn.Dense(self.hidden_size, dtype=self.dtype,
-                     param_dtype=jnp.float32, kernel_init=dense_kernel_init,
-                     name="mlm_transform")(x)
-        x = nn.gelu(x, approximate=True)
-        x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
-        logits = embed.attend(x.astype(jnp.float32))
-        bias = self.param("mlm_bias", nn.initializers.zeros,
-                          (self.vocab_size,), jnp.float32)
-        logits = logits + bias
+        logits = MLMHead(self.vocab_size, self.hidden_size, self.dtype,
+                         name="head")(x, emb_table)
         if self.num_experts > 0:
             return {
                 "logits": logits,

@@ -1,0 +1,262 @@
+"""Pipeline parallelism — GPipe-style microbatched stages over ``pipe``.
+
+The reference has no pipeline parallelism (SURVEY.md §2 parallelism
+inventory — PP: NO); this extends the capability surface the TPU way: the
+transformer layer stack is *stacked* (leading dim = num_layers) and that
+dim is sharded over the ``pipe`` mesh axis, so each device owns a
+contiguous stage of layers. A nested shard_map (the same
+inside-jit pattern as parallel/ring.py) runs the circular schedule:
+
+    t:      0    1    2    ...                (M + S - 1 steps total)
+    stage0  mb0  mb1  mb2
+    stage1       mb0  mb1  ...
+    stage2            mb0  ...
+
+Each step every stage applies its layers to its current activation, then
+``ppermute`` rotates activations one stage forward — neighbor ICI traffic
+that XLA overlaps with the next step's compute. The batch stays sharded
+over the data axes (replicated across ``pipe``); microbatching happens on
+the per-shard batch inside the shard_map, so PP composes with DP/FSDP for
+free. Autodiff through the scan+ppermute gives the reverse schedule
+(backward bubbles mirror forward) with no hand-written backward pass.
+
+v1 scope: the pipelined stack itself is sharded ONLY over ``pipe`` —
+combining TP / sequence (ring) / expert parallelism *inside* the pipelined
+layers needs hand-placed collectives in manual mode and is rejected at
+StepBuilder level; dense (embed/head) params still get FSDP/TP from the
+jit path as usual.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+# Param-tree key for the stacked layer stack — parallel/sharding.py keys its
+# P("pipe", None, ...) rule off this prefix.
+STACK_KEY = "pipeline_layers"
+
+
+def _stage_apply(layer: nn.Module, stage_params: Any, x: jax.Array,
+                 mask: jax.Array | None, rng: jax.Array | None,
+                 layer0: jax.Array, *, train: bool) -> jax.Array:
+    """Apply this stage's local layers (leading dim = layers-per-stage)
+    sequentially. ``layer0`` is the stage's first global layer index, used
+    to give every (microbatch, layer) a distinct dropout stream."""
+    n_local = jax.tree.leaves(stage_params)[0].shape[0]
+
+    def body(h, xs):
+        p, i = xs
+        rngs = None
+        if train and rng is not None:
+            rngs = {"dropout": jax.random.fold_in(rng, layer0 + i)}
+        h, _aux = layer.apply({"params": p}, h, mask, train=train, rngs=rngs)
+        return h, None
+
+    x, _ = lax.scan(body, x, (stage_params, jnp.arange(n_local)))
+    return x
+
+
+def pipeline_apply(
+    layer: nn.Module,
+    stacked_params: Any,
+    x: jax.Array,
+    mask: jax.Array | None,
+    rng: jax.Array | None,
+    *,
+    mesh,
+    num_stages: int,
+    num_microbatches: int,
+    train: bool,
+    axis_name: str = "pipe",
+) -> jax.Array:
+    """Run the stacked layer params over ``x`` with the circular schedule.
+
+    ``stacked_params`` leaves have leading dim num_layers (sharded over
+    ``pipe``); ``x`` is (B, S, H) sharded over the data axes. Returns the
+    activations after the full stack, same sharding as ``x``.
+    """
+    s_stages, m = num_stages, num_microbatches
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if num_layers % s_stages:
+        raise ValueError(
+            f"num_layers={num_layers} not divisible by pipeline stages {s_stages}"
+        )
+    layers_per_stage = num_layers // s_stages
+
+    def fn(p_local, x_loc, mask_loc, rng_in):
+        idx = lax.axis_index(axis_name)
+        b_loc = x_loc.shape[0]
+        if b_loc % m:
+            raise ValueError(
+                f"per-shard batch {b_loc} not divisible by "
+                f"num_microbatches={m}"
+            )
+        xm = x_loc.reshape((m, b_loc // m) + x_loc.shape[1:])
+        maskm = None
+        if mask_loc is not None:
+            maskm = mask_loc.reshape((m, b_loc // m) + mask_loc.shape[1:])
+        layer0 = idx * layers_per_stage
+
+        def body(buf, t):
+            # Rotate: stage p's activation moves to stage p+1 (stage 0
+            # receives S-1's garbage, overwritten by the injection below).
+            buf = lax.ppermute(
+                buf, axis_name, [(i, (i + 1) % s_stages) for i in range(s_stages)]
+            )
+            inject = lax.dynamic_index_in_dim(
+                xm, jnp.clip(t, 0, m - 1), 0, keepdims=False
+            )
+            buf = jnp.where((idx == 0) & (t < m), inject, buf)
+            # The microbatch currently in this stage is t - idx.
+            mb_id = jnp.clip(t - idx, 0, m - 1)
+            mb_mask = None
+            if maskm is not None:
+                mb_mask = lax.dynamic_index_in_dim(maskm, mb_id, 0,
+                                                   keepdims=False)
+            mb_rng = None
+            if rng_in is not None:
+                mb_rng = jax.random.fold_in(rng_in, mb_id * num_layers)
+            buf = _stage_apply(layer, p_local, buf, mb_mask, mb_rng, layer0,
+                               train=train)
+            return buf, buf
+
+        buf0 = jnp.zeros_like(xm[0])
+        _, emitted = lax.scan(body, buf0, jnp.arange(m + s_stages - 1))
+        # The last stage emits microbatch t-(S-1) at step t, so its slice
+        # emitted[S-1:] is exactly [mb0..mbM-1]; other stages' slices are
+        # pipeline garbage, dropped by the [-1] selection outside (the
+        # stacked out-spec makes that a one-hop broadcast from the last
+        # stage, not a ring-wide all-reduce of zeros).
+        outs = emitted[s_stages - 1:].reshape(x_loc.shape)
+        return outs[None]
+
+    data_axes = ("data", "fsdp", "expert")
+    x_spec = P(data_axes, *([None] * (x.ndim - 1)))
+    stack_spec = jax.tree.map(
+        lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), stacked_params
+    )
+    mask_spec = None
+    if mask is not None:
+        mask_spec = P(data_axes, *([None] * (mask.ndim - 1)))
+    rng_spec = None if rng is None else P()
+    out_spec = P(axis_name, data_axes, *([None] * (x.ndim - 1)))
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(stack_spec, x_spec, mask_spec, rng_spec),
+        out_specs=out_spec,
+        check_vma=False,
+    )
+    return mapped(stacked_params, x, mask, rng)[-1]
+
+
+class PipelinedBert:
+    """BERT-for-MLM with the encoder stack pipelined over ``pipe``.
+
+    Flax-compatible ``init``/``apply`` surface (duck-typed for
+    train/step.py's StepBuilder) without being an nn.Module: the stacked
+    layer params are built with a vmapped per-layer init and managed as a
+    plain pytree under params["pipeline_layers"], which is what the
+    sharding rules key on.
+    """
+
+    def __init__(self, *, vocab_size: int, hidden_size: int, num_layers: int,
+                 num_heads: int, mlp_dim: int, max_seq_len: int,
+                 dropout_rate: float, dtype: Any, mesh,
+                 num_stages: int, num_microbatches: int,
+                 attention_impl: str = "xla"):
+        if mesh is None:
+            raise ValueError("PipelinedBert needs the physical mesh")
+        if num_layers % num_stages:
+            raise ValueError(
+                f"num_layers={num_layers} must divide into "
+                f"pipeline_stages={num_stages}"
+            )
+        if attention_impl == "ring":
+            raise ValueError(
+                "attention_impl='ring' nests a shard_map inside the pipeline "
+                "shard_map — unsupported; use 'xla' or 'pallas' with PP"
+            )
+        from distributed_tensorflow_framework_tpu.models.bert import (
+            BertEmbed,
+            EncoderLayer,
+            MLMHead,
+        )
+
+        self.num_layers = num_layers
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches or num_stages
+        self.mesh = mesh
+        self.embed = BertEmbed(vocab_size, hidden_size, max_seq_len,
+                               dropout_rate, dtype)
+        self.layer = EncoderLayer(num_heads, mlp_dim, dropout_rate,
+                                  dtype=dtype, attention_impl=attention_impl)
+        self.head = MLMHead(vocab_size, hidden_size, dtype)
+
+    # ---------------------------------------------------- flax-like API --
+    def init(self, rngs: dict, input_ids, attention_mask=None, *,
+             train: bool = False) -> dict:
+        del attention_mask, train
+        params_rng = rngs["params"]
+        k_embed, k_layers, k_head = jax.random.split(params_rng, 3)
+        e_vars = self.embed.init({"params": k_embed}, input_ids, train=False)
+        x, emb_table = self.embed.apply(e_vars, input_ids, train=False)
+
+        keys = jax.random.split(k_layers, self.num_layers)
+        stacked = jax.vmap(
+            lambda k: self.layer.init({"params": k}, x, None,
+                                      train=False)["params"]
+        )(keys)
+
+        h_vars = self.head.init({"params": k_head}, x, emb_table)
+        return {"params": {
+            "embed_block": e_vars["params"],
+            STACK_KEY: stacked,
+            "head": h_vars["params"],
+        }}
+
+    def apply(self, variables: dict, input_ids, attention_mask=None, *,
+              train: bool = True, mutable=False, rngs: dict | None = None):
+        p = variables["params"]
+        embed_rngs = None
+        rng = None
+        if rngs is not None and train:
+            rng = rngs.get("dropout")
+            if rng is not None:
+                embed_rngs = {"dropout": jax.random.fold_in(rng, 0x5A5A)}
+        x, emb_table = self.embed.apply({"params": p["embed_block"]},
+                                        input_ids, train=train,
+                                        rngs=embed_rngs)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        x = pipeline_apply(
+            self.layer, p[STACK_KEY], x, mask, rng,
+            mesh=self.mesh, num_stages=self.num_stages,
+            num_microbatches=self.num_microbatches, train=train,
+        )
+        logits = self.head.apply({"params": p["head"]}, x, emb_table)
+        if mutable:
+            return logits, {}
+        return logits
+
+    # Reference (non-pipelined) forward with the same params — used by the
+    # numerics tests to pin the schedule's correctness.
+    def apply_reference(self, variables: dict, input_ids,
+                        attention_mask=None, *, train: bool = False):
+        p = variables["params"]
+        x, emb_table = self.embed.apply({"params": p["embed_block"]},
+                                        input_ids, train=train)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        for i in range(self.num_layers):
+            layer_p = jax.tree.map(lambda leaf: leaf[i], p[STACK_KEY])
+            x, _ = self.layer.apply({"params": layer_p}, x, mask, train=train)
+        return self.head.apply({"params": p["head"]}, x, emb_table)

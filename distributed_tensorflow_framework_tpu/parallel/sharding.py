@@ -127,7 +127,13 @@ def infer_param_specs(
     def rule(path, leaf) -> P:
         shape = tuple(np.shape(leaf))
         p = _path_str(path)
-        # Expert weights first: their layout is fixed by the MoE dispatch
+        # Pipelined layer stacks (parallel/pipeline.py STACK_KEY): leading
+        # num_layers dim over ``pipe``, nothing else — the stage shard_map
+        # owns these leaves, so FSDP/TP must not touch them. Substring
+        # match so optimizer-state mirrors (mu/nu/...) get the same layout.
+        if "pipeline_layers" in p and len(shape) >= 1:
+            return P("pipe", *([None] * (len(shape) - 1)))
+        # Expert weights next: their layout is fixed by the MoE dispatch
         # regardless of whether TP is on.
         spec: P | None = _match_rules(p, shape, mesh, MOE_RULES)
         if spec is None and use_tp:

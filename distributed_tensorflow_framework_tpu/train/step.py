@@ -65,16 +65,40 @@ class StepBuilder:
                 "spmd_mode='shard_map' is the pure-DP reference-parity path; "
                 "expert parallelism (mesh.expert>1) requires spmd_mode='jit'"
             )
-        if (
-            mesh.shape.get("pipe", 1) > 1
-            or config.model.pipeline_stages > 1
-            or config.model.pipeline_microbatches > 0
-        ):
-            raise NotImplementedError(
-                "pipeline parallelism (mesh.pipe / pipeline_stages / "
-                "pipeline_microbatches) lands in parallel/pipeline.py — "
-                "not wired up yet"
-            )
+        pipe = mesh.shape.get("pipe", 1)
+        stages = config.model.pipeline_stages
+        if pipe > 1 or stages > 1 or config.model.pipeline_microbatches > 0:
+            if stages <= 1:
+                raise ValueError(
+                    "pipeline_microbatches / mesh.pipe>1 require "
+                    "model.pipeline_stages>1"
+                )
+            if "bert" not in config.model.name.lower():
+                raise ValueError(
+                    "pipeline parallelism is only wired for the transformer "
+                    "(bert) models (parallel/pipeline.py)"
+                )
+            if stages != pipe:
+                raise ValueError(
+                    f"model.pipeline_stages={stages} must equal the mesh's "
+                    f"pipe axis size {pipe}"
+                )
+            if self.shard_map_mode:
+                raise ValueError(
+                    "pipeline parallelism runs under spmd_mode='jit' (the "
+                    "stage schedule is its own nested shard_map)"
+                )
+            if (
+                mesh.shape.get("model", 1) > 1
+                or mesh.shape.get("seq", 1) > 1
+                or mesh.shape.get("expert", 1) > 1
+                or config.model.num_experts > 0
+            ):
+                raise ValueError(
+                    "v1 pipeline scope: pipe composes with data/fsdp only — "
+                    "TP/seq/expert parallelism inside the pipelined stack "
+                    "needs manual-mode collectives in the stage body"
+                )
         # BN axis name: only meaningful under shard_map (under jit, stats
         # are global automatically; see models/layers.py docstring).
         bn_axis = None
