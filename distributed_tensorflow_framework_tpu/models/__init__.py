@@ -59,6 +59,12 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
         )
     if name in ("bert", "bert_base", "bert-base"):
         if config.pipeline_stages > 1:
+            if config.num_experts > 0:
+                raise ValueError(
+                    "MoE inside the pipelined stack is unsupported "
+                    "(num_experts>0 with pipeline_stages>1) — the stage "
+                    "shard_map would need manual expert collectives"
+                )
             from distributed_tensorflow_framework_tpu.parallel.pipeline import (
                 PipelinedBert,
             )

@@ -158,10 +158,14 @@ class MLMHead(nn.Module):
                      name="mlm_transform")(x)
         x = nn.gelu(x, approximate=True)
         x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
-        logits = x.astype(jnp.float32) @ embedding.astype(jnp.float32).T
+        # Vocab projection in the compute dtype (bf16 on TPU): this is the
+        # model's largest matmul (H×V) — running it f32 would double its
+        # MXU cost. The f32 promotion happens at the bias add; the loss
+        # does its softmax in f32 regardless.
+        logits = x.astype(self.dtype) @ embedding.astype(self.dtype).T
         bias = self.param("mlm_bias", nn.initializers.zeros,
                           (self.vocab_size,), jnp.float32)
-        return logits + bias
+        return logits.astype(jnp.float32) + bias
 
 
 class BertForMLM(nn.Module):

@@ -136,7 +136,9 @@ def pipeline_apply(
         outs = emitted[s_stages - 1:].reshape(x_loc.shape)
         return outs[None]
 
-    data_axes = ("data", "fsdp", "expert")
+    from distributed_tensorflow_framework_tpu.core.mesh import batch_spec
+
+    data_axes = batch_spec(mesh)[0]  # the canonical batch-sharding axes
     x_spec = P(data_axes, *([None] * (x.ndim - 1)))
     stack_spec = jax.tree.map(
         lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), stacked_params

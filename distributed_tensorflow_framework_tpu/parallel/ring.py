@@ -92,8 +92,11 @@ def ring_attention_sharded(q, k, v, *, mesh, mask=None, axis_name: str = "seq"):
                          jnp.finfo(jnp.float32).min).astype(jnp.float32)
     else:
         bias = jnp.zeros((b, s), jnp.float32)
-    spec = P(("data", "fsdp"), axis_name, None, None)
-    bias_spec = P(("data", "fsdp"), axis_name)
+    from distributed_tensorflow_framework_tpu.core.mesh import batch_spec
+
+    data_axes = batch_spec(mesh)[0]  # the canonical batch-sharding axes
+    spec = P(data_axes, axis_name, None, None)
+    bias_spec = P(data_axes, axis_name)
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=axis_name),
         mesh=mesh,

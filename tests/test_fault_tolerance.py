@@ -1,0 +1,84 @@
+"""Failure detection / recovery (SURVEY.md §5).
+
+The reference's contract: MonitoredTrainingSession auto-restores from the
+last checkpoint when a killed job is relaunched — no elasticity, just
+kill → relaunch → resume. Same contract here: a training process is
+SIGKILLed mid-run (a real kill, not a clean exit), the identical command
+is relaunched, and it must restore the latest checkpoint and finish.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+DRIVER = """
+import jax; jax.config.update('jax_platforms','cpu')
+from distributed_tensorflow_framework_tpu.cli.train import main
+main(['--set','model.name=lenet5','--set','model.dtype=float32',
+      '--set','data.name=synthetic_images','--set','data.image_size=28',
+      '--set','data.channels=1','--set','data.global_batch_size=64',
+      '--set','mesh.data=8',
+      '--set','optimizer.name=sgd_momentum','--set','optimizer.learning_rate=0.01',
+      '--set','train.total_steps={steps}','--set','train.log_interval=20',
+      '--set','train.eval_steps=0',
+      '--set','checkpoint.directory={ckpt}',
+      '--set','checkpoint.save_interval_steps=20',
+      '--set','checkpoint.async_save=false'])
+"""
+
+
+def _launch(ckpt_dir: str, steps: int) -> subprocess.Popen:
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS",)}
+    env["JAX_PLATFORMS"] = ""
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8").strip()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-c", DRIVER.format(ckpt=ckpt_dir, steps=steps)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=repo_root,
+    )
+
+
+def _wait_for_checkpoint(ckpt_dir: str, timeout: float = 240.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.isdir(ckpt_dir):
+            steps = [d for d in os.listdir(ckpt_dir) if d.isdigit()]
+            if steps:
+                return
+        time.sleep(0.5)
+    raise TimeoutError(f"no checkpoint appeared in {ckpt_dir}")
+
+
+@pytest.mark.slow
+def test_sigkill_and_relaunch_resumes(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    steps = 4000  # far more than survive the kill window
+
+    victim = _launch(ckpt_dir, steps)
+    try:
+        _wait_for_checkpoint(ckpt_dir)
+    finally:
+        if victim.poll() is None:
+            victim.send_signal(signal.SIGKILL)
+    out, _ = victim.communicate(timeout=60)
+    assert victim.returncode != 0, (
+        f"victim survived to completion — kill landed too late:\n{out[-2000:]}"
+    )
+
+    # Relaunch the identical command with an achievable horizon: it must
+    # auto-restore (MonitoredTrainingSession contract) and run to the end.
+    survivor = _launch(ckpt_dir, 60)
+    out, _ = survivor.communicate(timeout=420)
+    assert survivor.returncode == 0, out[-3000:]
+    assert "Restored checkpoint at step" in out, out[-3000:]
+    assert "final train metrics" in out, out[-3000:]
