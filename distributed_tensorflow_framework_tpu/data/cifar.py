@@ -15,7 +15,11 @@ import pickle
 import numpy as np
 
 from distributed_tensorflow_framework_tpu.core.config import DataConfig
-from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset, host_batch_size
+from distributed_tensorflow_framework_tpu.data.pipeline import (
+    HostDataset,
+    host_batch_size,
+    image_np_dtype,
+)
 from distributed_tensorflow_framework_tpu.data import synthetic
 
 log = logging.getLogger(__name__)
@@ -43,6 +47,7 @@ def make_cifar10(config: DataConfig, process_index: int, process_count: int,
     images, labels = _load(config.data_dir, train)
     b = host_batch_size(config.global_batch_size, process_count)
     n = len(images)
+    out_dtype = image_np_dtype(config.image_dtype)
 
     def standardize(batch):
         mean = batch.mean(axis=(1, 2, 3), keepdims=True)
@@ -77,14 +82,15 @@ def make_cifar10(config: DataConfig, process_index: int, process_count: int,
                         out[j] = img[:, ::-1] if flips[j] else img
                     x = out
                 state["batch_in_epoch"] = i + 1
-                yield {"image": standardize(x), "label": labels[idx]}
+                yield {"image": standardize(x).astype(out_dtype, copy=False),
+                       "label": labels[idx]}
             state["epoch"] += 1
             state["batch_in_epoch"] = 0
 
     return HostDataset(
         make_iter,
         element_spec={
-            "image": ((b, 32, 32, 3), np.float32),
+            "image": ((b, 32, 32, 3), out_dtype),
             "label": ((b,), np.int32),
         },
         initial_state={"epoch": 0, "batch_in_epoch": 0},

@@ -20,7 +20,11 @@ import os
 import numpy as np
 
 from distributed_tensorflow_framework_tpu.core.config import DataConfig
-from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset, host_batch_size
+from distributed_tensorflow_framework_tpu.data.pipeline import (
+    HostDataset,
+    host_batch_size,
+    image_np_dtype,
+)
 from distributed_tensorflow_framework_tpu.data import synthetic
 from distributed_tensorflow_framework_tpu.data.tfdata import tfdata_to_hostdataset
 
@@ -91,6 +95,8 @@ def make_imagenet(config: DataConfig, process_index: int, process_count: int,
             image = tf.image.central_crop(image, 0.875)
             image = tf.image.resize(image, [size, size], method="bicubic")
         image = (tf.cast(image, tf.float32) - MEAN_RGB) / STDDEV_RGB
+        if config.image_dtype == "bfloat16":
+            image = tf.cast(image, tf.bfloat16)
         return {"image": image, "label": label}
 
     def make_ds(seed: int):
@@ -125,7 +131,7 @@ def make_imagenet(config: DataConfig, process_index: int, process_count: int,
     return tfdata_to_hostdataset(
         make_ds,
         element_spec={
-            "image": ((b, size, size, 3), np.float32),
+            "image": ((b, size, size, 3), image_np_dtype(config.image_dtype)),
             "label": ((b,), np.int32),
         },
     )

@@ -13,7 +13,11 @@ import os
 import numpy as np
 
 from distributed_tensorflow_framework_tpu.core.config import DataConfig
-from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset, host_batch_size
+from distributed_tensorflow_framework_tpu.data.pipeline import (
+    HostDataset,
+    host_batch_size,
+    image_np_dtype,
+)
 from distributed_tensorflow_framework_tpu.data import synthetic
 
 log = logging.getLogger(__name__)
@@ -40,6 +44,7 @@ def make_mnist(config: DataConfig, process_index: int, process_count: int,
 
     b = host_batch_size(config.global_batch_size, process_count)
     n = len(images)
+    out_dtype = image_np_dtype(config.image_dtype)
 
     def make_iter(state):
         state.setdefault("epoch", 0)
@@ -54,14 +59,15 @@ def make_mnist(config: DataConfig, process_index: int, process_count: int,
             for i in range(start, batches):
                 idx = shard[i * b:(i + 1) * b]
                 state["batch_in_epoch"] = i + 1
-                yield {"image": images[idx], "label": labels[idx]}
+                yield {"image": images[idx].astype(out_dtype, copy=False),
+                       "label": labels[idx]}
             state["epoch"] += 1
             state["batch_in_epoch"] = 0
 
     return HostDataset(
         make_iter,
         element_spec={
-            "image": ((b, 28, 28, 1), np.float32),
+            "image": ((b, 28, 28, 1), out_dtype),
             "label": ((b,), np.int32),
         },
         initial_state={"epoch": 0, "batch_in_epoch": 0},

@@ -17,6 +17,21 @@ import numpy as np
 Batch = Mapping[str, np.ndarray]
 
 
+def image_np_dtype(image_dtype: str) -> np.dtype:
+    """Numpy dtype for DataConfig.image_dtype ('float32' | 'bfloat16').
+
+    bfloat16 infeed halves image HBM traffic — the ResNet-50 step is
+    HBM-bandwidth-bound (bench.py) — while augmentation math stays f32.
+    """
+    if image_dtype == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    if image_dtype in ("float32", "f32"):
+        return np.dtype(np.float32)
+    raise ValueError(f"Unsupported image_dtype {image_dtype!r}")
+
+
 def host_batch_size(global_batch_size: int, process_count: int) -> int:
     """This host's share of the global batch; rejects non-divisible splits
     (a silent floor-divide would shrink the actual global batch and skew

@@ -13,7 +13,10 @@ from typing import Any
 import numpy as np
 
 from distributed_tensorflow_framework_tpu.core.config import DataConfig
-from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
+from distributed_tensorflow_framework_tpu.data.pipeline import (
+    HostDataset,
+    image_np_dtype,
+)
 
 
 def _host_batch(config: DataConfig, process_count: int) -> int:
@@ -32,6 +35,7 @@ def synthetic_images(
     h = w = config.image_size
     c = config.channels
     num_classes = config.num_classes
+    out_dtype = image_np_dtype(config.image_dtype)
 
     def make_iter(state: dict[str, Any]):
         state.setdefault("step", 0)
@@ -46,12 +50,12 @@ def synthetic_images(
                 images.reshape(b, -1)[:, :num_classes], axis=1
             ).astype(np.int32)
             state["step"] += 1
-            yield {"image": images, "label": labels}
+            yield {"image": images.astype(out_dtype, copy=False), "label": labels}
 
     return HostDataset(
         make_iter,
         element_spec={
-            "image": ((b, h, w, c), np.float32),
+            "image": ((b, h, w, c), out_dtype),
             "label": ((b,), np.int32),
         },
         initial_state={"step": 0},

@@ -14,7 +14,7 @@ with ONE compiled SPMD program in two selectable flavors:
     BN (the reference's exact semantics) is expressible.
 
 Both modes produce bitwise-identical parameter trajectories for BN-free
-models (tested in tests/test_parallel.py).
+models (tested in tests/test_train_lenet.py::test_jit_and_shard_map_agree).
 """
 
 from __future__ import annotations

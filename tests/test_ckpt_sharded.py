@@ -1,0 +1,80 @@
+"""Checkpoint roundtrip for non-trivially-sharded states.
+
+test_ckpt.py pins exact resume for the replicated-param LeNet; these pin
+save/restore when params are actually sharded — MoE expert weights over
+``expert`` and pipelined layer stacks over ``pipe`` — including restore
+into freshly-initialized (different-valued) state of the same topology.
+"""
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_framework_tpu.ckpt import CheckpointManager
+from distributed_tensorflow_framework_tpu.core.config import load_config
+from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+from distributed_tensorflow_framework_tpu.data import get_dataset
+from distributed_tensorflow_framework_tpu.data.infeed import to_global
+from distributed_tensorflow_framework_tpu.train.step import StepBuilder
+
+
+def _roundtrip(cfg, tmp_path):
+    mesh = create_mesh(cfg.mesh)
+    builder = StepBuilder(cfg, mesh)
+    ds = get_dataset(cfg.data)
+    batch = to_global(next(ds), mesh)
+    state = builder.init_state(0, batch)
+    step = builder.make_train_step(batch)
+    state, _ = step(state, batch)
+
+    cfg.checkpoint.directory = str(tmp_path / "ckpt")
+    cfg.checkpoint.async_save = False
+    mgr = CheckpointManager(cfg.checkpoint)
+    assert mgr.save(1, state)
+    mgr.wait_until_finished()
+
+    # Restore into a DIFFERENT seed's state: every leaf must come back
+    # equal to the saved run, with the template's shardings intact.
+    template = builder.init_state(123, batch)
+    restored = mgr.restore(template)
+    mgr.close()
+    assert restored is not None
+    for a, b in zip(jax.tree.leaves(jax.device_get(state.params)),
+                    jax.tree.leaves(jax.device_get(restored.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Shardings preserved (spot-check a known-sharded leaf).
+    return state, restored
+
+
+def test_moe_state_roundtrip(devices, tmp_path):
+    cfg = load_config(base={
+        "name": "ckpt-moe",
+        "mesh": {"data": 2, "expert": 2, "model": 2},
+        "model": {"name": "bert", "vocab_size": 128, "hidden_size": 32,
+                  "num_layers": 2, "num_heads": 2, "mlp_dim": 64,
+                  "max_seq_len": 32, "dtype": "float32", "num_experts": 4},
+        "data": {"name": "synthetic_mlm", "vocab_size": 128,
+                 "global_batch_size": 8, "seq_len": 32},
+        "optimizer": {"name": "adamw", "learning_rate": 1e-3},
+        "train": {"total_steps": 2},
+    })
+    state, restored = _roundtrip(cfg, tmp_path)
+    wi = restored.params["layer1"]["moe"]["wi"]
+    assert wi.sharding.spec[0] == "expert", wi.sharding.spec
+
+
+def test_pipelined_state_roundtrip(devices, tmp_path):
+    cfg = load_config(base={
+        "name": "ckpt-pp",
+        "mesh": {"data": 2, "pipe": 4},
+        "model": {"name": "bert", "vocab_size": 64, "hidden_size": 32,
+                  "num_layers": 4, "num_heads": 2, "mlp_dim": 64,
+                  "max_seq_len": 16, "dtype": "float32",
+                  "pipeline_stages": 4},
+        "data": {"name": "synthetic_mlm", "vocab_size": 64,
+                 "global_batch_size": 16, "seq_len": 16},
+        "optimizer": {"name": "sgd_momentum", "learning_rate": 0.1},
+        "train": {"total_steps": 2},
+    })
+    state, restored = _roundtrip(cfg, tmp_path)
+    leaf = jax.tree.leaves(restored.params["pipeline_layers"])[0]
+    assert leaf.sharding.spec[0] == "pipe", leaf.sharding.spec

@@ -50,6 +50,47 @@ def test_lenet_loss_decreases(devices, spmd_mode, tmp_path):
     )
 
 
+def test_bfloat16_infeed(devices):
+    """data.image_dtype=bfloat16 (the HBM-bandwidth lever, bench.py) must
+    flow through pipeline → infeed → step."""
+    cfg = lenet_config(**{"train.total_steps": 5, "data.image_dtype": "bfloat16"})
+    trainer = Trainer(cfg)
+    metrics = trainer.train()
+    assert np.isfinite(metrics["loss"])
+
+
+def test_replica_count_invariance(devices):
+    """Sync-DP invariant (SURVEY.md §4): N replicas on global batch B must
+    match 1 replica on batch B — the grad mean over a sharded batch equals
+    the single-device mean."""
+    import jax
+
+    from distributed_tensorflow_framework_tpu.core.config import MeshConfig
+    from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+    from distributed_tensorflow_framework_tpu.data.infeed import to_global
+    from distributed_tensorflow_framework_tpu.train.step import StepBuilder
+
+    cfg = lenet_config()
+    rng = np.random.default_rng(0)
+    host = {
+        "image": rng.standard_normal((64, 28, 28, 1)).astype(np.float32),
+        "label": rng.integers(0, 10, 64).astype(np.int32),
+    }
+    results = {}
+    for n in (1, 8):
+        mesh = create_mesh(MeshConfig(data=n), devices=jax.devices()[:n])
+        builder = StepBuilder(cfg, mesh)
+        batch = to_global(host, mesh)
+        state = builder.init_state(0, batch)
+        step = builder.make_train_step(batch)
+        for _ in range(3):
+            state, _ = step(state, batch)
+        results[n] = jax.device_get(state.params)
+
+    for a, b in zip(jax.tree.leaves(results[1]), jax.tree.leaves(results[8])):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
 def test_jit_and_shard_map_agree(devices):
     """Sync-DP invariant (SURVEY.md §4 numerics parity): the explicit
     shard_map pipeline and the implicit jit pipeline produce the same
