@@ -114,6 +114,11 @@ class OptimizerConfig:
     eps: float = 1e-8
     weight_decay: float = 0.0
     grad_clip_norm: float = 0.0  # 0 disables
+    # Exponential moving average of params (0 disables). Uses the
+    # tf.train.ExponentialMovingAverage warmup schedule
+    # min(decay, (1+step)/(10+step)); eval reads the averaged params
+    # unless train.eval_use_ema is false.
+    ema_decay: float = 0.0
     # Shard optimizer state over the fsdp axis even when params are replicated
     # (cross-replica weight-update sharding; cf. SURVEY.md §7 hard part 5).
     shard_opt_state: bool = False
@@ -201,8 +206,13 @@ class TrainConfig:
     # per-replica code with hand-placed collectives (the closer analogue of
     # the reference's SyncReplicasOptimizer + NCCL pipeline).
     spmd_mode: str = "jit"
+    # Wire dtype for the explicit gradient all-reduce (shard_map mode only):
+    # "" keeps the gradient dtype; "bfloat16" halves collective bytes
+    # (EQuARX-style compression — most useful over DCN on multislice).
+    grad_allreduce_dtype: str = ""
     nan_guard: bool = True
     label_smoothing: float = 0.0
+    eval_use_ema: bool = True  # only meaningful with optimizer.ema_decay>0
     # Weight of the MoE load-balancing aux loss (Switch Transformer uses 0.01).
     moe_aux_weight: float = 0.01
     # Gradient accumulation: split each global batch into this many

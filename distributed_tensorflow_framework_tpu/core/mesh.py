@@ -57,20 +57,8 @@ def initialize_distributed() -> None:
         )
 
 
-def create_mesh(
-    config: MeshConfig | None = None,
-    *,
-    devices: Sequence[jax.Device] | None = None,
-) -> Mesh:
-    """Build the logical mesh from a MeshConfig over available devices.
-
-    Axes with size 1 are kept in the mesh (size-1 axes are free) so that
-    sharding rules can always name all four canonical axes regardless of the
-    physical topology.
-    """
-    config = config or MeshConfig()
-    devs = list(devices if devices is not None else jax.devices())
-    n = len(devs)
+def _resolve_axis_sizes(config: MeshConfig, n: int) -> dict[str, int]:
+    """Fill the single -1 axis and validate the product against n."""
     sizes = config.axis_sizes()
     fixed = {k: v for k, v in sizes.items() if v != -1}
     fixed_prod = int(np.prod(list(fixed.values()))) if fixed else 1
@@ -89,7 +77,73 @@ def create_mesh(
         raise ValueError(
             f"Mesh {sizes} needs {total} devices but {n} are available"
         )
+    return sizes
+
+
+def hybrid_mesh_shapes(
+    sizes: dict[str, int], num_slices: int
+) -> tuple[dict[str, int], dict[str, int]]:
+    """Split logical axis sizes into (per-slice ICI, cross-slice DCN) parts.
+
+    Multislice placement policy: outer axes span slices first — ``data``
+    (one grad all-reduce per step tolerates DCN latency), then ``fsdp``
+    (for FSDP-dominant layouts), and so on down MESH_AXES order — while
+    everything still fitting intra-slice stays on ICI. The slice count
+    must factor into the axis sizes walked in that order.
+    """
+    import math
+
+    ici = dict(sizes)
+    dcn = {a: 1 for a in sizes}
+    remaining = num_slices
+    for axis in MESH_AXES:
+        if remaining == 1:
+            break
+        f = math.gcd(ici[axis], remaining)
+        if f > 1:
+            dcn[axis] = f
+            ici[axis] //= f
+            remaining //= f
+    if remaining != 1:
+        raise ValueError(
+            f"slice count {num_slices} does not factor into the mesh axes "
+            f"{sizes} (walked in {MESH_AXES} order) — no DCN-spanning "
+            f"layout exists"
+        )
+    return ici, dcn
+
+
+def create_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the logical mesh from a MeshConfig over available devices.
+
+    Axes with size 1 are kept in the mesh (size-1 axes are free) so that
+    sharding rules can always name all canonical axes regardless of the
+    physical topology. On a multislice TPU deployment (devices report
+    distinct ``slice_index``), the mesh is built hybrid: ``data`` replicas
+    span slices over DCN, every other axis stays within a slice on ICI.
+    """
+    config = config or MeshConfig()
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    sizes = _resolve_axis_sizes(config, n)
     shape = tuple(sizes[a] for a in MESH_AXES)
+
+    slice_ids = {getattr(d, "slice_index", 0) for d in devs}
+    if len(slice_ids) > 1:
+        from jax.experimental import mesh_utils
+
+        ici, dcn = hybrid_mesh_shapes(sizes, len(slice_ids))
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici[a] for a in MESH_AXES),
+            tuple(dcn[a] for a in MESH_AXES),
+            devices=devs,
+        )
+        return Mesh(dev_array, MESH_AXES)
+
     dev_array = np.asarray(devs).reshape(shape)
     return Mesh(dev_array, MESH_AXES)
 

@@ -20,9 +20,28 @@ from jax import lax
 DATA_AXES = ("data", "fsdp")
 
 
-def allreduce_gradients(grads: Any, axis_names: Sequence[str] = DATA_AXES) -> Any:
-    """Mean-reduce gradients across data-parallel replicas (sync-DP core)."""
-    return jax.tree.map(lambda g: lax.pmean(g, axis_names), grads)
+def allreduce_gradients(
+    grads: Any,
+    axis_names: Sequence[str] = DATA_AXES,
+    *,
+    compute_dtype: Any = None,
+) -> Any:
+    """Mean-reduce gradients across data-parallel replicas (sync-DP core).
+
+    ``compute_dtype`` (e.g. jnp.bfloat16) compresses the all-reduce wire
+    format: grads are cast down before the pmean and restored after —
+    halving collective bytes, which matters most when the reduction spans
+    DCN (multislice). This is the block-free core of the EQuARX idea
+    (PAPERS.md: quantized all-reduce); the mean itself still accumulates
+    in the reduced dtype, so reserve it for bandwidth-bound regimes.
+    """
+    if compute_dtype is None:
+        return jax.tree.map(lambda g: lax.pmean(g, axis_names), grads)
+
+    def reduce(g):
+        return lax.pmean(g.astype(compute_dtype), axis_names).astype(g.dtype)
+
+    return jax.tree.map(reduce, grads)
 
 
 def psum(x: Any, axis_names: Sequence[str] | str) -> Any:

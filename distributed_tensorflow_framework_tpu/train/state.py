@@ -23,14 +23,19 @@ class TrainState:
     batch_stats: Any         # BN running stats ({} for BN-free models)
     opt_state: optax.OptState
     rng: jax.Array           # dropout/noise root key (device-side)
+    # Exponential moving average of params ({} when disabled) — the
+    # tf.train.ExponentialMovingAverage of the reference recipe class;
+    # eval reads these when optimizer.ema_decay > 0.
+    ema_params: Any = flax.struct.field(default_factory=dict)
 
     @classmethod
     def create(cls, *, params, batch_stats, tx: optax.GradientTransformation,
-               rng: jax.Array) -> "TrainState":
+               rng: jax.Array, ema: bool = False) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             batch_stats=batch_stats,
             opt_state=tx.init(params),
             rng=rng,
+            ema_params=jax.tree.map(jnp.copy, params) if ema else {},
         )

@@ -60,6 +60,12 @@ class StepBuilder:
         self.mesh = mesh
         self.task = task_for_model(config.model.name)
         self.shard_map_mode = config.train.spmd_mode == "shard_map"
+        if config.train.grad_allreduce_dtype and not self.shard_map_mode:
+            raise ValueError(
+                "train.grad_allreduce_dtype only applies to the explicit "
+                "collective path — set train.spmd_mode='shard_map' (under "
+                "'jit' XLA owns the gradient reduction wire format)"
+            )
         if self.shard_map_mode and mesh.shape.get("expert", 1) > 1:
             raise ValueError(
                 "spmd_mode='shard_map' is the pure-DP reference-parity path; "
@@ -122,7 +128,8 @@ class StepBuilder:
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         return TrainState.create(
-            params=params, batch_stats=batch_stats, tx=self.tx, rng=dropout_root
+            params=params, batch_stats=batch_stats, tx=self.tx,
+            rng=dropout_root, ema=self.config.optimizer.ema_decay > 0,
         )
 
     def state_specs(self, sample_batch: Any) -> Any:
@@ -285,11 +292,24 @@ class StepBuilder:
         metrics = dict(metrics)
         metrics["grad_norm"] = coll.global_norm(grads)
         metrics["learning_rate"] = self.schedule(state.step)
+        ema_decay = self.config.optimizer.ema_decay
+        if ema_decay > 0:
+            # tf.train.ExponentialMovingAverage(num_updates=step) schedule:
+            # early steps track params closely, late steps converge to decay.
+            t = state.step.astype(jnp.float32)
+            d = jnp.minimum(ema_decay, (1.0 + t) / (10.0 + t))
+            new_ema = jax.tree.map(
+                lambda e, p: e * d + p.astype(e.dtype) * (1.0 - d),
+                state.ema_params, new_params,
+            )
+        else:
+            new_ema = state.ema_params
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
             opt_state=new_opt_state,
             batch_stats=new_model_state.get("batch_stats", state.batch_stats),
+            ema_params=new_ema,
         )
         return new_state, metrics
 
@@ -302,8 +322,13 @@ class StepBuilder:
     def _train_step_replica(self, state: TrainState, batch: Any):
         grads, metrics, new_model_state = self._loss_and_updates(state, batch)
         # Explicit sync-DP: mean grads across replicas — the NCCL all-reduce
-        # site of the reference (SURVEY.md §2 row 3).
-        grads = coll.allreduce_gradients(grads, DATA_AXES)
+        # site of the reference (SURVEY.md §2 row 3). Optionally compressed
+        # to a narrower wire dtype (train.grad_allreduce_dtype).
+        wire = self.config.train.grad_allreduce_dtype
+        grads = coll.allreduce_gradients(
+            grads, DATA_AXES,
+            compute_dtype=jnp.dtype(wire) if wire else None,
+        )
         metrics = coll.pmean(metrics, DATA_AXES)
         if self._has_bn(state):
             # Running stats were updated from per/cross-replica batch stats;
@@ -352,7 +377,12 @@ class StepBuilder:
     # -------------------------------------------------------- eval step --
     def _eval_step(self, state: TrainState, batch: Any):
         has_bn = self._has_bn(state)
-        variables = {"params": state.params}
+        use_ema = (
+            self.config.optimizer.ema_decay > 0
+            and self.config.train.eval_use_ema
+            and jax.tree.leaves(state.ema_params)
+        )
+        variables = {"params": state.ema_params if use_ema else state.params}
         if has_bn:
             variables["batch_stats"] = state.batch_stats
         inputs = model_inputs(self.task, batch)

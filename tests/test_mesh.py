@@ -33,6 +33,30 @@ def test_bad_shape_raises(devices):
         create_mesh(MeshConfig(data=3, model=2))  # 6 != 8
 
 
+def test_hybrid_shapes():
+    from distributed_tensorflow_framework_tpu.core.mesh import hybrid_mesh_shapes
+
+    sizes = {"data": 8, "fsdp": 2, "expert": 1, "pipe": 1, "seq": 1,
+             "model": 4}
+    ici, dcn = hybrid_mesh_shapes(sizes, 4)
+    assert ici == {"data": 2, "fsdp": 2, "expert": 1, "pipe": 1, "seq": 1,
+                   "model": 4}
+    assert dcn == {"data": 4, "fsdp": 1, "expert": 1, "pipe": 1, "seq": 1,
+                   "model": 1}
+    # FSDP-dominant layout: slices spill onto fsdp when data can't cover.
+    ici2, dcn2 = hybrid_mesh_shapes(
+        {"data": 2, "fsdp": 8, "expert": 1, "pipe": 1, "seq": 1, "model": 1},
+        4,
+    )
+    assert dcn2 == {"data": 2, "fsdp": 2, "expert": 1, "pipe": 1, "seq": 1,
+                    "model": 1}
+    assert ici2 == {"data": 1, "fsdp": 4, "expert": 1, "pipe": 1, "seq": 1,
+                    "model": 1}
+    with pytest.raises(ValueError, match="does not factor"):
+        hybrid_mesh_shapes({"data": 3, "fsdp": 1, "expert": 1, "pipe": 1,
+                            "seq": 1, "model": 1}, 4)
+
+
 def test_runtime(devices):
     rt = initialize_runtime(MeshConfig(data=8))
     assert rt.is_chief
