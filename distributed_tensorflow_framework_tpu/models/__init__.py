@@ -33,21 +33,18 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
         from distributed_tensorflow_framework_tpu.models.lenet import LeNet5
 
         return LeNet5(num_classes=config.num_classes, dtype=dtype)
-    if name in ("resnet50", "resnet-50"):
-        from distributed_tensorflow_framework_tpu.models.resnet import ResNet50
+    import re
 
-        return ResNet50(
+    m = re.fullmatch(r"resnet-?(\d+)(_cifar|-cifar)?", name)
+    if m:
+        from distributed_tensorflow_framework_tpu.models.resnet import make_resnet
+
+        return make_resnet(
+            int(m.group(1)),
             num_classes=config.num_classes,
             dtype=dtype,
             bn_axis_name=bn_axis_name,
-        )
-    if name in ("resnet50_cifar", "resnet-50-cifar"):
-        from distributed_tensorflow_framework_tpu.models.resnet import ResNet50Cifar
-
-        return ResNet50Cifar(
-            num_classes=config.num_classes,
-            dtype=dtype,
-            bn_axis_name=bn_axis_name,
+            cifar_stem=m.group(2) is not None,
         )
     if name in ("inception_v3", "inception-v3", "inceptionv3"):
         from distributed_tensorflow_framework_tpu.models.inception import InceptionV3

@@ -55,11 +55,38 @@ class Bottleneck(nn.Module):
         return nn.relu(residual + y)
 
 
+class BasicBlock(nn.Module):
+    """Two-3×3 residual block — the ResNet-18/34 unit."""
+
+    features: int
+    strides: tuple[int, int] = (1, 1)
+    train: bool = True
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = ConvBN(self.features, (3, 3), strides=self.strides,
+                   train=self.train, dtype=self.dtype,
+                   bn_axis_name=self.bn_axis_name, name="conv1")(x)
+        y = ConvBN(self.features, (3, 3), use_relu=False, train=self.train,
+                   dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+                   zero_init_gamma=True, name="conv2")(y)
+        if residual.shape != y.shape:
+            residual = ConvBN(self.features, (1, 1), strides=self.strides,
+                              use_relu=False, train=self.train,
+                              dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+                              name="proj")(residual)
+        return nn.relu(residual + y)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     num_classes: int = 1000
     width: int = 64
     cifar_stem: bool = False
+    basic_block: bool = False  # True → ResNet-18/34 topology
     dtype: Any = jnp.bfloat16
     bn_axis_name: Any = None
 
@@ -74,10 +101,11 @@ class ResNet(nn.Module):
                        dtype=self.dtype, bn_axis_name=self.bn_axis_name,
                        name="stem")(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block_cls = BasicBlock if self.basic_block else Bottleneck
         for stage, size in enumerate(self.stage_sizes):
             for block in range(size):
                 strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
-                x = Bottleneck(
+                x = block_cls(
                     self.width * 2 ** stage,
                     strides=strides,
                     train=train,
@@ -92,13 +120,35 @@ class ResNet(nn.Module):
         return x
 
 
+# Canonical depth → (stage sizes, block type). Param counts match
+# torchvision's resnetN exactly (pinned in tests/test_models_big.py).
+RESNET_DEPTHS: dict[int, tuple[tuple[int, ...], bool]] = {
+    18: ((2, 2, 2, 2), True),
+    34: ((3, 4, 6, 3), True),
+    50: ((3, 4, 6, 3), False),
+    101: ((3, 4, 23, 3), False),
+    152: ((3, 8, 36, 3), False),
+}
+
+
+def make_resnet(depth: int, num_classes: int = 1000,
+                dtype: Any = jnp.bfloat16, bn_axis_name: Any = None,
+                cifar_stem: bool = False) -> ResNet:
+    if depth not in RESNET_DEPTHS:
+        raise ValueError(
+            f"resnet depth {depth} not in {sorted(RESNET_DEPTHS)}"
+        )
+    stages, basic = RESNET_DEPTHS[depth]
+    return ResNet(stage_sizes=stages, num_classes=num_classes,
+                  basic_block=basic, cifar_stem=cifar_stem, dtype=dtype,
+                  bn_axis_name=bn_axis_name)
+
+
 def ResNet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
              bn_axis_name: Any = None) -> ResNet:
-    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
-                  dtype=dtype, bn_axis_name=bn_axis_name)
+    return make_resnet(50, num_classes, dtype, bn_axis_name)
 
 
 def ResNet50Cifar(num_classes: int = 10, dtype: Any = jnp.bfloat16,
                   bn_axis_name: Any = None) -> ResNet:
-    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
-                  cifar_stem=True, dtype=dtype, bn_axis_name=bn_axis_name)
+    return make_resnet(50, num_classes, dtype, bn_axis_name, cifar_stem=True)
