@@ -22,6 +22,7 @@ import os
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
 from distributed_tensorflow_framework_tpu.core.config import CheckpointConfig
@@ -47,7 +48,7 @@ class CheckpointManager:
             raise ValueError("CheckpointConfig.directory must be set")
         self.config = config
         self.is_chief = is_chief
-        path = os.path.abspath(config.directory)
+        path = self._path = os.path.abspath(config.directory)
         os.makedirs(path, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             path,
@@ -78,17 +79,103 @@ class CheckpointManager:
     def restore(self, template: TrainState, *,
                 dataset: HostDataset | None = None,
                 step: int | None = None) -> TrainState | None:
-        """Restore into the template's shardings; None if no checkpoint."""
+        """Restore into the template's shardings; None if no checkpoint.
+
+        Tolerates ``optimizer.ema_decay`` being toggled across a resume:
+        the stored tree's ``ema_params`` entry ({} vs params-shaped) may
+        not match the template's. On mismatch the restore is retried with
+        the opposite EMA shape and reconciled — EMA re-seeded from the
+        restored params when newly enabled, dropped when newly disabled —
+        instead of failing mid-experiment on a template/tree mismatch.
+        """
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
-        args = {"state": ocp.args.StandardRestore(_pack(template))}
-        if dataset is not None:
-            args["data_iter"] = ocp.args.JsonRestore()
-        restored = self._mgr.restore(step, args=ocp.args.Composite(**args))
+
+        want_ema = bool(jax.tree.leaves(template.ema_params))
+
+        def tmpl_for(stored_ema: bool) -> TrainState:
+            """Restore template matching the stored tree's EMA presence."""
+            if want_ema and not stored_ema:
+                log.warning(
+                    "Checkpoint at step %d has no EMA params (ema_decay "
+                    "enabled after it was saved) — will re-seed EMA from "
+                    "the restored params", step,
+                )
+                return template.replace(ema_params={})
+            if stored_ema and not want_ema:
+                # Stored EMA must be read into a params-shaped template and
+                # discarded below (orbax's Standard handler has no partial
+                # restore) — a one-time params-sized I/O cost on the rare
+                # disable-EMA-mid-experiment resume. Leaves are only a
+                # restore template, so aliasing params is fine.
+                log.warning(
+                    "Checkpoint at step %d carries EMA params but ema_decay "
+                    "is now disabled — dropping them", step,
+                )
+                return template.replace(ema_params=template.params)
+            return template
+
+        def attempt(t: TrainState):
+            args = {"state": ocp.args.StandardRestore(_pack(t))}
+            if dataset is not None:
+                args["data_iter"] = ocp.args.JsonRestore()
+            return self._mgr.restore(step, args=ocp.args.Composite(**args))
+
+        stored_ema = self._stored_has_ema(step, default=want_ema)
+        tmpl = tmpl_for(stored_ema)
+        try:
+            restored = attempt(tmpl)
+        except ValueError as e:
+            # Fallback for when the metadata probe misjudged (its JSON
+            # layout is orbax-private and may change): a tree-structure
+            # mismatch on ema_params means the stored EMA presence is the
+            # opposite of what we assumed — flip the template and retry.
+            if "ema_params" not in str(e):
+                raise
+            log.warning(
+                "EMA-presence probe disagreed with the stored tree "
+                "(%s); retrying restore with the flipped EMA template", e,
+            )
+            stored_ema = not stored_ema
+            tmpl = tmpl_for(stored_ema)
+            restored = attempt(tmpl)
+        state = _unpack(restored["state"], tmpl)
+        if want_ema and not stored_ema:
+            # Real copies, not aliases: params and ema_params both live in
+            # the donated TrainState — aliased buffers would be donated
+            # twice in the first train step.
+            state = state.replace(ema_params=jax.tree.map(jnp.copy, state.params))
+        elif stored_ema and not want_ema:
+            state = state.replace(ema_params={})
         if dataset is not None and restored.get("data_iter") is not None:
             dataset.restore(restored["data_iter"])
-        return _unpack(restored["state"], template)
+        return state
+
+    def _stored_has_ema(self, step: int, *, default: bool) -> bool:
+        """Whether the stored state tree carries EMA param leaves.
+
+        Reads the step's PyTree ``_METADATA`` JSON directly (the manager's
+        ``item_metadata`` returns nothing before the item registry is
+        populated). A state saved with EMA disabled stores a single
+        empty-Dict marker at ``('ema_params',)``; real EMA state stores
+        nested array entries ``('ema_params', <module>, ...)``.
+        """
+        import json
+
+        path = os.path.join(self._path, str(step), "state", "_METADATA")
+        try:
+            with open(path) as fh:
+                tree_meta = json.load(fh).get("tree_metadata", {})
+        except Exception as e:  # probe is best-effort; restore() retries
+            log.warning("EMA-presence probe failed reading %s (%s) — "
+                        "assuming template shape", path, e)
+            return default
+        for entry in tree_meta.values():
+            keys = entry.get("key_metadata") or []
+            if keys and keys[0].get("key") == "ema_params" and len(keys) > 1:
+                return True
+        return False
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()

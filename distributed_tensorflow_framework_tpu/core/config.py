@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import pathlib
+import re
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -100,7 +101,7 @@ class MeshConfig:
 
 @config_dataclass
 class OptimizerConfig:
-    name: str = "sgd_momentum"  # sgd_momentum | adam | adamw | lars
+    name: str = "sgd_momentum"  # sgd_momentum | adam | adamw | lars | rmsprop
     learning_rate: float = 0.1
     warmup_steps: int = 0
     schedule: str = "constant"  # constant | cosine | staircase | linear
@@ -112,6 +113,10 @@ class OptimizerConfig:
     beta1: float = 0.9
     beta2: float = 0.999
     eps: float = 1e-8
+    # RMSProp second-moment decay (the reference's Inception recipe family
+    # is RMSProp decay=0.9, momentum=0.9, eps=1.0 — set eps accordingly
+    # when using name=rmsprop for recipe fidelity).
+    rms_decay: float = 0.9
     weight_decay: float = 0.0
     grad_clip_norm: float = 0.0  # 0 disables
     # Exponential moving average of params (0 disables). Uses the
@@ -220,7 +225,13 @@ class TrainConfig:
     # Weight of the MoE load-balancing aux loss (Switch Transformer uses 0.01).
     moe_aux_weight: float = 0.01
     # Gradient accumulation: split each global batch into this many
-    # microbatches, scan fwd/bwd accumulating grads, apply once.
+    # microbatches, scan fwd/bwd accumulating grads, apply once. The
+    # accumulated gradient equals the full-batch gradient exactly. BN
+    # caveat: running stats are EMA-updated once per *microbatch* (k
+    # updates per optimizer step from microbatch statistics), so the
+    # effective BN momentum is momentum**k and BN-model trajectories
+    # differ slightly from the accum=1 step — only BN-free models get
+    # bitwise full-batch parity (tests/test_grad_accum.py).
     grad_accum_steps: int = 1
     # XPlane trace capture over steps [profile_start, profile_stop);
     # 0/0 disables (SURVEY.md §5 tracing).
@@ -253,15 +264,17 @@ def _set_by_path(data: dict, dotted: str, value: Any) -> None:
     node[keys[-1]] = value
 
 
+_SCI_NOTATION = re.compile(r"^[+-]?(\d+\.?\d*|\.\d+)[eE][+-]?\d+$")
+
+
 def _parse_scalar(text: str) -> Any:
     value = yaml.safe_load(text)
     # YAML 1.1 reads "1e-3" (no decimal point) as a *string*; CLI overrides
-    # mean numbers when they look like numbers, so coerce.
-    if isinstance(value, str):
-        try:
-            return float(value)
-        except ValueError:
-            pass
+    # mean numbers when they look like numbers. Coerce ONLY the
+    # scientific-notation shapes YAML misses — a bare float() would also
+    # swallow intended strings like "nan", "inf" or "1_000".
+    if isinstance(value, str) and _SCI_NOTATION.match(value):
+        return float(value)
     return value
 
 

@@ -73,10 +73,11 @@ def synthetic_mlm(
     def make_iter(state: dict[str, Any]):
         state.setdefault("step", 0)
         seed_base = (config.seed * 1_000_003 + process_index) & 0x7FFFFFFF
-        # BERT's [MASK]=103 when the vocab has room for it; tiny test
-        # vocabs fall back to an id below `lo` (always in range — an OOB
-        # id would hit undefined nn.Embed gather behavior).
-        mask_id = 103 if vocab > 103 else max(lo - 1, 1)
+        # BERT's [MASK]=103 when it sits below the token range [lo, vocab)
+        # (vocab > 103 is NOT enough: e.g. vocab=128 → tokens span [64,128)
+        # and 103 would collide with a real token). Fallback is id 0, which
+        # is always below lo>=1 and in embedding range.
+        mask_id = 103 if lo > 103 else 0
         while True:
             rng = np.random.default_rng(seed_base + state["step"])
             tokens = rng.integers(lo, vocab, size=(b, s), dtype=np.int64).astype(np.int32)

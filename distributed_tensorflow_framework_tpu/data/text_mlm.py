@@ -57,6 +57,18 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
                     config.data_dir)
         return synthetic.synthetic_mlm(config, process_index, process_count)
 
+    if len(files) < process_count:
+        # Guard BOTH reader paths here, where files are resolved: the
+        # native path would re-read the same shard on several hosts
+        # (duplicate data); the tf.data path's ds.shard() would hand some
+        # hosts an EMPTY file shard — their infeed never yields and every
+        # host deadlocks at the first collective.
+        raise ValueError(
+            f"MLM reader: {len(files)} TFRecord file(s) for "
+            f"{process_count} processes — sharding by file needs at least "
+            f"one file per process. Provide more shards or fewer hosts."
+        )
+
     if config.use_native_reader:
         return _make_mlm_native(config, files, process_index, process_count)
 
@@ -72,7 +84,11 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
             tf.data.TFRecordDataset,
             cycle_length=8,
             num_parallel_calls=tf.data.AUTOTUNE,
-            deterministic=not train,
+            # Deterministic ALWAYS: resume replays by skip-count
+            # (data/tfdata.py contract), which requires the interleave to
+            # produce an identical record order on every run — train
+            # included (same fix as data/imagenet.py).
+            deterministic=True,
         )
         def parse(rec):
             feats = tf.io.parse_single_example(
@@ -135,7 +151,7 @@ def _make_mlm_native(config: DataConfig, files: list[str],
 
     b = host_batch_size(config.global_batch_size, process_count)
     s = config.seq_len
-    shard = files[process_index::process_count] or files[:1]
+    shard = files[process_index::process_count]  # non-empty: make_mlm guards
 
     def make_iter(state):
         state.setdefault("epoch", 0)

@@ -32,8 +32,16 @@ def allreduce_gradients(
     format: grads are cast down before the pmean and restored after —
     halving collective bytes, which matters most when the reduction spans
     DCN (multislice). This is the block-free core of the EQuARX idea
-    (PAPERS.md: quantized all-reduce); the mean itself still accumulates
-    in the reduced dtype, so reserve it for bandwidth-bound regimes.
+    (PAPERS.md: quantized all-reduce).
+
+    Precision: both the wire format AND the reduction accumulate in the
+    narrow dtype. The cast costs one bf16 round-trip (~3 significant
+    digits) and each of the log2(n) reduction adds contributes bf16-level
+    relative error, so the mean degrades slowly with replica count —
+    acceptable for SGD-class training at practical n (the bf16-vs-f32
+    trajectory test bounds it at n=8), but keep the default f32 wire when
+    gradients are ill-scaled (e.g. fp16 without loss scaling) or when
+    reproducing a reference trajectory exactly.
     """
     if compute_dtype is None:
         return jax.tree.map(lambda g: lax.pmean(g, axis_names), grads)

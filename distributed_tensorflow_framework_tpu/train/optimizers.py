@@ -61,6 +61,24 @@ def make_optimizer(
                 mask=_decay_mask,
             )
         )
+    elif name == "rmsprop":
+        # The reference's Inception recipe family (SURVEY.md §2 row 4 is
+        # RMSProp-based): decay/momentum/eps from config — canonical
+        # Inception-v3 values are decay=0.9, momentum=0.9, eps=1.0.
+        if config.weight_decay > 0:
+            chain.append(optax.add_decayed_weights(config.weight_decay, mask=_decay_mask))
+        # initial_scale=1.0: TF1's RMSPropOptimizer initializes the
+        # mean-square slot to ones (optax defaults to zero) — without it
+        # early updates are systematically larger than the reference's.
+        chain.append(
+            optax.rmsprop(
+                sched,
+                decay=config.rms_decay,
+                eps=config.eps,
+                momentum=config.momentum if config.momentum > 0 else None,
+                initial_scale=1.0,
+            )
+        )
     elif name == "lars":
         chain.append(
             optax.lars(

@@ -41,6 +41,20 @@ train:
     assert cfg.data.global_batch_size == 128
 
 
+def test_override_scalar_coercion():
+    # YAML-1.1 gap: "1e-3" (no dot) parses as a string — we coerce it.
+    cfg = load_config(overrides=["optimizer.learning_rate=1e-3"])
+    assert cfg.optimizer.learning_rate == 1e-3
+    cfg = load_config(overrides=["optimizer.learning_rate=2.5E+2"])
+    assert cfg.optimizer.learning_rate == 250.0
+    # But float()-parseable *strings* must stay strings: a bare float()
+    # would turn these into nan / inf. ("1_000" is already an int per
+    # YAML 1.1 underscore syntax — that's the YAML parser, not coercion.)
+    for raw in ("nan", "inf", "infinity", "1e", "e5"):
+        cfg = load_config(overrides=[f"name={raw}"])
+        assert cfg.name == raw, raw
+
+
 def test_unknown_key_rejected(tmp_path):
     p = tmp_path / "c.yaml"
     p.write_text("modell: {name: lenet5}\n")

@@ -1,0 +1,61 @@
+"""Optimizer construction (train/optimizers.py).
+
+The reference wraps base optimizers in SyncReplicasOptimizer; here the
+base update rule itself must match the optax primitives it claims to wrap
+(schedule-equivalence, VERDICT r1 item 8 for RMSProp).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_tensorflow_framework_tpu.core.config import OptimizerConfig
+from distributed_tensorflow_framework_tpu.train.optimizers import make_optimizer
+
+
+def _trajectory(tx, params, grads_seq):
+    opt_state = tx.init(params)
+    out = []
+    for g in grads_seq:
+        updates, opt_state = tx.update(g, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        out.append(jax.device_get(params))
+    return out
+
+
+def test_rmsprop_matches_optax_primitive():
+    cfg = OptimizerConfig(
+        name="rmsprop", learning_rate=0.045, rms_decay=0.9,
+        momentum=0.9, eps=1.0, schedule="constant",
+    )
+    tx, sched = make_optimizer(cfg, total_steps=10)
+    # initial_scale=1.0 matches TF1 RMSPropOptimizer's ones-initialized
+    # mean-square slot — the production choice (train/optimizers.py).
+    ref = optax.rmsprop(0.045, decay=0.9, eps=1.0, momentum=0.9,
+                        initial_scale=1.0)
+
+    params = {"w": jnp.array([1.0, -2.0, 3.0]), "b": jnp.array(0.5)}
+    rng = np.random.default_rng(0)
+    grads_seq = [
+        {"w": jnp.asarray(rng.standard_normal(3), jnp.float32),
+         "b": jnp.asarray(rng.standard_normal(), jnp.float32)}
+        for _ in range(5)
+    ]
+    ours = _trajectory(tx, params, grads_seq)
+    theirs = _trajectory(ref, params, grads_seq)
+    for a, b in zip(ours, theirs):
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_allclose(x, y, rtol=1e-6, atol=1e-7)
+    assert float(sched(0)) == 0.045
+
+
+def test_rmsprop_no_momentum():
+    cfg = OptimizerConfig(name="rmsprop", learning_rate=0.01, momentum=0.0)
+    tx, _ = make_optimizer(cfg, total_steps=10)
+    params = {"w": jnp.ones(4)}
+    g = {"w": jnp.full((4,), 0.5)}
+    updates, _ = tx.update(g, tx.init(params), params)
+    ref = optax.rmsprop(0.01, decay=0.9, eps=1e-8, initial_scale=1.0)
+    ref_updates, _ = ref.update(g, ref.init(params), params)
+    np.testing.assert_allclose(updates["w"], ref_updates["w"], rtol=1e-6)
