@@ -124,8 +124,14 @@ class OptimizerConfig:
     # min(decay, (1+step)/(10+step)); eval reads the averaged params
     # unless train.eval_use_ema is false.
     ema_decay: float = 0.0
-    # Shard optimizer state over the fsdp axis even when params are replicated
-    # (cross-replica weight-update sharding; cf. SURVEY.md §7 hard part 5).
+    # ZeRO-1 / cross-replica weight-update sharding (SURVEY.md §7 hard
+    # part 5, PAPERS.md "Automatic Cross-Replica Sharding of Weight
+    # Update"): keep params REPLICATED (pure-DP reference semantics) but
+    # shard the optimizer state (momentum/variance slots) over the fsdp
+    # axis — each device updates 1/fsdp of the weights and the updated
+    # params are all-gathered by XLA. Cuts optimizer memory by the fsdp
+    # factor without FSDP's parameter gathering in the forward pass.
+    # Requires mesh.fsdp > 1 and spmd_mode="jit".
     shard_opt_state: bool = False
 
 
@@ -163,9 +169,11 @@ class ModelConfig:
     # mesh's pipe size) with microbatched GPipe scheduling.
     pipeline_stages: int = 1
     pipeline_microbatches: int = 0  # 0 → defaults to pipeline_stages
-    # Rematerialize transformer layers in the backward pass
-    # (jax.checkpoint): trades ~30% more FLOPs for O(layers) less
-    # activation memory — the lever for long-context / big-model fits.
+    # Rematerialize transformer encoder layers in the backward pass
+    # (jax.checkpoint via nn.remat): trades ~30% more FLOPs for O(layers)
+    # less activation memory — the lever for long-context / big-model
+    # fits. Supported for the bert models (numerics parity tested); other
+    # model families reject it rather than silently ignore it.
     remat: bool = False
 
 

@@ -54,6 +54,19 @@ def make_cifar10(config: DataConfig, process_index: int, process_count: int,
         std = batch.std(axis=(1, 2, 3), keepdims=True) + 1e-6
         return (batch - mean) / std
 
+    if not train:
+        # Exact single-pass eval: every test example once, no augmentation,
+        # final batch zero-padded with per-example weights (data/pipeline.py).
+        from distributed_tensorflow_framework_tpu.data.pipeline import (
+            finite_array_eval,
+        )
+
+        return finite_array_eval(
+            standardize(images).astype(out_dtype, copy=False), labels,
+            batch=b, process_index=process_index,
+            process_count=process_count, out_dtype=out_dtype,
+        )
+
     def make_iter(state):
         state.setdefault("epoch", 0)
         state.setdefault("batch_in_epoch", 0)

@@ -26,7 +26,11 @@ from distributed_tensorflow_framework_tpu.data.pipeline import (
     image_np_dtype,
 )
 from distributed_tensorflow_framework_tpu.data import synthetic
-from distributed_tensorflow_framework_tpu.data.tfdata import tfdata_to_hostdataset
+from distributed_tensorflow_framework_tpu.data.tfdata import (
+    count_records,
+    eval_batches_all_hosts,
+    tfdata_to_hostdataset,
+)
 
 log = logging.getLogger(__name__)
 
@@ -37,6 +41,8 @@ STDDEV_RGB = (0.229 * 255, 0.224 * 255, 0.225 * 255)
 def _file_pattern(config: DataConfig, train: bool) -> str:
     sub = "train" if train else "validation"
     return os.path.join(config.data_dir, f"{sub}-*")
+
+
 
 
 def make_imagenet(config: DataConfig, process_index: int, process_count: int,
@@ -123,15 +129,52 @@ def make_imagenet(config: DataConfig, process_index: int, process_count: int,
             lambda rec, i: parse(rec, tf.stack([tf.cast(i, tf.int32), seed])),
             num_parallel_calls=tf.data.AUTOTUNE,
         )
-        ds = ds.batch(b, drop_remainder=True)
-        if not train:
-            ds = ds.repeat()
+        if train:
+            ds = ds.batch(b, drop_remainder=True)
+        else:
+            # Exact single-pass eval (SURVEY.md §3.4): keep the remainder,
+            # zero-pad it to the static batch size, and emit per-example
+            # weights so padding contributes nothing to the metric sums.
+            ds = ds.batch(b, drop_remainder=False)
+
+            def pad(batch):
+                k = tf.shape(batch["image"])[0]
+                pad_n = b - k
+                image = tf.pad(batch["image"], [[0, pad_n], [0, 0], [0, 0], [0, 0]])
+                label = tf.pad(batch["label"], [[0, pad_n]])
+                weight = tf.concat(
+                    [tf.ones([k], tf.float32), tf.zeros([pad_n], tf.float32)], 0
+                )
+                image = tf.ensure_shape(image, [b, size, size, 3])
+                label = tf.ensure_shape(label, [b])
+                weight = tf.ensure_shape(weight, [b])
+                return {"image": image, "label": label, "weight": weight}
+
+            ds = ds.map(pad, num_parallel_calls=tf.data.AUTOTUNE)
         return ds.prefetch(tf.data.AUTOTUNE)
 
+    img_dtype = image_np_dtype(config.image_dtype)
+    if train:
+        return tfdata_to_hostdataset(
+            make_ds,
+            element_spec={
+                "image": ((b, size, size, 3), img_dtype),
+                "label": ((b,), np.int32),
+            },
+        )
+
+    # Count THIS host's file shard (make_ds shards files with the same
+    # stride), not the full set — otherwise every host's eval pass is
+    # inflated ~process_count× with zero-weight padding batches.
+    host_files = files[process_index::process_count]
+    num_batches = eval_batches_all_hosts(count_records(host_files), b)
     return tfdata_to_hostdataset(
         make_ds,
         element_spec={
-            "image": ((b, size, size, 3), image_np_dtype(config.image_dtype)),
+            "image": ((b, size, size, 3), img_dtype),
             "label": ((b,), np.int32),
+            "weight": ((b,), np.float32),
         },
+        cardinality=num_batches,
+        pad_tail_to=num_batches,
     )

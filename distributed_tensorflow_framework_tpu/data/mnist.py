@@ -46,6 +46,19 @@ def make_mnist(config: DataConfig, process_index: int, process_count: int,
     n = len(images)
     out_dtype = image_np_dtype(config.image_dtype)
 
+    if not train:
+        # Exact single-pass eval: every test example once, final batch
+        # zero-padded with per-example weights (data/pipeline.py).
+        from distributed_tensorflow_framework_tpu.data.pipeline import (
+            finite_array_eval,
+        )
+
+        return finite_array_eval(
+            images.astype(out_dtype, copy=False), labels, batch=b,
+            process_index=process_index, process_count=process_count,
+            out_dtype=out_dtype,
+        )
+
     def make_iter(state):
         state.setdefault("epoch", 0)
         state.setdefault("batch_in_epoch", 0)

@@ -44,6 +44,57 @@ def host_batch_size(global_batch_size: int, process_count: int) -> int:
     return global_batch_size // process_count
 
 
+def finite_array_eval(
+    images: np.ndarray,
+    labels: np.ndarray,
+    *,
+    batch: int,
+    process_index: int,
+    process_count: int,
+    out_dtype: Any,
+) -> "HostDataset":
+    """Single-pass padded eval stream over in-memory arrays.
+
+    The exact-evaluation contract (reference eval loop, SURVEY.md §3.4):
+    every example is visited exactly once; the final partial batch is
+    zero-padded to the static batch size and a per-example ``weight``
+    (1.0 real / 0.0 pad) lets the eval step weight its metric sums so the
+    padding contributes nothing. Every host yields the same number of
+    batches (padding differs), so multi-host collectives never diverge.
+    """
+    n = len(images)
+    shard = np.arange(n)[process_index::process_count]
+    # ceil over the LARGEST host shard so all hosts agree on batch count.
+    max_shard = -(-n // process_count)
+    num_batches = -(-max_shard // batch)
+
+    def make_iter(state):
+        state.setdefault("batch", 0)
+        for i in range(state["batch"], num_batches):
+            idx = shard[i * batch:(i + 1) * batch]
+            k = len(idx)
+            img = np.zeros((batch,) + images.shape[1:], dtype=out_dtype)
+            lab = np.zeros((batch,), np.int32)
+            w = np.zeros((batch,), np.float32)
+            if k:
+                img[:k] = images[idx]
+                lab[:k] = labels[idx]
+                w[:k] = 1.0
+            state["batch"] = i + 1
+            yield {"image": img, "label": lab, "weight": w}
+
+    return HostDataset(
+        make_iter,
+        element_spec={
+            "image": ((batch,) + tuple(images.shape[1:]), out_dtype),
+            "label": ((batch,), np.int32),
+            "weight": ((batch,), np.float32),
+        },
+        initial_state={"batch": 0},
+        cardinality=num_batches,
+    )
+
+
 class HostDataset:
     """A restartable, checkpointable per-host batch stream."""
 

@@ -70,6 +70,15 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
         )
 
     if config.use_native_reader:
+        if not train:
+            # The native reader streams full batches in an infinite epoch
+            # loop — it has no single-pass padded mode, so exact eval
+            # (every record once, tail included) can't be honored. Refuse
+            # rather than silently recycling/dropping validation records.
+            raise ValueError(
+                "use_native_reader has no exact-eval path — use the "
+                "tf.data reader (use_native_reader=false) for evaluation"
+            )
         return _make_mlm_native(config, files, process_index, process_count)
 
     import tensorflow as tf
@@ -98,14 +107,42 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
         ds = ds.map(parse, num_parallel_calls=tf.data.AUTOTUNE)
         if train:
             ds = ds.shuffle(config.shuffle_buffer, seed=seed).repeat()
+            ds = ds.batch(b, drop_remainder=True)
         else:
-            ds = ds.repeat()
-        return ds.batch(b, drop_remainder=True).prefetch(tf.data.AUTOTUNE)
+            # Exact single-pass eval: keep the remainder, zero-pad to the
+            # static batch. Pad rows are all-zero tokens, which the masker
+            # treats as special (never selected) — they contribute no
+            # masked positions, hence nothing to the MLM metric sums.
+            ds = ds.batch(b, drop_remainder=False)
 
-    base = tfdata_to_hostdataset(
-        make_tok_ds,
-        element_spec={"tokens": ((b, s), np.int32)},
-    )
+            def pad(batch):
+                k = tf.shape(batch["tokens"])[0]
+                tokens = tf.pad(batch["tokens"], [[0, b - k], [0, 0]])
+                return {"tokens": tf.ensure_shape(tokens, [b, s])}
+
+            ds = ds.map(pad, num_parallel_calls=tf.data.AUTOTUNE)
+        return ds.prefetch(tf.data.AUTOTUNE)
+
+    if train:
+        base = tfdata_to_hostdataset(
+            make_tok_ds,
+            element_spec={"tokens": ((b, s), np.int32)},
+        )
+        num_batches = None
+    else:
+        from distributed_tensorflow_framework_tpu.data.tfdata import (
+            count_records,
+            eval_batches_all_hosts,
+        )
+
+        host_files = files[process_index::process_count]
+        num_batches = eval_batches_all_hosts(count_records(host_files), b)
+        base = tfdata_to_hostdataset(
+            make_tok_ds,
+            element_spec={"tokens": ((b, s), np.int32)},
+            cardinality=num_batches,
+            pad_tail_to=num_batches,
+        )
 
     # Wrap with host-side dynamic masking (rng keyed off batch counter so
     # restores re-create identical masks).
@@ -133,6 +170,7 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
             "attention_mask": ((b, s), np.int32),
         },
         initial_state={"inner": base.state()},
+        cardinality=num_batches,
     )
 
 

@@ -21,12 +21,53 @@ import numpy as np
 
 from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
 
+# files-tuple → record count. Restores rebuild the pipeline (skip-count
+# contract), so the one-time count per shard set must not be repeated.
+_RECORD_COUNT_CACHE: dict[tuple[str, ...], int] = {}
+
+
+def count_records(files: list[str]) -> int:
+    """Number of TFRecords across ``files`` (raw framing read, no decode).
+
+    For exact eval, call with THIS HOST'S file shard, not the full file
+    list — the per-host batch count must reflect the records this host
+    will actually stream.
+    """
+    key = tuple(files)
+    if key not in _RECORD_COUNT_CACHE:
+        import tensorflow as tf
+
+        ds = tf.data.TFRecordDataset(files, num_parallel_reads=tf.data.AUTOTUNE)
+        n = int(ds.reduce(np.int64(0), lambda x, _: x + 1).numpy())
+        _RECORD_COUNT_CACHE[key] = n
+    return _RECORD_COUNT_CACHE[key]
+
+
+def eval_batches_all_hosts(host_records: int, batch: int) -> int:
+    """Per-host eval batch count, equalized across hosts.
+
+    Exact evaluation needs every host to run the same number of eval steps
+    (each step is a collective), while file-sharded hosts hold different
+    record counts. Take the max of ceil(records/batch) across processes;
+    hosts that exhaust early pad with zero-weight batches (``pad_tail_to``).
+    """
+    import jax
+
+    mine = -(-host_records // batch)
+    if jax.process_count() == 1:
+        return mine
+    from jax.experimental import multihost_utils
+
+    counts = multihost_utils.process_allgather(np.int64(mine))
+    return int(np.max(counts))
+
 
 def tfdata_to_hostdataset(
     make_batched_ds: Callable[[int], Any],
     *,
     element_spec: dict,
     cardinality: int | None = None,
+    pad_tail_to: int | None = None,
 ) -> HostDataset:
     """Wrap a factory of batched+repeated tf.data datasets.
 
@@ -34,7 +75,17 @@ def tfdata_to_hostdataset(
       make_batched_ds: seed → batched, repeated, deterministic tf.data
         Dataset yielding dict elements matching element_spec.
       element_spec: name → (per-host batch shape, numpy dtype).
+      cardinality: batches per epoch per host (None = infinite stream).
+      pad_tail_to: for finite eval streams on multi-host jobs — if this
+        host's stream exhausts before yielding this many batches, emit
+        all-zero batches (weight 0) up to the target so every host runs
+        the same number of collective eval steps.
     """
+
+    def _zero_batch():
+        return {
+            k: np.zeros(shape, dtype) for k, (shape, dtype) in element_spec.items()
+        }
 
     def make_iter(state: dict[str, Any]):
         state.setdefault("batches", 0)
@@ -46,6 +97,9 @@ def tfdata_to_hostdataset(
         for elem in ds.as_numpy_iterator():
             state["batches"] += 1
             yield {k: np.asarray(v) for k, v in elem.items()}
+        while pad_tail_to is not None and state["batches"] < pad_tail_to:
+            state["batches"] += 1
+            yield _zero_batch()
 
     return HostDataset(
         make_iter,

@@ -29,6 +29,21 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
 
     dtype = jnp.dtype(config.dtype)
     name = config.name.lower()
+    is_bert = name in ("bert", "bert_base", "bert-base")
+    if config.remat and not is_bert:
+        # Honest failure beats a silently-ignored knob: activation remat is
+        # wired for the transformer encoder stack (models/bert.py); the
+        # conv models' activation footprint is pooling-dominated and has
+        # not needed it.
+        raise ValueError(
+            f"model.remat is only supported for the transformer (bert) "
+            f"models, not {config.name!r}"
+        )
+    if config.remat and config.pipeline_stages > 1:
+        raise ValueError(
+            "model.remat inside the pipelined stack is unsupported — the "
+            "GPipe stage body manages its own activation lifetime"
+        )
     if name in ("lenet", "lenet5", "lenet-5"):
         from distributed_tensorflow_framework_tpu.models.lenet import LeNet5
 
@@ -54,7 +69,7 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             dtype=dtype,
             bn_axis_name=bn_axis_name,
         )
-    if name in ("bert", "bert_base", "bert-base"):
+    if is_bert:
         if config.pipeline_stages > 1:
             if config.num_experts > 0:
                 raise ValueError(
@@ -97,5 +112,6 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             moe_every=config.moe_every,
             expert_topk=config.expert_topk,
             capacity_factor=config.capacity_factor,
+            remat=config.remat,
         )
     raise ValueError(f"Unknown model {config.name!r}")

@@ -89,7 +89,9 @@ class EncoderLayer(nn.Module):
     capacity_factor: float = 1.25
 
     @nn.compact
-    def __call__(self, x, mask=None, *, train: bool = True):
+    def __call__(self, x, mask=None, train: bool = True):
+        # NOTE: ``train`` is positional-able (not keyword-only) so nn.remat
+        # can mark it static by argnum (BertForMLM.remat).
         attn = MultiHeadAttention(
             self.num_heads, dtype=self.dtype,
             attention_impl=self.attention_impl, mesh=self.mesh, name="attn",
@@ -186,6 +188,13 @@ class BertForMLM(nn.Module):
     moe_every: int = 2
     expert_topk: int = 2
     capacity_factor: float = 1.25
+    # Rematerialize each encoder layer in the backward pass
+    # (jax.checkpoint): activations are recomputed per layer instead of
+    # stored, cutting activation memory from O(layers) to O(1) layers at
+    # ~30% extra forward FLOPs — the fit lever for long-context/big-model
+    # configs (ModelConfig.remat). Numerically exact (same ops replayed;
+    # parity-tested in tests/test_remat.py).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, *, train: bool = True):
@@ -199,12 +208,19 @@ class BertForMLM(nn.Module):
             mask = attention_mask[:, None, None, :].astype(bool)
         aux_total = jnp.zeros((), jnp.float32)
         n_moe = 0
+        # argnums of EncoderLayer.__call__: 0=self, 1=x, 2=mask, 3=train —
+        # train branches Python-side (Dropout determinism) so it must stay
+        # static under the checkpoint transform.
+        layer_cls = (
+            nn.remat(EncoderLayer, static_argnums=(3,)) if self.remat
+            else EncoderLayer
+        )
         for i in range(self.num_layers):
             use_moe = (
                 self.num_experts > 0
                 and i % max(self.moe_every, 1) == max(self.moe_every, 1) - 1
             )
-            x, aux = EncoderLayer(
+            x, aux = layer_cls(
                 self.num_heads, self.mlp_dim, self.dropout_rate,
                 dtype=self.dtype, attention_impl=self.attention_impl,
                 mesh=self.mesh,
@@ -212,7 +228,7 @@ class BertForMLM(nn.Module):
                 expert_topk=self.expert_topk,
                 capacity_factor=self.capacity_factor,
                 name=f"layer{i}",
-            )(x, mask, train=train)
+            )(x, mask, train)
             if use_moe:
                 aux_total = aux_total + aux
                 n_moe += 1

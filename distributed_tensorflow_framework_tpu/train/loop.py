@@ -62,7 +62,10 @@ class Trainer:
         sample = to_global(host_batch, self.mesh)
         self.state = self.builder.init_state(self.config.train.seed, sample)
         self.train_step = self.builder.make_train_step(sample)
-        self.eval_step = self.builder.make_eval_step(sample)
+        # eval_step is compiled lazily from the EVAL stream's sample batch
+        # (its element spec differs from training: weight key, no aug) —
+        # see _ensure_eval().
+        self.eval_step = None
         # Checkpoint manager + auto-restore (MonitoredTrainingSession
         # contract: restore latest from checkpoint_dir if present).
         if self.config.checkpoint.directory:
@@ -144,27 +147,61 @@ class Trainer:
         return last_metrics
 
     # ---------------------------------------------------------------- eval --
+    def _ensure_eval(self):
+        """Build the eval pipeline + compiled eval step ONCE; reused across
+        every EvalHook firing and final eval (rebuilding the TFRecord
+        pipeline per call was the round-1 waste)."""
+        if getattr(self, "_eval_ds", None) is None:
+            eval_cfg = self.config.eval_data or self.config.data
+            self._eval_ds = get_dataset(
+                eval_cfg,
+                process_index=self.runtime.process_index,
+                process_count=self.runtime.process_count,
+                train=False,
+            )
+            self._eval_start = self._eval_ds.state()
+            sample_host = next(self._eval_ds)
+            self._eval_ds.restore(self._eval_start)
+            if self.eval_step is None:
+                self.eval_step = self.builder.make_eval_step(
+                    to_global(sample_host, self.mesh)
+                )
+        return self._eval_ds
+
     def evaluate(self, step: int | None = None, num_batches: int | None = None) -> dict[str, float]:
+        """Exact evaluation (SURVEY.md §3.4 eval-loop contract).
+
+        Finite eval streams (real datasets) are walked in ONE full pass —
+        every validation example exactly once, padded final batch masked
+        by per-example weights — and metrics are weighted means over real
+        examples. Infinite streams (synthetic fallback) evaluate
+        ``train.eval_steps`` batches. ``num_batches`` truncates either.
+        """
         if self.state is None:
             self.build()
-        eval_cfg = self.config.eval_data or self.config.data
-        ds = get_dataset(
-            eval_cfg,
-            process_index=self.runtime.process_index,
-            process_count=self.runtime.process_count,
-            train=False,
-        )
-        n = num_batches or self.config.train.eval_steps
+        ds = self._ensure_eval()
+        ds.restore(self._eval_start)  # fresh pass every call
+        if num_batches is not None:
+            n = num_batches
+        elif ds.cardinality is not None:
+            n = ds.cardinality  # exact: the full validation set
+        else:
+            n = self.config.train.eval_steps
         totals: dict[str, float] = {}
-        count = 0
         for i, (batch, _) in enumerate(prefetch_to_device(ds, self.mesh, size=2)):
             if i >= n:
                 break
             m = jax.device_get(self.eval_step(self.state, batch))
             for k, v in m.items():
                 totals[k] = totals.get(k, 0.0) + float(v)
-            count += 1
-        results = {f"eval_{k}": v / max(count, 1) for k, v in totals.items()}
+        weight = totals.pop("weight_sum", 0.0)
+        denom = max(weight, 1e-9)
+        results = {
+            f"eval_{k[: -len('_sum')]}": v / denom for k, v in totals.items()
+        }
+        # Real examples seen (masked tokens for MLM) — lets callers confirm
+        # full-set coverage (e.g. 50000 for ImageNet validation).
+        results["eval_examples"] = weight
         if step is not None:
             self.writer.write(step, results)
         return results

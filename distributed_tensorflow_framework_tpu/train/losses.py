@@ -35,6 +35,52 @@ def classification_loss(
     return loss, metrics
 
 
+def classification_metrics_sums(
+    logits: jax.Array, labels: jax.Array, weight: jax.Array
+) -> dict[str, jax.Array]:
+    """Per-batch weighted metric SUMS for exact full-set evaluation.
+
+    The eval loop (train/loop.py) accumulates these across the single-pass
+    padded eval stream and divides by ``weight_sum`` at the end, so the
+    result is the exact mean over real examples — zero-weight padding rows
+    contribute nothing (reference eval-loop contract, SURVEY.md §3.4).
+    """
+    num_classes = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    w = weight.astype(jnp.float32)
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    correct = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    out = {
+        "loss_sum": (losses * w).sum(),
+        "top1_sum": (correct * w).sum(),
+        "weight_sum": w.sum(),
+    }
+    if num_classes > 5:
+        top5 = (jax.lax.top_k(logits, 5)[1] == labels[:, None]).any(axis=-1)
+        out["top5_sum"] = (top5.astype(jnp.float32) * w).sum()
+    return out
+
+
+def mlm_metrics_sums(
+    logits: jax.Array, targets: jax.Array, weight: jax.Array
+) -> dict[str, jax.Array]:
+    """MLM weighted metric SUMS over masked positions (see above).
+
+    ``weight_sum`` counts masked tokens of real (weight-1) examples — the
+    exact denominator for masked-LM loss/accuracy.
+    """
+    logits = logits.astype(jnp.float32)
+    mask = mlm_mask(targets) * weight.astype(jnp.float32)[:, None]
+    safe_targets = jnp.maximum(targets, 0)
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, safe_targets)
+    correct = (jnp.argmax(logits, axis=-1) == safe_targets).astype(jnp.float32)
+    return {
+        "loss_sum": (losses * mask).sum(),
+        "mlm_acc_sum": (correct * mask).sum(),
+        "weight_sum": mask.sum(),
+    }
+
+
 def mlm_mask(targets: jax.Array) -> jax.Array:
     """1.0 at masked (predicted) positions, 0.0 elsewhere — the single
     definition of the '-1 means unmasked' sentinel, shared with the

@@ -71,6 +71,19 @@ class StepBuilder:
                 "spmd_mode='shard_map' is the pure-DP reference-parity path; "
                 "expert parallelism (mesh.expert>1) requires spmd_mode='jit'"
             )
+        if config.optimizer.shard_opt_state:
+            if self.shard_map_mode:
+                raise ValueError(
+                    "optimizer.shard_opt_state needs spmd_mode='jit' (XLA "
+                    "owns the update-shard/all-gather pattern; the explicit "
+                    "shard_map path is pure replicated DP)"
+                )
+            if mesh.shape.get("fsdp", 1) <= 1:
+                raise ValueError(
+                    "optimizer.shard_opt_state shards over the fsdp mesh "
+                    "axis — set mesh.fsdp > 1 (it would be a silent no-op "
+                    "on this mesh)"
+                )
         pipe = mesh.shape.get("pipe", 1)
         stages = config.model.pipeline_stages
         if pipe > 1 or stages > 1 or config.model.pipeline_microbatches > 0:
@@ -141,6 +154,15 @@ class StepBuilder:
                 # semantics): params fully replicated. FSDP/TP layouts are
                 # the jit path's job.
                 self._state_specs = jax.tree.map(lambda _: P(), shapes)
+            elif self.config.optimizer.shard_opt_state:
+                # ZeRO-1 (cross-replica weight-update sharding): params /
+                # BN stats / EMA replicated like pure DP, optimizer slots
+                # sharded over fsdp. XLA partitions the weight update and
+                # all-gathers the new params (SURVEY.md §7 hard part 5).
+                base = shd.infer_param_specs(shapes, self.mesh, fsdp=False)
+                opt = shd.infer_param_specs(shapes.opt_state, self.mesh,
+                                            fsdp=True)
+                self._state_specs = base.replace(opt_state=opt)
             else:
                 self._state_specs = shd.infer_param_specs(shapes, self.mesh)
         return self._state_specs
@@ -376,6 +398,14 @@ class StepBuilder:
 
     # -------------------------------------------------------- eval step --
     def _eval_step(self, state: TrainState, batch: Any):
+        """Weighted metric SUMS for one eval batch.
+
+        Returns ``{*_sum, weight_sum}``; the eval loop accumulates and
+        divides, making a full pass over a padded finite eval stream the
+        EXACT metric over the real examples (SURVEY.md §3.4). Batches
+        without a ``weight`` key (infinite synthetic streams) weight every
+        example 1.0, which reproduces the plain batched mean.
+        """
         has_bn = self._has_bn(state)
         use_ema = (
             self.config.optimizer.ema_decay > 0
@@ -387,13 +417,17 @@ class StepBuilder:
             variables["batch_stats"] = state.batch_stats
         inputs = model_inputs(self.task, batch)
         logits = self.model.apply(variables, *inputs, train=False)
+        if isinstance(logits, dict):  # MoE aux loss / Inception aux head
+            logits = logits["logits"]
         if self.task == "mlm":
-            if isinstance(logits, dict):  # MoE model: drop aux for eval
-                logits = logits["logits"]
-            _, metrics = losses.mlm_loss(logits, batch["targets"])
-        else:
-            _, metrics = losses.classification_loss(logits, batch["label"])
-        return metrics
+            weight = batch.get(
+                "weight", jnp.ones(batch["targets"].shape[0], jnp.float32)
+            )
+            return losses.mlm_metrics_sums(logits, batch["targets"], weight)
+        weight = batch.get(
+            "weight", jnp.ones(batch["label"].shape[0], jnp.float32)
+        )
+        return losses.classification_metrics_sums(logits, batch["label"], weight)
 
     def make_eval_step(self, sample_batch: Any) -> Callable:
         specs = self.state_specs(sample_batch)

@@ -1,0 +1,131 @@
+"""Exact full-validation-set evaluation (SURVEY.md §3.4 eval-loop contract).
+
+Round-1 gap: eval took `eval_steps` batches from repeat()ed streams, so
+top-1 was measured on a truncated/recycled subset. These tests pin the new
+contract: one pass, every example exactly once, padded final batch masked
+out, metrics equal to a numpy reference computed over the raw set.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_framework_tpu.core.config import load_config
+from distributed_tensorflow_framework_tpu.data.pipeline import finite_array_eval
+from distributed_tensorflow_framework_tpu.train import Trainer
+
+N_TEST = 87  # deliberately not divisible by any batch size used below
+
+
+def test_finite_array_eval_covers_every_example_once():
+    images = np.arange(N_TEST, dtype=np.float32).reshape(N_TEST, 1, 1, 1)
+    labels = (np.arange(N_TEST) % 10).astype(np.int32)
+    ds = finite_array_eval(images, labels, batch=16, process_index=0,
+                          process_count=1, out_dtype=np.float32)
+    assert ds.cardinality == 6  # ceil(87/16)
+    seen = []
+    total_weight = 0.0
+    batches = list(ds)
+    assert len(batches) == 6
+    for b in batches:
+        assert b["image"].shape == (16, 1, 1, 1)
+        w = b["weight"]
+        total_weight += float(w.sum())
+        seen.extend(b["image"][w > 0, 0, 0, 0].tolist())
+        # padding is zeroed and zero-weighted
+        assert (b["image"][w == 0] == 0).all()
+    assert total_weight == N_TEST
+    assert sorted(seen) == list(range(N_TEST))  # each example exactly once
+    # Stream is finite: a second pull raises StopIteration.
+    with pytest.raises(StopIteration):
+        next(ds)
+
+
+def test_finite_array_eval_multihost_equal_batch_counts():
+    # 87 examples over 4 hosts: shards of 22,22,22,21 — every host must
+    # still yield ceil(22/8)=3 batches so collectives stay in step.
+    images = np.zeros((N_TEST, 1, 1, 1), np.float32)
+    labels = np.zeros((N_TEST,), np.int32)
+    counts, weights = [], []
+    for p in range(4):
+        ds = finite_array_eval(images, labels, batch=8, process_index=p,
+                              process_count=4, out_dtype=np.float32)
+        bs = list(ds)
+        counts.append(len(bs))
+        weights.append(sum(float(b["weight"].sum()) for b in bs))
+    assert counts == [3, 3, 3, 3]
+    assert sum(weights) == N_TEST
+
+
+@pytest.fixture(scope="module")
+def mnist_npz(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("mnist_data"))
+    rng = np.random.default_rng(3)
+    x_train = rng.integers(0, 255, (256, 28, 28), dtype=np.uint8)
+    y_train = rng.integers(0, 10, 256).astype(np.int64)
+    x_test = rng.integers(0, 255, (N_TEST, 28, 28), dtype=np.uint8)
+    y_test = rng.integers(0, 10, N_TEST).astype(np.int64)
+    np.savez(os.path.join(root, "mnist.npz"), x_train=x_train,
+             y_train=y_train, x_test=x_test, y_test=y_test)
+    return root
+
+
+def test_exact_eval_matches_numpy_reference(devices, mnist_npz):
+    """Trainer.evaluate over the real-file MNIST path must equal a numpy
+    reference computed on the raw (unpadded, unbatched) test set."""
+    cfg = load_config(base={
+        "name": "exact-eval",
+        "mesh": {"data": 8},
+        "model": {"name": "lenet5", "num_classes": 10, "dtype": "float32"},
+        "data": {"name": "mnist", "data_dir": mnist_npz,
+                 "global_batch_size": 32, "image_size": 28, "channels": 1},
+        "optimizer": {"name": "sgd_momentum", "learning_rate": 0.05},
+        "train": {"total_steps": 3, "log_interval": 3},
+    })
+    trainer = Trainer(cfg)
+    trainer.train()
+    results = trainer.evaluate()
+    # Full coverage: all 87 test examples, once.
+    assert results["eval_examples"] == N_TEST
+
+    # Numpy reference on the same standardized test set, no padding.
+    with np.load(os.path.join(mnist_npz, "mnist.npz")) as d:
+        images = d["x_test"].astype(np.float32)[..., None] / 255.0
+        labels = d["y_test"].astype(np.int32)
+    mean = images.mean(axis=(1, 2, 3), keepdims=True)
+    std = images.std(axis=(1, 2, 3), keepdims=True) + 1e-6
+    images = (images - mean) / std
+
+    params = jax.device_get(trainer.state.params)
+    logits = np.asarray(
+        trainer.builder.model.apply({"params": params}, images, train=False),
+        np.float32,
+    )
+    # log-softmax CE + top-1, f64 accumulation for a tight reference.
+    shifted = logits - logits.max(axis=-1, keepdims=True)
+    logp = shifted - np.log(np.exp(shifted).sum(axis=-1, keepdims=True))
+    ref_loss = float(-logp[np.arange(N_TEST), labels].mean())
+    ref_top1 = float((logits.argmax(-1) == labels).mean())
+
+    assert results["eval_top1"] == pytest.approx(ref_top1, abs=1e-12)
+    assert results["eval_loss"] == pytest.approx(ref_loss, rel=1e-5)
+
+
+def test_eval_pipeline_reused_across_calls(devices, mnist_npz):
+    cfg = load_config(base={
+        "name": "eval-reuse",
+        "mesh": {"data": 8},
+        "model": {"name": "lenet5", "num_classes": 10, "dtype": "float32"},
+        "data": {"name": "mnist", "data_dir": mnist_npz,
+                 "global_batch_size": 32, "image_size": 28, "channels": 1},
+        "train": {"total_steps": 2, "log_interval": 2},
+    })
+    trainer = Trainer(cfg)
+    trainer.train()
+    r1 = trainer.evaluate()
+    ds_first = trainer._eval_ds
+    r2 = trainer.evaluate()
+    assert trainer._eval_ds is ds_first  # no per-call pipeline rebuild
+    assert r1 == r2  # deterministic full pass both times

@@ -100,3 +100,37 @@ def test_tfrecord_eval_transform(record_dir):
     ds = make_imagenet(_cfg(record_dir), 0, 1, train=False)
     batch = next(ds)
     assert batch["image"].shape == (8, 32, 32, 3)
+
+
+def test_eval_covers_every_record_once(record_dir):
+    # 16 validation records, batch 5 → 4 batches, last padded to 5 with
+    # weight 0 (exact-eval contract: one pass, every record once).
+    cfg = _cfg(record_dir)
+    cfg.global_batch_size = 5
+    ds = make_imagenet(cfg, 0, 1, train=False)
+    assert ds.cardinality == 4  # ceil(16/5)
+    batches = list(ds)
+    assert len(batches) == 4
+    total = sum(float(b["weight"].sum()) for b in batches)
+    assert total == 16
+    # Labels covered exactly once: the writer assigns sequential labels
+    # (n%1000)+1 for n=1..16, shifted to [0,999] → 1..16 after -1... i.e.
+    # stored 2..17, shifted 1..16.
+    labels = np.concatenate(
+        [b["label"][b["weight"] > 0] for b in batches]
+    )
+    assert sorted(labels.tolist()) == list(range(1, 17))
+    with pytest.raises(StopIteration):
+        next(ds)
+
+
+def test_eval_counts_host_shard_not_total(record_dir):
+    # 2 validation files over 2 processes: each host streams ONE file
+    # (8 records, batch 5 → 2 batches) — not ceil(16/5)=4 padded batches.
+    cfg = _cfg(record_dir)
+    cfg.global_batch_size = 10  # per-host b=5 with process_count=2
+    ds = make_imagenet(cfg, 0, 2, train=False)
+    assert ds.cardinality == 2
+    batches = list(ds)
+    assert len(batches) == 2
+    assert sum(float(b["weight"].sum()) for b in batches) == 8

@@ -95,6 +95,34 @@ def test_shard_guard_both_paths(record_dir):
             make_mlm(cfg, 0, 4, train=True)
 
 
+def test_eval_single_pass_padded(record_dir):
+    # 24 records, batch 7 → 4 batches, last padded with all-zero token
+    # rows (never masked → zero contribution to MLM sums).
+    cfg = _cfg(record_dir, global_batch_size=7)
+    ds = make_mlm(cfg, 0, 1, train=False)
+    assert ds.cardinality == 4  # ceil(24/7)
+    batches = list(ds)
+    assert len(batches) == 4
+    real_rows = sum(
+        int((b["input_ids"] != 0).any(axis=1).sum()) for b in batches
+    )
+    assert real_rows == 24
+    # Pad rows produce no prediction targets.
+    last = batches[-1]
+    pad = ~(last["input_ids"] != 0).any(axis=1)
+    assert (last["targets"][pad] == -1).all()
+    with pytest.raises(StopIteration):
+        next(ds)
+
+
+def test_native_reader_rejects_eval(record_dir):
+    # The native reader has no single-pass padded mode — exact eval must
+    # refuse it instead of silently recycling/dropping validation records.
+    cfg = _cfg(record_dir, use_native_reader=True)
+    with pytest.raises(ValueError, match="exact-eval"):
+        make_mlm(cfg, 0, 1, train=False)
+
+
 def test_native_reader_resume(record_dir):
     cfg = _cfg(record_dir, use_native_reader=True)
     ds1 = make_mlm(cfg, 0, 1, train=True)

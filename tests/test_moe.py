@@ -95,10 +95,12 @@ def test_moe_bert_trains_dp_ep_tp(moe_cfg, devices):
         assert np.isfinite(float(m["loss"]))
         assert np.isfinite(float(m["moe_aux_loss"]))
         losses.append(float(m["loss"]))
-    # Eval path strips the aux dict.
+    # Eval path strips the aux dict and returns weighted metric sums
+    # (exact-eval contract, train/step.py _eval_step).
     eval_step = builder.make_eval_step(batch)
     em = jax.device_get(eval_step(state, batch))
-    assert np.isfinite(float(em["loss"]))
+    assert float(em["weight_sum"]) > 0
+    assert np.isfinite(float(em["loss_sum"]) / float(em["weight_sum"]))
 
 
 def test_moe_shard_map_rejected(moe_cfg):

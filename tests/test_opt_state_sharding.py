@@ -1,0 +1,115 @@
+"""ZeRO-1 optimizer-state sharding (optimizer.shard_opt_state).
+
+SURVEY.md §7 hard part 5 / PAPERS.md cross-replica weight-update sharding:
+params stay replicated (pure-DP reference semantics) while Adam/momentum
+slots shard over the fsdp mesh axis. Asserts the spec layout AND that the
+parameter trajectory matches plain replicated DP.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_framework_tpu.core.config import load_config
+from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+from distributed_tensorflow_framework_tpu.data.infeed import to_global
+from distributed_tensorflow_framework_tpu.train.step import StepBuilder
+
+
+def _cfg(mesh_axes, shard_opt: bool):
+    return load_config(base={
+        "name": "zero1",
+        "mesh": mesh_axes,
+        "model": {"name": "lenet5", "num_classes": 10, "dtype": "float32"},
+        "data": {"name": "synthetic_images", "global_batch_size": 64,
+                 "image_size": 28, "channels": 1},
+        "optimizer": {"name": "adam", "learning_rate": 0.01,
+                      "shard_opt_state": shard_opt},
+        "train": {"total_steps": 5, "log_interval": 5},
+    })
+
+
+def _batch(mesh):
+    rng = np.random.default_rng(0)
+    host = {
+        "image": rng.standard_normal((64, 28, 28, 1)).astype(np.float32),
+        "label": rng.integers(0, 10, 64).astype(np.int32),
+    }
+    return to_global(host, mesh)
+
+
+def _run(mesh_axes, shard_opt: bool, steps: int = 5):
+    cfg = _cfg(mesh_axes, shard_opt)
+    mesh = create_mesh(cfg.mesh)
+    builder = StepBuilder(cfg, mesh)
+    batch = _batch(mesh)
+    state = builder.init_state(0, batch)
+    step = builder.make_train_step(batch)
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    return builder, state, float(jax.device_get(metrics["loss"]))
+
+
+def test_specs_params_replicated_opt_sharded(devices):
+    cfg = _cfg({"data": 4, "fsdp": 2}, True)
+    mesh = create_mesh(cfg.mesh)
+    builder = StepBuilder(cfg, mesh)
+    specs = builder.state_specs(_batch(mesh))
+    # Params and EMA replicated — the reference's DP layout.
+    for leaf in jax.tree.leaves(specs.params, is_leaf=lambda x: isinstance(x, P)):
+        assert leaf == P(), leaf
+    # Adam mu/nu slots sharded over fsdp wherever a dim divides.
+    opt_specs = [
+        s for s in jax.tree.leaves(
+            specs.opt_state, is_leaf=lambda x: isinstance(x, P))
+        if s != P()
+    ]
+    assert opt_specs, "no optimizer-state leaf got sharded"
+    assert any("fsdp" in s for s in opt_specs)
+
+
+def test_zero1_memory_is_sharded(devices):
+    _, state, _ = _run({"data": 4, "fsdp": 2}, True, steps=1)
+    # Find a sharded mu slot: its per-device shard must be half the global.
+    found = False
+    for leaf in jax.tree.leaves(state.opt_state):
+        if hasattr(leaf, "sharding") and leaf.ndim >= 1 and leaf.size > 1:
+            spec = leaf.sharding.spec
+            if any(s == "fsdp" for s in spec if s):
+                shard = leaf.addressable_shards[0].data
+                assert shard.size == leaf.size // 2
+                found = True
+    assert found, "no fsdp-sharded optimizer slot materialized"
+    # Params stay replicated: every device holds the full array.
+    for leaf in jax.tree.leaves(state.params):
+        assert leaf.addressable_shards[0].data.size == leaf.size
+
+
+def test_zero1_trajectory_matches_pure_dp(devices):
+    _, s_dp, loss_dp = _run({"data": 8}, False)
+    _, s_z1, loss_z1 = _run({"data": 4, "fsdp": 2}, True)
+    assert np.isfinite(loss_z1)
+    np.testing.assert_allclose(loss_dp, loss_z1, rtol=1e-5)
+    # Different mesh shapes reduce gradients in different orders; Adam's
+    # eps-division amplifies that float noise slightly, so the tolerance
+    # is loose enough for reduction-order drift but far below any layout
+    # bug (observed worst case ~1e-5 relative after 5 steps).
+    for a, b in zip(jax.tree.leaves(jax.device_get(s_dp.params)),
+                    jax.tree.leaves(jax.device_get(s_z1.params))):
+        np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5)
+
+
+def test_shard_opt_state_rejected_without_fsdp_axis(devices):
+    cfg = _cfg({"data": 8}, True)
+    mesh = create_mesh(cfg.mesh)
+    with pytest.raises(ValueError, match="fsdp"):
+        StepBuilder(cfg, mesh)
+
+
+def test_shard_opt_state_rejected_under_shard_map(devices):
+    cfg = _cfg({"data": 4, "fsdp": 2}, True)
+    cfg.train.spmd_mode = "shard_map"
+    mesh = create_mesh(cfg.mesh)
+    with pytest.raises(ValueError, match="jit"):
+        StepBuilder(cfg, mesh)
