@@ -2,28 +2,54 @@
 """Benchmark: ResNet-50/ImageNet-shape training throughput on the local chip.
 
 Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+  {"metric": "...", "value": N, "unit": "images/sec/chip", "vs_baseline": N,
+   "chip": ..., "tflops_per_sec": ..., "mfu": ..., "bound": ...}
 
 vs_baseline is measured against BASELINE.json's north-star target of
 10,000 images/sec aggregate on v5e-64 → 156.25 images/sec/chip (the
 reference's own published numbers are unrecoverable — BASELINE.md).
+
+MFU and the bottleneck verdict come from XLA's own cost model: the
+compiled train step's ``flops`` / ``bytes accessed`` give achieved
+TFLOP/s, model-flop utilization against the chip's bf16 peak, and
+arithmetic intensity vs the chip's ridge point (peak FLOPs / HBM BW) —
+intensity below the ridge means the step is HBM-bandwidth-bound.
+Measured numbers and analysis are recorded in PERF_NOTES.md.
+
+Set BENCH_TRACE=<dir> to also capture an XPlane trace of the timed window
+(core/profiling.trace) for TensorBoard/Perfetto inspection.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
 import sys
 import time
 
 TARGET_PER_CHIP = 10_000 / 64  # BASELINE.json north star on v5e-64
 
+# device_kind → (peak bf16 FLOP/s, HBM bytes/s). Public spec-sheet numbers.
+CHIP_PEAKS: dict[str, tuple[float, float]] = {
+    "TPU v2": (45e12, 700e9),
+    "TPU v3": (123e12, 900e9),
+    "TPU v4": (275e12, 1228e9),
+    "TPU v5 lite": (197e12, 819e9),   # v5e
+    "TPU v5e": (197e12, 819e9),
+    "TPU v5p": (459e12, 2765e9),
+    "TPU v6 lite": (918e12, 1640e9),  # v6e / Trillium
+    "TPU v6e": (918e12, 1640e9),
+}
 
-def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3) -> float:
+
+def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3) -> dict:
     import jax
     import numpy as np
 
     from distributed_tensorflow_framework_tpu.core.config import load_config
     from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+    from distributed_tensorflow_framework_tpu.core.profiling import trace
     from distributed_tensorflow_framework_tpu.data.infeed import to_global
     from distributed_tensorflow_framework_tpu.train.step import StepBuilder
 
@@ -36,7 +62,7 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3) -> float:
                 "global_batch_size": batch_size,
                 "image_size": 224,
                 "channels": 3,
-                # bf16 infeed: the step is HBM-BW-bound (~95% of v5e peak);
+                # bf16 infeed: the step is HBM-BW-bound (PERF_NOTES.md);
                 # halving image bytes is worth ~3% wall-clock.
                 "image_dtype": "bfloat16",
             },
@@ -62,6 +88,19 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3) -> float:
     state = builder.init_state(0, batch)
     step = builder.make_train_step(batch)
 
+    # XLA's cost model for the compiled step: algorithmic flops and HBM
+    # bytes touched per step (donated state, so this is the steady-state
+    # executable, not init).
+    flops_per_step = bytes_per_step = None
+    try:
+        ca = step.lower(state, batch).compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        flops_per_step = float(ca.get("flops", 0.0)) or None
+        bytes_per_step = float(ca.get("bytes accessed", 0.0)) or None
+    except Exception as e:  # cost model unavailable on some backends
+        print(f"bench: cost_analysis unavailable ({type(e).__name__})",
+              file=sys.stderr)
+
     # NOTE: sync via device_get of a VALUE, not block_until_ready — the
     # latter returns early through the axon remote-execution tunnel and
     # inflates throughput ~10x. Fetch a param leaf so the barrier includes
@@ -73,38 +112,71 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3) -> float:
     for _ in range(warmup):
         state, metrics = step(state, batch)
     sync(state)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, batch)
-    sync(state)
-    dt = time.perf_counter() - t0
-    return batch_size * steps / dt
+    trace_dir = os.environ.get("BENCH_TRACE")
+    ctx = trace(trace_dir) if trace_dir else contextlib.nullcontext()
+    with ctx:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        sync(state)
+        dt = time.perf_counter() - t0
+    return {
+        "images_per_sec": batch_size * steps / dt,
+        "sec_per_step": dt / steps,
+        "flops_per_step": flops_per_step,
+        "bytes_per_step": bytes_per_step,
+    }
 
 
 def main() -> int:
     import jax
 
     n_chips = jax.device_count()
-    value = None
+    chip = jax.devices()[0].device_kind
+    result = None
     for bs in (256 * n_chips, 128 * n_chips, 64 * n_chips):
         try:
-            value = bench_resnet50(bs)
+            result = bench_resnet50(bs)
             break
         except Exception as e:  # OOM → retry smaller
             print(f"bench: batch {bs} failed ({type(e).__name__}), retrying",
                   file=sys.stderr)
-    if value is None:
+    if result is None:
         print(json.dumps({"metric": "resnet50_images_per_sec_per_chip",
                           "value": 0.0, "unit": "images/sec/chip",
-                          "vs_baseline": 0.0}))
+                          "vs_baseline": 0.0, "chip": chip}))
         return 1
-    per_chip = value / n_chips
-    print(json.dumps({
+
+    per_chip = result["images_per_sec"] / n_chips
+    out = {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / TARGET_PER_CHIP, 4),
-    }))
+        "chip": chip,
+        "num_chips": n_chips,
+    }
+    peak = CHIP_PEAKS.get(chip)
+    if result["flops_per_step"]:
+        achieved = result["flops_per_step"] / result["sec_per_step"] / n_chips
+        out["tflops_per_sec"] = round(achieved / 1e12, 2)
+        if result["bytes_per_step"]:
+            intensity = result["flops_per_step"] / result["bytes_per_step"]
+            out["arith_intensity"] = round(intensity, 1)
+        if peak:
+            peak_flops, hbm_bw = peak
+            out["mfu"] = round(achieved / peak_flops, 4)
+            if result["bytes_per_step"]:
+                ridge = peak_flops / hbm_bw
+                out["bound"] = (
+                    "hbm_bandwidth" if intensity < ridge else "compute"
+                )
+                # Fraction of peak HBM bandwidth actually sustained.
+                out["hbm_bw_util"] = round(
+                    result["bytes_per_step"] / result["sec_per_step"]
+                    / n_chips / hbm_bw, 4,
+                )
+    print(json.dumps(out))
     return 0
 
 

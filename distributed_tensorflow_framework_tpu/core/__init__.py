@@ -17,6 +17,6 @@ from distributed_tensorflow_framework_tpu.core.mesh import (  # noqa: F401
 )
 from distributed_tensorflow_framework_tpu.core.prng import (  # noqa: F401
     fold_in_step,
+    host_rng,
     make_root_key,
-    split_for_hosts,
 )

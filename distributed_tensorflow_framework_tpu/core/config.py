@@ -217,6 +217,10 @@ class TrainConfig:
     total_steps: int = 100
     log_interval: int = 10
     eval_interval: int = 0        # 0 disables mid-training eval
+    # Batches per MID-TRAINING eval firing, and the fallback length for
+    # infinite (synthetic) eval streams. The final eval and --eval-only
+    # always walk the FULL validation set when the stream is finite
+    # (exact-eval contract); 0 disables the final eval entirely.
     eval_steps: int = 10
     seed: int = 42
     # "jit" = pjit-style automatic partitioning; "shard_map" = explicit

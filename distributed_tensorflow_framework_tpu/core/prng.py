@@ -1,26 +1,43 @@
-"""PRNG discipline.
+"""PRNG discipline — the single source of randomness derivation.
 
 The reference seeds ``tf.set_random_seed`` globally and relies on per-op
-graph seeds (SURVEY.md §4 "input-pipeline determinism"). JAX keys are
-explicit; the framework's discipline is:
+graph seeds (SURVEY.md §4 "input-pipeline determinism"). Here every
+random stream derives from the experiment seed through ONE of two
+documented paths:
+
+**Device side** (jax keys; traced inside jit):
 
   root key (experiment seed)
-    ├─ fold_in(ROLE_*)            per subsystem (init / dropout / data)
-    ├─ fold_in(step)              per training step
-    └─ fold_in(process_index)     only for host-local streams (data feed)
+    ├─ for_role(ROLE_INIT / ROLE_DROPOUT)   per subsystem
+    └─ fold_in_step(step)                    per training step
 
-Device-side keys are never host-dependent so that the SPMD program is
+Device-side keys are never host-dependent so the SPMD program is
 identical on every host.
+
+**Host side** (numpy generators; data pipelines): ``host_rng(seed, role,
+*context)`` seeds ``np.random.default_rng`` with the full derivation
+tuple. Context integers are stream coordinates (epoch, batch index,
+process index). Rules:
+
+  * include ``process_index`` iff the stream is host-local (per-host
+    synthetic data, per-example augmentation) — NEVER for decisions that
+    must agree across hosts (the epoch shuffle permutation all hosts
+    stride-index into);
+  * include the batch/epoch counters the resume snapshot records, so a
+    restored pipeline re-derives identical randomness (resume exactness,
+    SURVEY.md §7 hard part 3).
 """
 
 from __future__ import annotations
 
 import jax
+import numpy as np
 
-ROLE_INIT = 0
-ROLE_DROPOUT = 1
-ROLE_DATA = 2
-ROLE_MASK = 3  # MLM masking
+ROLE_INIT = 0     # parameter init (device)
+ROLE_DROPOUT = 1  # dropout / stochastic layers (device)
+ROLE_DATA = 2     # data stream content + order (host)
+ROLE_MASK = 3     # MLM dynamic masking (host)
+ROLE_AUGMENT = 4  # per-example augmentation (host)
 
 
 def make_root_key(seed: int) -> jax.Array:
@@ -35,6 +52,6 @@ def fold_in_step(key: jax.Array, step) -> jax.Array:
     return jax.random.fold_in(key, step)
 
 
-def split_for_hosts(key: jax.Array, process_index: int) -> jax.Array:
-    """Host-local stream (data pipelines only — never device compute)."""
-    return jax.random.fold_in(key, process_index)
+def host_rng(seed: int, role: int, *context: int) -> np.random.Generator:
+    """Host-side generator for data pipelines (see module docstring)."""
+    return np.random.default_rng((seed, role, *context))

@@ -5,15 +5,17 @@ The reference's option here is TF1 ``tf.RunMetadata`` + timeline JSON /
 TensorBoard/Perfetto plus lightweight step annotations:
 
   * ``trace(logdir)``       — context manager around a window of steps
-                              (``jax.profiler.start_trace``/``stop_trace``)
+                              (``jax.profiler.start_trace``/``stop_trace``);
+                              bench.py wraps its timed window in it
   * ``annotate(name)``      — named region inside a traced window
-                              (``jax.profiler.TraceAnnotation``)
-  * ``StepTimer``           — host-side per-phase wall timing that works
-                              without any trace infrastructure (printed by
-                              the metric writer)
+                              (``jax.profiler.TraceAnnotation``); the
+                              Trainer annotates every ``train_step`` dispatch
+  * ``StepTimer``           — host-side per-phase wall timing (infeed /
+                              dispatch / metrics_fetch), reported as
+                              ``time_*_ms`` in the Trainer's logged metrics
 
-The Trainer exposes ``--set train.profile_steps=[start,stop]`` via
-ProfileHook in train/hooks.py.
+Step-window traces during training: ``--set train.profile_start=N
+--set train.profile_stop=M`` via ProfileHook (train/hooks.py).
 """
 
 from __future__ import annotations

@@ -15,6 +15,7 @@ import pickle
 import numpy as np
 
 from distributed_tensorflow_framework_tpu.core.config import DataConfig
+from distributed_tensorflow_framework_tpu.core import prng
 from distributed_tensorflow_framework_tpu.data.pipeline import (
     HostDataset,
     host_batch_size,
@@ -71,7 +72,9 @@ def make_cifar10(config: DataConfig, process_index: int, process_count: int,
         state.setdefault("epoch", 0)
         state.setdefault("batch_in_epoch", 0)
         while True:
-            rng = np.random.default_rng(config.seed * 977 + state["epoch"])
+            # Cross-host-shared shuffle (no process_index — see
+            # core/prng.py host-side rules).
+            rng = prng.host_rng(config.seed, prng.ROLE_DATA, state["epoch"])
             perm = rng.permutation(n)
             shard = perm[process_index::process_count]
             batches = len(shard) // b
@@ -79,9 +82,11 @@ def make_cifar10(config: DataConfig, process_index: int, process_count: int,
                 idx = shard[i * b:(i + 1) * b]
                 x = images[idx]
                 if train:
-                    # pad-4 + random crop + random flip
-                    crop_rng = np.random.default_rng(
-                        (config.seed, state["epoch"], i, process_index)
+                    # pad-4 + random crop + random flip (host-local
+                    # augmentation: process_index IS in the derivation)
+                    crop_rng = prng.host_rng(
+                        config.seed, prng.ROLE_AUGMENT,
+                        state["epoch"], i, process_index,
                     )
                     padded = np.pad(
                         x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect"

@@ -13,6 +13,7 @@ import os
 import numpy as np
 
 from distributed_tensorflow_framework_tpu.core.config import DataConfig
+from distributed_tensorflow_framework_tpu.core import prng
 from distributed_tensorflow_framework_tpu.data.pipeline import (
     HostDataset,
     host_batch_size,
@@ -63,7 +64,9 @@ def make_mnist(config: DataConfig, process_index: int, process_count: int,
         state.setdefault("epoch", 0)
         state.setdefault("batch_in_epoch", 0)
         while True:
-            rng = np.random.default_rng(config.seed * 131 + state["epoch"])
+            # Cross-host-shared shuffle: every host strides the SAME
+            # permutation, so no process_index (core/prng.py rules).
+            rng = prng.host_rng(config.seed, prng.ROLE_DATA, state["epoch"])
             perm = rng.permutation(n)
             # Each host reads a disjoint shard of the shuffled epoch.
             shard = perm[process_index::process_count]

@@ -13,6 +13,7 @@ from typing import Any
 import numpy as np
 
 from distributed_tensorflow_framework_tpu.core.config import DataConfig
+from distributed_tensorflow_framework_tpu.core import prng
 from distributed_tensorflow_framework_tpu.data.pipeline import (
     HostDataset,
     image_np_dtype,
@@ -39,9 +40,11 @@ def synthetic_images(
 
     def make_iter(state: dict[str, Any]):
         state.setdefault("step", 0)
-        seed_base = (config.seed * 1_000_003 + process_index) & 0x7FFFFFFF
         while True:
-            rng = np.random.default_rng(seed_base + state["step"])
+            # Host-local stream: process_index in the derivation
+            # (core/prng.py host-side rules).
+            rng = prng.host_rng(config.seed, prng.ROLE_DATA,
+                                process_index, state["step"])
             images = rng.standard_normal((b, h, w, c), dtype=np.float32)
             # Label = argmax over the first num_classes pixels: uniform over
             # classes, perfectly learnable, and stable at any image size
@@ -72,14 +75,14 @@ def synthetic_mlm(
 
     def make_iter(state: dict[str, Any]):
         state.setdefault("step", 0)
-        seed_base = (config.seed * 1_000_003 + process_index) & 0x7FFFFFFF
         # BERT's [MASK]=103 when it sits below the token range [lo, vocab)
         # (vocab > 103 is NOT enough: e.g. vocab=128 → tokens span [64,128)
         # and 103 would collide with a real token). Fallback is id 0, which
         # is always below lo>=1 and in embedding range.
         mask_id = 103 if lo > 103 else 0
         while True:
-            rng = np.random.default_rng(seed_base + state["step"])
+            rng = prng.host_rng(config.seed, prng.ROLE_DATA,
+                                process_index, state["step"])
             tokens = rng.integers(lo, vocab, size=(b, s), dtype=np.int64).astype(np.int32)
             mask = rng.random((b, s)) < config.mask_prob
             mask[:, 0] = False

@@ -15,6 +15,7 @@ import os
 import numpy as np
 
 from distributed_tensorflow_framework_tpu.core.config import DataConfig
+from distributed_tensorflow_framework_tpu.core import prng
 from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset, host_batch_size
 from distributed_tensorflow_framework_tpu.data import synthetic
 from distributed_tensorflow_framework_tpu.data.tfdata import tfdata_to_hostdataset
@@ -150,8 +151,9 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
         base.restore(state.get("inner", base.state()))
         for batch in base:
             state["inner"] = base.state()
-            rng = np.random.default_rng(
-                (config.seed, state["inner"].get("batches", 0), process_index)
+            rng = prng.host_rng(
+                config.seed, prng.ROLE_MASK,
+                state["inner"].get("batches", 0), process_index,
             )
             inputs, targets = apply_mlm_mask(batch["tokens"], rng,
                                              config.mask_prob,
@@ -202,8 +204,9 @@ def _make_mlm_native(config: DataConfig, files: list[str],
             for i, tokens in enumerate(it):
                 if i < skip:
                     continue
-                rng = np.random.default_rng(
-                    (config.seed, state["epoch"], i, process_index)
+                rng = prng.host_rng(
+                    config.seed, prng.ROLE_MASK,
+                    state["epoch"], i, process_index,
                 )
                 inputs, targets = apply_mlm_mask(tokens, rng, config.mask_prob,
                                                  config.vocab_size)

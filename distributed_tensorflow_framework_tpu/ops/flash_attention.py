@@ -1,19 +1,22 @@
-"""Fused attention Pallas kernel.
+"""Fused attention Pallas kernels — forward AND backward.
 
-Computes softmax(qkᵀ/√d)·v with the S×S score matrix living only in VMEM —
-one HBM read of q/k/v and one write of the output per (batch, head, q-block)
-program, the memory-optimal pattern for self-attention at BERT-scale
-sequence lengths. XLA alone materializes (or at best tiles) the score
-tensor through HBM for the unfused einsum+softmax+einsum chain; this kernel
-is the TPU analogue of the reference's fused cuDNN attention path would-be
-(the reference predates flash attention; SURVEY.md §5 long-context row).
+Computes softmax(qkᵀ/√d)·v with the S×S score matrix living only in VMEM.
+Forward: one HBM read of q/k/v and one write of o (+ the per-row
+logsumexp) per (batch, head, q-block) program. Backward: two Pallas
+kernels (dq over q-blocks; dk/dv over k-blocks) that RECOMPUTE the
+probability blocks online from the saved (q, k, v, o, lse) — so training
+peak memory is O(S·D) end to end; no O(S²) tensor is ever materialized in
+HBM in either direction. This is the flash-attention recompute pattern
+(PAPERS.md); XLA alone tiles but still round-trips the score tensor for
+the unfused einsum+softmax+einsum chain.
 
-Shapes: q, k, v are (B, S, H, D); grid is (B, H, S/BLOCK_Q); each program
-holds its q block and the full K/V for that head in VMEM (fine to S≈4K;
-beyond that use ring attention over the ``seq`` mesh axis or the xla impl).
+Shapes: q, k, v are (B, S, H, D); each program holds its block plus the
+full opposing sequence for that head in VMEM (fine to S≈4K; beyond that
+use ring attention over the ``seq`` mesh axis or the xla impl).
 
-The kernel runs in interpret mode off-TPU so the CPU test mesh exercises
-the same code path.
+The kernels run in interpret mode off-TPU so the CPU test mesh exercises
+the same code path; tests/test_attention.py pins fwd+bwd numerics against
+the plain-XLA reference.
 """
 
 from __future__ import annotations
@@ -26,12 +29,14 @@ from jax.experimental import pallas as pl
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 BLOCK_Q = 128
-# Whole-K VMEM budget: S*D*4B*2 (K and V, f32 upcast) + scores BLOCK_Q*S*4B
+BLOCK_K = 128
+# Whole-K VMEM budget: S*D*4B*2 (K and V, f32 upcast) + scores BLOCK*S*4B
 # must fit in ~16MB with double buffering.
 MAX_SEQ_VMEM = 4096
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale: float):
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                     *, scale: float):
     q = q_ref[0, 0].astype(jnp.float32)          # (BQ, D)
     k = k_ref[0, 0].astype(jnp.float32)          # (S, D)
     v = v_ref[0, 0].astype(jnp.float32)          # (S, D)
@@ -39,7 +44,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale: float):
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale                                     # (BQ, S)
-    s = s + bias_ref[0][None, :]                  # additive mask bias
+    s = s + bias_ref[0]                           # additive mask bias, (1,S)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -48,19 +53,78 @@ def _attn_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale: float):
         preferred_element_type=jnp.float32,
     ) / l                                         # (BQ, D)
     o_ref[0, 0] = o.astype(o_ref.dtype)
+    # Per-row logsumexp: the only softmax statistic the backward needs.
+    lse_ref[0, 0] = (m + jnp.log(l)).astype(jnp.float32)
+
+
+def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                        delta_ref, dq_ref, *, scale: float):
+    """dQ for one q-block: recompute p from (q, k, lse), no S×S residual."""
+    q = q_ref[0, 0].astype(jnp.float32)           # (BQ, D)
+    k = k_ref[0, 0].astype(jnp.float32)           # (S, D)
+    v = v_ref[0, 0].astype(jnp.float32)           # (S, D)
+    do = do_ref[0, 0].astype(jnp.float32)         # (BQ, D)
+    lse = lse_ref[0, 0]                           # (BQ, 1)
+    delta = delta_ref[0, 0]                       # (BQ, 1)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + bias_ref[0]                       # (BQ, S)
+    p = jnp.exp(s - lse)                          # recomputed probabilities
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                             # (BQ, S)
+    ds = p * (dp - delta)                         # (BQ, S)
+    dq = jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                         delta_ref, dk_ref, dv_ref, dbias_ref,
+                         *, scale: float):
+    """dK/dV (+ per-head dbias) for one k-block: full Q/dO in VMEM."""
+    q = q_ref[0, 0].astype(jnp.float32)           # (S, D)
+    k = k_ref[0, 0].astype(jnp.float32)           # (BK, D)
+    v = v_ref[0, 0].astype(jnp.float32)           # (BK, D)
+    do = do_ref[0, 0].astype(jnp.float32)         # (S, D)
+    lse = lse_ref[0, 0]                           # (S, 1)
+    delta = delta_ref[0, 0]                       # (S, 1)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + bias_ref[0]                       # (S, BK)
+    p = jnp.exp(s - lse)
+    dv = jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                             # (BK, D)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                             # (S, BK)
+    ds = p * (dp - delta)                         # (S, BK)
+    dk = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                     # (BK, D)
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    dbias_ref[0, 0] = jnp.sum(ds, axis=0, keepdims=True)  # (1, BK)
 
 
 def _xla_reference(q, k, v, bias):
-    """Plain-XLA attention on the (B,H,S,D) layout — the autodiff source of
-    truth for the backward pass (forward runs the fused kernel; backward
-    rematerializes through this, trading HBM for FLOPs exactly like
-    jax.checkpoint would)."""
+    """Plain-XLA attention on the (B,H,S,D) layout — the numerics source of
+    truth the kernels are tested against (tests/test_attention.py)."""
     d = q.shape[-1]
     s = jax.lax.dot_general(
         q.astype(jnp.float32), k.astype(jnp.float32),
         (((3,), (3,)), ((0, 1), (0, 1))),
     ) / (d ** 0.5)                                  # (B,H,S,S)
-    s = s + bias[:, None, None, :]
+    s = s + bias[:, None, :, :]
     p = jax.nn.softmax(s, axis=-1)
     return jax.lax.dot_general(
         p, v.astype(jnp.float32),
@@ -68,52 +132,122 @@ def _xla_reference(q, k, v, bias):
     ).astype(q.dtype)                               # (B,H,S,D)
 
 
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
 @jax.custom_vjp
 def _fused(q, k, v, bias):
-    interpret = jax.default_backend() != "tpu"
-    return _flash_attention(q, k, v, bias, interpret=interpret)
+    o, _ = _flash_fwd(q, k, v, bias, interpret=_interpret())
+    return o
 
 
 def _fused_fwd(q, k, v, bias):
-    return _fused(q, k, v, bias), (q, k, v, bias)
+    o, lse = _flash_fwd(q, k, v, bias, interpret=_interpret())
+    # Residuals are all O(S·D) / O(S): no score-matrix-shaped tensor saved.
+    return o, (q, k, v, bias, o, lse)
 
 
 def _fused_bwd(res, g):
-    q, k, v, bias = res
-    _, vjp = jax.vjp(_xla_reference, q, k, v, bias)
-    return vjp(g)
+    q, k, v, bias, o, lse = res
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, o, lse, g,
+                                   interpret=_interpret())
+    return dq, dk, dv, dbias
 
 
 _fused.defvjp(_fused_fwd, _fused_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _flash_attention(q, k, v, bias, *, interpret: bool):
+def _flash_fwd(q, k, v, bias, *, interpret: bool):
     b, h, s, d = q.shape
     scale = 1.0 / (d ** 0.5)
     block_q = min(BLOCK_Q, s)
     grid = (b, h, s // block_q)
     return pl.pallas_call(
-        functools.partial(_attn_kernel, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        functools.partial(_attn_fwd_kernel, scale=scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, s), lambda bi, hi, qi: (bi, 0)),
+            pl.BlockSpec((1, 1, s), lambda bi, hi, qi: (bi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        interpret=interpret,
+    )(q, k, v, bias)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _flash_bwd(q, k, v, bias, o, lse, do, *, interpret: bool):
+    b, h, s, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    # delta_i = Σ_d dO_i·O_i — the softmax-jacobian row correction; an
+    # O(S·D) elementwise+reduce, cheap in plain XLA.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)        # (B,H,S,1)
+
+    block_q = min(BLOCK_Q, s)
+    dq = pl.pallas_call(
+        functools.partial(_attn_bwd_dq_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        grid=(b, h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s), lambda bi, hi, qi: (bi, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)
         ),
         interpret=interpret,
-    )(q, k, v, bias)
+    )(q, k, v, bias, do, lse, delta)
+
+    block_k = min(BLOCK_K, s)
+    dk, dv, dbias_h = pl.pallas_call(
+        functools.partial(_attn_bwd_dkv_kernel, scale=scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
+        ],
+        grid=(b, h, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bi, hi, ki: (bi, 0, ki)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, 1, block_k), lambda bi, hi, ki: (bi, hi, 0, ki)),
+        ],
+        interpret=interpret,
+    )(q, k, v, bias, do, lse, delta)
+    dbias = jnp.sum(dbias_h, axis=1)               # (B, 1, S): Σ over heads
+    return dq, dk, dv, dbias
 
 
 def flash_attention(q, k, v, *, mask=None):
     """Fused attention. q,k,v: (B, S, H, D); mask: (B,1,1,S) bool or None.
 
-    Returns (B, S, H, D) in q's dtype.
+    Returns (B, S, H, D) in q's dtype. Differentiable end to end with
+    Pallas forward AND backward kernels (module docstring).
     """
     b, s, hh, d = q.shape
     if s > MAX_SEQ_VMEM:
@@ -128,8 +262,8 @@ def flash_attention(q, k, v, *, mask=None):
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     if mask is not None:
-        bias = jnp.where(mask[:, 0, 0, :], 0.0, NEG_INF).astype(jnp.float32)
+        bias = jnp.where(mask[:, 0, :, :], 0.0, NEG_INF).astype(jnp.float32)
     else:
-        bias = jnp.zeros((b, s), jnp.float32)
+        bias = jnp.zeros((b, 1, s), jnp.float32)
     out = _fused(qt, kt, vt, bias)
     return out.transpose(0, 2, 1, 3)

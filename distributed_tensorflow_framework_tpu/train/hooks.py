@@ -142,12 +142,18 @@ class ProfileHook(BaseHook):
 
 
 class EvalHook(BaseHook):
-    """Mid-training eval — the reference's eval loop (SURVEY.md §3.4)."""
+    """Mid-training eval — the reference's eval loop (SURVEY.md §3.4).
 
-    def __init__(self, eval_fn, interval: int):
+    ``num_batches`` caps each firing (train.eval_steps); None walks the
+    full validation set every interval — usually only wanted for small
+    sets.
+    """
+
+    def __init__(self, eval_fn, interval: int, *, num_batches: int | None = None):
         self.eval_fn = eval_fn
         self.interval = max(1, interval)
+        self.num_batches = num_batches
 
     def after_step(self, trainer, step, metrics) -> None:
         if step > 0 and step % self.interval == 0:
-            self.eval_fn(step)
+            self.eval_fn(step, num_batches=self.num_batches)

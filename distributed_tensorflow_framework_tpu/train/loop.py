@@ -21,6 +21,7 @@ from typing import Any
 import jax
 
 from distributed_tensorflow_framework_tpu.core.config import ExperimentConfig
+from distributed_tensorflow_framework_tpu.core import profiling
 from distributed_tensorflow_framework_tpu.core.mesh import MeshRuntime, initialize_runtime
 from distributed_tensorflow_framework_tpu.core.metrics import MetricWriter, setup_logging
 from distributed_tensorflow_framework_tpu.data import get_dataset
@@ -54,7 +55,23 @@ class Trainer:
         self.data_ckpt_state: dict = self.dataset.state()
 
     # -------------------------------------------------------------- setup --
+    def _validate_eval_config(self) -> None:
+        """Fail at BUILD time for eval configs that would only crash after
+        training finishes (the lazily-built eval pipeline would otherwise
+        raise at the first evaluate() — potentially hours in)."""
+        cfg = self.config
+        will_eval = cfg.train.eval_steps > 0 or cfg.train.eval_interval > 0
+        eval_cfg = cfg.eval_data or cfg.data
+        if (will_eval and eval_cfg.use_native_reader
+                and eval_cfg.name.lower() in ("text_mlm", "mlm")):
+            raise ValueError(
+                "use_native_reader has no exact-eval path (data/text_mlm.py) "
+                "— use the tf.data reader for eval_data, or disable eval "
+                "(train.eval_steps=0, train.eval_interval=0)"
+            )
+
     def build(self) -> None:
+        self._validate_eval_config()
         # Peek one batch for shapes, then restore the stream to the start.
         start_state = self.dataset.state()
         host_batch = next(self.dataset)
@@ -97,7 +114,13 @@ class Trainer:
                 )
             )
         if cfg.train.eval_interval > 0:
-            hooks.append(hooks_lib.EvalHook(self.evaluate, cfg.train.eval_interval))
+            # Mid-training evals are BOUNDED by eval_steps (a full 50k-image
+            # pass every interval would stall training); the final eval and
+            # --eval-only walk the complete validation set.
+            hooks.append(hooks_lib.EvalHook(
+                self.evaluate, cfg.train.eval_interval,
+                num_batches=cfg.train.eval_steps or None,
+            ))
         if cfg.train.profile_stop > cfg.train.profile_start and self.runtime.is_chief:
             import os
 
@@ -124,9 +147,16 @@ class Trainer:
         infeed = prefetch_to_device(
             self.dataset, self.mesh, size=self.config.data.prefetch
         )
+        # Host-side phase timing (core/profiling.py): infeed vs dispatch vs
+        # metric-fetch wall time, reported at every log interval — the
+        # cheap always-on signal for "is the input pipeline the wall?"
+        # (SURVEY.md §7 hard part 1) without capturing a trace.
+        timer = profiling.StepTimer()
         while self.host_step < cfg.total_steps:
-            batch, self.data_ckpt_state = next(infeed)
-            self.state, metrics = self.train_step(self.state, batch)
+            with timer.phase("infeed"):
+                batch, self.data_ckpt_state = next(infeed)
+            with timer.phase("dispatch"), profiling.annotate("train_step"):
+                self.state, metrics = self.train_step(self.state, batch)
             self.host_step += 1
             fetch = (
                 self.host_step % cfg.log_interval == 0
@@ -136,9 +166,12 @@ class Trainer:
             if fetch:
                 # Only here does the host sync with the device; off-interval
                 # steps dispatch asynchronously.
-                host_metrics = {
-                    k: float(v) for k, v in jax.device_get(metrics).items()
-                }
+                with timer.phase("metrics_fetch"):
+                    host_metrics = {
+                        k: float(v) for k, v in jax.device_get(metrics).items()
+                    }
+                host_metrics.update(timer.means())
+                timer.reset()
                 last_metrics = host_metrics
             for h in hooks:
                 h.after_step(self, self.host_step, host_metrics)
@@ -148,11 +181,18 @@ class Trainer:
 
     # ---------------------------------------------------------------- eval --
     def _ensure_eval(self):
-        """Build the eval pipeline + compiled eval step ONCE; reused across
-        every EvalHook firing and final eval (rebuilding the TFRecord
-        pipeline per call was the round-1 waste)."""
-        if getattr(self, "_eval_ds", None) is None:
-            eval_cfg = self.config.eval_data or self.config.data
+        """Build the eval pipeline + compiled eval step ONCE per eval
+        config; reused across every EvalHook firing and final eval
+        (rebuilding the TFRecord pipeline per call was the round-1 waste).
+        Swapping ``config.eval_data`` invalidates the cache — the next
+        evaluate() rebuilds pipeline AND compiled step."""
+        eval_cfg = self.config.eval_data or self.config.data
+        if getattr(self, "_eval_ds", None) is None or \
+                getattr(self, "_eval_cfg", None) is not eval_cfg:
+            if getattr(self, "_eval_cfg", None) is not None \
+                    and self._eval_cfg is not eval_cfg:
+                self.eval_step = None  # element spec may differ — recompile
+            self._eval_cfg = eval_cfg
             self._eval_ds = get_dataset(
                 eval_cfg,
                 process_index=self.runtime.process_index,

@@ -5,7 +5,13 @@ jax.distributed.initialize exercise the cross-host code paths (env-var
 topology discovery, per-host data sharding, global-array assembly, psum
 across processes) without a real multi-host slice.
 
-Usage: python distributed_worker.py <port> <num_procs> <proc_id>
+Usage: python distributed_worker.py <port> <num_procs> <proc_id> [eval_dir]
+
+With ``eval_dir`` (a directory of ``validation-*`` TFRecords), the worker
+additionally runs the EXACT multi-host eval path: hosts hold uneven file
+shards, agree on the padded batch count via the process_allgather in
+``eval_batches_all_hosts``, and must produce identical full-set metrics
+without deadlocking.
 """
 
 import os
@@ -14,6 +20,7 @@ import sys
 
 def main() -> int:
     port, num_procs, proc_id = sys.argv[1:4]
+    eval_dir = sys.argv[4] if len(sys.argv) > 4 else None
     os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
     os.environ["JAX_NUM_PROCESSES"] = num_procs
     os.environ["JAX_PROCESS_ID"] = proc_id
@@ -34,8 +41,11 @@ def main() -> int:
         "name": "mp-lenet",
         "mesh": {"data": -1},
         "model": {"name": "lenet5", "num_classes": 10, "dtype": "float32"},
+        # 32x32x3 when exercising the TFRecord eval path so the trained
+        # params match the eval pipeline's image shape.
         "data": {"name": "synthetic_images", "global_batch_size": 32,
-                 "image_size": 28, "channels": 1},
+                 "image_size": 32 if eval_dir else 28,
+                 "channels": 3 if eval_dir else 1},
         "optimizer": {"name": "sgd_momentum", "learning_rate": 0.05},
         "train": {"total_steps": 5, "log_interval": 5, "seed": 0},
     })
@@ -47,6 +57,21 @@ def main() -> int:
     metrics = trainer.train()
     # Every process must agree on the (replicated) loss.
     print(f"RESULT process={proc_id} loss={metrics['loss']:.6f}", flush=True)
+
+    if eval_dir:
+        from distributed_tensorflow_framework_tpu.core.config import DataConfig
+
+        trainer.config.eval_data = DataConfig(
+            name="imagenet", data_dir=eval_dir, global_batch_size=8,
+            image_size=32, num_classes=10,
+        )
+        results = trainer.evaluate()
+        print(
+            f"EVAL process={proc_id} "
+            f"examples={results['eval_examples']:.0f} "
+            f"loss={results['eval_loss']:.6f}",
+            flush=True,
+        )
     return 0
 
 

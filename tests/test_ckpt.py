@@ -9,11 +9,13 @@ and RNG stream.
 
 import jax
 import numpy as np
+import pytest
 
 from tests.test_train_lenet import lenet_config
 from distributed_tensorflow_framework_tpu.train import Trainer
 
 
+@pytest.mark.slow
 def test_resume_exactness(devices, tmp_path):
     ckpt_dir = str(tmp_path / "ckpt")
 

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from distributed_tensorflow_framework_tpu.core.config import load_config
 from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
@@ -52,6 +53,7 @@ def test_wire_dtype_rejected_under_jit(devices):
         StepBuilder(cfg, mesh)
 
 
+@pytest.mark.slow
 def test_bf16_wire_close_to_f32(devices):
     p32, l32 = _run("")
     p16, l16 = _run("bfloat16")

@@ -3,6 +3,7 @@ tf.train.ExponentialMovingAverage of the reference recipe class."""
 
 import jax
 import numpy as np
+import pytest
 
 from distributed_tensorflow_framework_tpu.core.config import load_config
 from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
@@ -69,6 +70,7 @@ def _cfg_ckpt(ckpt_dir: str, ema_decay: float, total_steps: int = 4):
     return load_config(base=base)
 
 
+@pytest.mark.slow
 def test_ema_toggle_across_resume(devices, tmp_path):
     """optimizer.ema_decay flipped across a restart must not fail the
     restore (ADVICE r1: StandardRestore template mismatch)."""

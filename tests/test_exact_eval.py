@@ -113,6 +113,95 @@ def test_exact_eval_matches_numpy_reference(devices, mnist_npz):
     assert results["eval_loss"] == pytest.approx(ref_loss, rel=1e-5)
 
 
+def test_native_reader_eval_rejected_at_build(devices, tmp_path):
+    """A config that would crash at the FIRST evaluate() (native MLM reader
+    has no exact-eval path) must fail at build time, not after training."""
+    import tensorflow as tf
+
+    root = str(tmp_path / "mlm")
+    os.makedirs(root)
+    with tf.io.TFRecordWriter(os.path.join(root, "a.tfrecord")) as w:
+        ids = np.arange(16, dtype=np.int64) + 100
+        w.write(tf.train.Example(features=tf.train.Features(feature={
+            "input_ids": tf.train.Feature(
+                int64_list=tf.train.Int64List(value=ids)),
+        })).SerializeToString())
+    cfg = load_config(base={
+        "name": "native-eval-reject",
+        "mesh": {"data": 8},
+        "model": {"name": "bert", "vocab_size": 512, "hidden_size": 32,
+                  "num_layers": 1, "num_heads": 2, "mlp_dim": 64,
+                  "max_seq_len": 16, "dtype": "float32"},
+        "data": {"name": "text_mlm", "data_dir": root, "seq_len": 16,
+                 "global_batch_size": 8, "use_native_reader": True},
+        "train": {"total_steps": 2, "eval_steps": 2},
+    })
+    trainer = Trainer(cfg)
+    with pytest.raises(ValueError, match="exact-eval"):
+        trainer.build()
+
+
+def test_eval_data_swap_invalidates_cache(devices, mnist_npz):
+    """Pointing config.eval_data somewhere new after a first evaluate()
+    must rebuild the cached pipeline + compiled step, not silently reuse
+    the old one."""
+    from distributed_tensorflow_framework_tpu.core.config import DataConfig
+
+    cfg = load_config(base={
+        "name": "eval-swap",
+        "mesh": {"data": 8},
+        "model": {"name": "lenet5", "num_classes": 10, "dtype": "float32"},
+        "data": {"name": "mnist", "data_dir": mnist_npz,
+                 "global_batch_size": 32, "image_size": 28, "channels": 1},
+        "train": {"total_steps": 2, "log_interval": 2},
+    })
+    trainer = Trainer(cfg)
+    trainer.train()
+    r1 = trainer.evaluate()
+    assert r1["eval_examples"] == N_TEST
+    # Swap eval to the synthetic stream: different pipeline, different
+    # element spec (no weight key), eval_steps fallback applies.
+    trainer.config.eval_data = DataConfig(
+        name="synthetic_images", global_batch_size=32, image_size=28,
+        channels=1,
+    )
+    r2 = trainer.evaluate(num_batches=3)
+    assert r2["eval_examples"] == 3 * 32
+    assert r2["eval_loss"] != r1["eval_loss"]
+
+
+def test_eval_hook_bounded_by_eval_steps(devices, mnist_npz):
+    """Mid-training EvalHook firings evaluate eval_steps batches, not the
+    full set; the final eval still covers everything."""
+    cfg = load_config(base={
+        "name": "eval-bounded",
+        "mesh": {"data": 8},
+        "model": {"name": "lenet5", "num_classes": 10, "dtype": "float32"},
+        "data": {"name": "mnist", "data_dir": mnist_npz,
+                 "global_batch_size": 32, "image_size": 28, "channels": 1},
+        "train": {"total_steps": 4, "log_interval": 2, "eval_interval": 2,
+                  "eval_steps": 1},
+    })
+    trainer = Trainer(cfg)
+    seen = []
+    orig = trainer.evaluate
+
+    def spy(step=None, num_batches=None):
+        out = orig(step=step, num_batches=num_batches)
+        seen.append((num_batches, out["eval_examples"]))
+        return out
+
+    trainer.evaluate = spy
+    trainer.build()
+    trainer.train()  # EvalHook fires at steps 2 and 4
+    final = orig()
+    assert final["eval_examples"] == N_TEST  # full pass
+    assert seen, "EvalHook never fired"
+    for num_batches, examples in seen:
+        assert num_batches == 1
+        assert examples == 32  # one batch, not the full set
+
+
 def test_eval_pipeline_reused_across_calls(devices, mnist_npz):
     cfg = load_config(base={
         "name": "eval-reuse",

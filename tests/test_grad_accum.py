@@ -8,6 +8,7 @@ microbatch gradients == full-batch gradient.
 
 import jax
 import numpy as np
+import pytest
 
 from distributed_tensorflow_framework_tpu.core.config import load_config
 from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
@@ -43,6 +44,7 @@ def _one_step(accum: int, devices):
     return jax.device_get(state.params), jax.device_get(metrics)
 
 
+@pytest.mark.slow
 def test_accum_matches_full_batch(devices):
     p1, m1 = _one_step(1, devices)
     p4, m4 = _one_step(4, devices)
@@ -86,6 +88,7 @@ def _one_mlm_step(accum: int):
     return jax.device_get(state.params), jax.device_get(metrics)
 
 
+@pytest.mark.slow
 def test_accum_matches_full_batch_mlm(devices):
     """MLM normalizes by the per-microbatch masked-token count; the
     weighted accumulation must still reproduce the full-batch gradient."""

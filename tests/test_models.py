@@ -50,6 +50,7 @@ def test_resnet50_param_count():
     assert count == 25_557_032, f"got {count}"
 
 
+@pytest.mark.slow
 def test_resnet50_forward_shape_dtype(devices):
     cfg = ModelConfig(name="resnet50_cifar", num_classes=10, dtype="bfloat16")
     model = get_model(cfg)

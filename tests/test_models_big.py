@@ -3,9 +3,13 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_tensorflow_framework_tpu.core.config import ModelConfig
 from distributed_tensorflow_framework_tpu.models import get_model
+
+# Big-model compile times dominate the suite wall-clock (VERDICT r1 #9).
+pytestmark = pytest.mark.slow
 
 
 def param_count(params) -> int:

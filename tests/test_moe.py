@@ -73,6 +73,7 @@ def moe_cfg():
     })
 
 
+@pytest.mark.slow
 def test_moe_bert_trains_dp_ep_tp(moe_cfg, devices):
     from distributed_tensorflow_framework_tpu.data import get_dataset
 

@@ -21,8 +21,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_training():
+def _run_workers(extra_args=()):
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
@@ -33,7 +32,7 @@ def test_two_process_training():
         subprocess.Popen(
             [sys.executable, os.path.join(os.path.dirname(__file__),
                                           "distributed_worker.py"),
-             str(port), "2", str(i)],
+             str(port), "2", str(i), *extra_args],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
         )
@@ -45,9 +44,51 @@ def test_two_process_training():
         outs.append(out)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_training():
+    outs = _run_workers()
     losses = []
     for out in outs:
         m = re.search(r"RESULT process=\d+ loss=([0-9.]+)", out)
         assert m, out[-2000:]
         losses.append(float(m.group(1)))
     assert losses[0] == pytest.approx(losses[1], abs=1e-6), losses
+
+
+@pytest.mark.slow
+def test_two_process_exact_eval_uneven_shards(tmp_path):
+    """Multi-host exact eval: hosts hold UNEVEN file shards (proc0: 2
+    files/8 records, proc1: 1 file/4 records), agree on the padded batch
+    count via process_allgather, and must report identical full-set
+    metrics covering all 12 records — without deadlocking."""
+    np = pytest.importorskip("numpy")
+    tf = pytest.importorskip("tensorflow")
+
+    eval_dir = str(tmp_path / "val")
+    os.makedirs(eval_dir)
+    rng = np.random.default_rng(0)
+    for f, per_file in enumerate([5, 4, 3]):  # 3 files → stride shards 2/1
+        path = os.path.join(eval_dir, f"validation-{f:05d}-of-00003")
+        with tf.io.TFRecordWriter(path) as w:
+            for r in range(per_file):
+                img = rng.integers(0, 255, (40, 40, 3), dtype=np.uint8)
+                ex = tf.train.Example(features=tf.train.Features(feature={
+                    "image/encoded": tf.train.Feature(bytes_list=tf.train.BytesList(
+                        value=[tf.io.encode_jpeg(img).numpy()])),
+                    "image/class/label": tf.train.Feature(int64_list=tf.train.Int64List(
+                        value=[(r % 10) + 1])),
+                }))
+                w.write(ex.SerializeToString())
+
+    outs = _run_workers((eval_dir,))
+    results = []
+    for out in outs:
+        m = re.search(r"EVAL process=\d+ examples=(\d+) loss=([0-9.]+)", out)
+        assert m, out[-2000:]
+        results.append((int(m.group(1)), float(m.group(2))))
+    # Full coverage (5+4+3=12 records) and cross-host agreement.
+    assert results[0][0] == 12 and results[1][0] == 12, results
+    assert results[0][1] == pytest.approx(results[1][1], abs=1e-6), results

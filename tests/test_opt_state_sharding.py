@@ -86,6 +86,7 @@ def test_zero1_memory_is_sharded(devices):
         assert leaf.addressable_shards[0].data.size == leaf.size
 
 
+@pytest.mark.slow
 def test_zero1_trajectory_matches_pure_dp(devices):
     _, s_dp, loss_dp = _run({"data": 8}, False)
     _, s_z1, loss_z1 = _run({"data": 4, "fsdp": 2}, True)

@@ -51,6 +51,7 @@ def test_pipeline_matches_reference(pp_mesh):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_gradients_match_reference(pp_mesh):
     model = _make_model(pp_mesh)
     rng = np.random.default_rng(1)
@@ -97,6 +98,7 @@ def _pp_cfg(stages=4, microbatches=0, **model_extra):
     })
 
 
+@pytest.mark.slow
 def test_pipeline_trains_dp_pp(pp_mesh):
     from distributed_tensorflow_framework_tpu.data import get_dataset
 

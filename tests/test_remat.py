@@ -22,6 +22,7 @@ def _tiny_bert(remat: bool) -> ModelConfig:
     )
 
 
+@pytest.mark.slow
 def test_remat_exact_logits_and_grads(devices):
     ids = jnp.asarray(np.random.default_rng(0).integers(1, 256, (2, 16)),
                       jnp.int32)

@@ -59,6 +59,7 @@ def test_bfloat16_infeed(devices):
     assert np.isfinite(metrics["loss"])
 
 
+@pytest.mark.slow
 def test_replica_count_invariance(devices):
     """Sync-DP invariant (SURVEY.md §4): N replicas on global batch B must
     match 1 replica on batch B — the grad mean over a sharded batch equals

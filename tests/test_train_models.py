@@ -42,6 +42,7 @@ def test_bert_trains(devices, impl):
     assert metrics["loss"] < 6.0, metrics
 
 
+@pytest.mark.slow
 def test_bert_tensor_parallel(devices):
     """model=4 TP: megatron-style sharded QKV/MLP; loss matches DP run."""
     import jax
@@ -58,6 +59,7 @@ def test_bert_tensor_parallel(devices):
     np.testing.assert_allclose(a, b, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_inception_trains(devices):
     cfg = load_config(base={
         "name": "inception-tiny",
