@@ -88,15 +88,17 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3) -> dict:
     state = builder.init_state(0, batch)
     step = builder.make_train_step(batch)
 
-    # XLA's cost model for the compiled step: algorithmic flops and HBM
-    # bytes touched per step (donated state, so this is the steady-state
-    # executable, not init).
+    # AOT-compile ONCE; the same executable serves the cost model (flops /
+    # HBM bytes per step) AND the warmup/timed loops — a second tracing
+    # through the jit cache would double ResNet-50's compile time.
     flops_per_step = bytes_per_step = None
     try:
-        ca = step.lower(state, batch).compile().cost_analysis()
+        compiled = step.lower(state, batch).compile()
+        ca = compiled.cost_analysis()
         ca = ca[0] if isinstance(ca, (list, tuple)) else ca
         flops_per_step = float(ca.get("flops", 0.0)) or None
         bytes_per_step = float(ca.get("bytes accessed", 0.0)) or None
+        step = compiled
     except Exception as e:  # cost model unavailable on some backends
         print(f"bench: cost_analysis unavailable ({type(e).__name__})",
               file=sys.stderr)

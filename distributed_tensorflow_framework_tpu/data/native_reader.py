@@ -38,7 +38,7 @@ def _build() -> str:
     if os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
         return lib
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           src, "-o", lib]
+           src, "-ljpeg", "-o", lib]
     log.info("building native record reader: %s", " ".join(cmd))
     subprocess.run(cmd, check=True, capture_output=True)
     return lib
@@ -61,6 +61,15 @@ def load_library():
             lib.rr_next_batch_i32.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p,
                 ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int]
+            lib.rr_next_batch_images.restype = ctypes.c_int
+            lib.rr_next_batch_images.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float)]
             lib.rr_error.restype = ctypes.c_char_p
             lib.rr_error.argtypes = [ctypes.c_void_p]
             lib.rr_close.argtypes = [ctypes.c_void_p]
@@ -111,6 +120,58 @@ class NativeRecordReader:
             if rc == 0:
                 return
             yield out.copy()
+
+    def batches_images(self, batch: int, height: int, width: int,
+                       *, image_key: str = "image/encoded",
+                       label_key: str = "image/class/label",
+                       threads: int = 0,
+                       crop_seeds: Iterator[np.ndarray] | None = None,
+                       mean: np.ndarray | None = None,
+                       std: np.ndarray | None = None,
+                       ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """(images f32 (b,h,w,3) in [0,255], labels i32 (b,)) per batch.
+
+        JPEG decode + bilinear resize run in C++ worker threads (the
+        ImageNet host-side hot path, SURVEY.md §7 hard part 1); Python
+        receives finished pixel batches. With ``crop_seeds`` (an iterator
+        of (batch,) uint64 arrays, one per batch), each image gets an
+        Inception-style distorted crop + random flip decoded via PARTIAL
+        IDCT (libjpeg-turbo crop/skip-scanlines) — the decode cost tracks
+        the crop area, the native twin of tf.data's decode_and_crop.
+        ``mean``/``std`` (per-channel, length 3) fuse standardization into
+        the native resize write, skipping a full numpy pass per batch.
+        """
+        images = np.empty((batch, height, width, 3), np.float32)
+        labels = np.empty((batch,), np.int32)
+        iptr = images.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        lptr = labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        null_seeds = ctypes.POINTER(ctypes.c_uint64)()
+        null_f = ctypes.POINTER(ctypes.c_float)()
+        if mean is not None and std is not None:
+            mean_arr = np.ascontiguousarray(mean, np.float32)
+            std_arr = np.ascontiguousarray(std, np.float32)
+            assert mean_arr.shape == (3,) and std_arr.shape == (3,)
+            mptr = mean_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            sptr_std = std_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        else:
+            mptr = sptr_std = null_f
+        while True:
+            if crop_seeds is not None:
+                seeds = np.ascontiguousarray(next(crop_seeds), np.uint64)
+                assert seeds.shape == (batch,)
+                sptr = seeds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+            else:
+                sptr = null_seeds
+            rc = self._lib.rr_next_batch_images(
+                self._h, image_key.encode(), label_key.encode(),
+                iptr, lptr, batch, height, width, threads, sptr,
+                mptr, sptr_std)
+            if rc < 0:
+                self._check_error()
+                raise RuntimeError(f"native image decode error (rc={rc})")
+            if rc == 0:
+                return
+            yield images.copy(), labels.copy()
 
     def close(self):
         if self._h:

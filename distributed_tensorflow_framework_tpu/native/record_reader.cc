@@ -15,13 +15,26 @@
 //   rr_next_record(h, &buf, &len)                -> 1 ok, 0 EOF, <0 error
 //   rr_free(buf)
 //   rr_next_batch_i32(h, key, out, batch, width) -> 1 ok, 0 EOF, <0 error
+//   rr_next_batch_images(h, ikey, lkey, imgs, labels, batch, th, tw,
+//                        threads, crop_seeds, mean, std)
+//                                                -> 1 ok, 0 EOF, <0 error
+//     The native ImageNet input path (SURVEY.md §7 hard part 1):
+//     per-image Inception-style distorted crop + flip sampled from
+//     crop_seeds (host-derived; splitmix64 here), decoded via PARTIAL
+//     IDCT (libjpeg-turbo DCT scaling + crop/skip scanlines — cost tracks
+//     the crop area, the native twin of tf.data's decode_and_crop),
+//     bilinear-resized with per-channel standardization fused into the
+//     output write, multi-threaded across the batch. crop_seeds=null →
+//     full-image resize; mean/std=null → raw [0,255] pixels.
 //   rr_close(h)
 //
 // Build: g++ -O2 -shared -fPIC -std=c++17 -pthread record_reader.cc
-//        -o librecord_reader.so
+//        -ljpeg -o librecord_reader.so
 
 #include <atomic>
+#include <cmath>
 #include <condition_variable>
+#include <csetjmp>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -30,6 +43,8 @@
 #include <string>
 #include <thread>
 #include <vector>
+
+#include <jpeglib.h>
 
 namespace {
 
@@ -262,6 +277,284 @@ int ParseExampleInt64(const char* data, size_t size, const char* key,
   return 0;  // key not found
 }
 
+// Extract the FIRST BytesList value for `key`; returns a view into `data`
+// (no copy) — 1 found, 0 missing, -1 malformed.
+int ParseExampleBytes(const char* data, size_t size, const char* key,
+                      const char** out, uint64_t* out_len) {
+  Cursor ex{reinterpret_cast<const uint8_t*>(data),
+            reinterpret_cast<const uint8_t*>(data) + size};
+  size_t key_len = std::strlen(key);
+  while (ex.ok && ex.p < ex.end) {
+    uint64_t tag = ex.Varint();
+    if (!ex.ok) return -1;
+    if ((tag >> 3) != 1 || (tag & 7) != 2) { ex.Skip(tag & 7); continue; }
+    uint64_t features_len = ex.Varint();
+    Cursor feats{ex.p, ex.p + features_len};
+    ex.p += features_len;
+    while (feats.ok && feats.p < feats.end) {
+      uint64_t ftag = feats.Varint();
+      if (!feats.ok) return -1;
+      if ((ftag >> 3) != 1 || (ftag & 7) != 2) { feats.Skip(ftag & 7); continue; }
+      uint64_t entry_len = feats.Varint();
+      Cursor entry{feats.p, feats.p + entry_len};
+      feats.p += entry_len;
+      bool key_match = false;
+      Cursor value{nullptr, nullptr};
+      while (entry.ok && entry.p < entry.end) {
+        uint64_t etag = entry.Varint();
+        if (!entry.ok) return -1;
+        if ((etag >> 3) == 1 && (etag & 7) == 2) {
+          uint64_t n = entry.Varint();
+          key_match = (n == key_len &&
+                       std::memcmp(entry.p, key, key_len) == 0);
+          entry.p += n;
+        } else if ((etag >> 3) == 2 && (etag & 7) == 2) {
+          uint64_t n = entry.Varint();
+          value = Cursor{entry.p, entry.p + n};
+          entry.p += n;
+        } else {
+          entry.Skip(etag & 7);
+        }
+      }
+      if (!key_match || value.p == nullptr) continue;
+      // value: Feature { bytes_list = 1 } ; BytesList { value = 1 (bytes) }
+      while (value.ok && value.p < value.end) {
+        uint64_t vtag = value.Varint();
+        if (!value.ok) return -1;
+        if ((vtag >> 3) != 1 || (vtag & 7) != 2) { value.Skip(vtag & 7); continue; }
+        uint64_t list_len = value.Varint();
+        Cursor list{value.p, value.p + list_len};
+        value.p += list_len;
+        while (list.ok && list.p < list.end) {
+          uint64_t ltag = list.Varint();
+          if (!list.ok) return -1;
+          if ((ltag >> 3) != 1 || (ltag & 7) != 2) { list.Skip(ltag & 7); continue; }
+          uint64_t n = list.Varint();
+          if (list.p + n > list.end) return -1;
+          *out = reinterpret_cast<const char*>(list.p);
+          *out_len = n;
+          return 1;
+        }
+      }
+    }
+  }
+  return 0;
+}
+
+// ------------------------------------------------------------ JPEG decode --
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf env;
+};
+
+void JpegErrorExit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  std::longjmp(err->env, 1);
+}
+
+// Decode JPEG bytes to 8-bit RGB. When the caller only needs
+// (min_width × min_height) output, DCT-scaled decode (1/2, 1/4, 1/8) does
+// the IDCT at reduced resolution — the dominant decode cost drops nearly
+// quadratically while staying ≥ the resize target (the libjpeg analogue
+// of tf.data's decode_and_crop trick). Pass 0/0 for full resolution.
+bool DecodeJpeg(const char* data, size_t n, std::vector<uint8_t>* rgb,
+                int* width, int* height, int min_width = 0,
+                int min_height = 0) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = JpegErrorExit;
+  if (setjmp(jerr.env)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, reinterpret_cast<const unsigned char*>(data), n);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;  // grayscale/YCbCr → RGB conversion
+  if (min_width > 0 && min_height > 0) {
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = 1;
+    for (int denom = 8; denom >= 2; denom /= 2) {
+      // Output dims at scale 1/denom are ceil(dim/denom).
+      int ow = (static_cast<int>(cinfo.image_width) + denom - 1) / denom;
+      int oh = (static_cast<int>(cinfo.image_height) + denom - 1) / denom;
+      if (ow >= min_width && oh >= min_height) {
+        cinfo.scale_denom = denom;
+        break;
+      }
+    }
+  }
+  jpeg_start_decompress(&cinfo);
+  *width = cinfo.output_width;
+  *height = cinfo.output_height;
+  rgb->resize(static_cast<size_t>(*width) * *height * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = rgb->data() + static_cast<size_t>(cinfo.output_scanline) *
+                                     *width * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Bilinear resize of an (sw,sh) RGB region within a row-stride buffer →
+// float32 (tw,th) RGB, values in [0,255]. Half-pixel-center sampling (the
+// TF2 tf.image.resize convention), so the native pipeline's geometry
+// matches the tf.data pipeline's.
+void ResizeBilinear(const uint8_t* src, int sw, int sh, int src_stride,
+                    float* dst, int tw, int th,
+                    const float* mean = nullptr,
+                    const float* inv_std = nullptr) {
+  const float x_scale = float(sw) / tw;
+  const float y_scale = float(sh) / th;
+  for (int y = 0; y < th; ++y) {
+    float fy = (y + 0.5f) * y_scale - 0.5f;
+    if (fy < 0) fy = 0;
+    if (fy > sh - 1) fy = float(sh - 1);
+    int y0 = static_cast<int>(fy);
+    int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    float wy = fy - y0;
+    for (int x = 0; x < tw; ++x) {
+      float fx = (x + 0.5f) * x_scale - 0.5f;
+      if (fx < 0) fx = 0;
+      if (fx > sw - 1) fx = float(sw - 1);
+      int x0 = static_cast<int>(fx);
+      int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float a = src[(y0 * src_stride + x0) * 3 + c];
+        float b = src[(y0 * src_stride + x1) * 3 + c];
+        float d = src[(y1 * src_stride + x0) * 3 + c];
+        float e = src[(y1 * src_stride + x1) * 3 + c];
+        float top = a + (b - a) * wx;
+        float bot = d + (e - d) * wx;
+        float v = top + (bot - top) * wy;
+        if (mean != nullptr) v = (v - mean[c]) * inv_std[c];
+        dst[(y * tw + x) * 3 + c] = v;
+      }
+    }
+  }
+}
+
+// --------------------------------------------------------------- crop rng --
+// splitmix64 — tiny deterministic PRNG for the crop sampler; the SEED is
+// derived host-side through the documented core/prng.py discipline, the
+// sampling algorithm is fixed here.
+struct Rng {
+  uint64_t s;
+  uint64_t Next() {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ull);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+    return z ^ (z >> 31);
+  }
+  float Uniform() { return (Next() >> 40) * (1.0f / (1 << 24)); }
+};
+
+// Inception-style distorted crop in full-res pixel coords: area fraction
+// U[0.08,1], aspect U[3/4,4/3], 10 attempts, central-full fallback.
+void SampleCrop(Rng* rng, int W, int H, int* cx, int* cy, int* cw, int* ch) {
+  for (int attempt = 0; attempt < 10; ++attempt) {
+    float area = (0.08f + 0.92f * rng->Uniform()) * W * H;
+    float ar = 0.75f + (4.0f / 3 - 0.75f) * rng->Uniform();
+    int w = static_cast<int>(std::sqrt(area * ar) + 0.5f);
+    int h = static_cast<int>(std::sqrt(area / ar) + 0.5f);
+    if (w < 1) w = 1;
+    if (h < 1) h = 1;
+    if (w <= W && h <= H) {
+      *cx = static_cast<int>(rng->Uniform() * (W - w + 1));
+      *cy = static_cast<int>(rng->Uniform() * (H - h + 1));
+      if (*cx > W - w) *cx = W - w;
+      if (*cy > H - h) *cy = H - h;
+      *cw = w;
+      *ch = h;
+      return;
+    }
+  }
+  *cx = 0; *cy = 0; *cw = W; *ch = H;
+}
+
+// Decode ONLY the sampled crop window: DCT-scaled decode sized to the
+// crop, jpeg_crop_scanline for the column range (iMCU-aligned),
+// jpeg_skip_scanlines for the rows above/below — the libjpeg-turbo
+// equivalent of tf.data's fused decode_and_crop, so the IDCT cost tracks
+// the CROP area (8%–100% of the image), not the full frame.
+bool DecodeJpegCropped(const char* data, size_t n, uint64_t seed, int tw,
+                       int th, float* out /* th*tw*3 */,
+                       const float* mean = nullptr,
+                       const float* inv_std = nullptr) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = JpegErrorExit;
+  std::vector<uint8_t> buf;
+  if (setjmp(jerr.env)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, reinterpret_cast<const unsigned char*>(data), n);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  const int W = cinfo.image_width, H = cinfo.image_height;
+
+  Rng rng{seed};
+  int cx, cy, cw, ch;
+  SampleCrop(&rng, W, H, &cx, &cy, &cw, &ch);
+  const bool flip = rng.Uniform() < 0.5f;  // horizontal flip, same stream
+
+  // DCT-scale so the SCALED crop still covers the resize target.
+  cinfo.scale_num = 1;
+  cinfo.scale_denom = 1;
+  for (int denom = 8; denom >= 2; denom /= 2) {
+    if ((cw + denom - 1) / denom >= tw && (ch + denom - 1) / denom >= th) {
+      cinfo.scale_denom = denom;
+      break;
+    }
+  }
+  jpeg_start_decompress(&cinfo);
+  const int ow = cinfo.output_width, oh = cinfo.output_height;
+  // Crop coords in scaled space (clamped).
+  auto scl = [&](int v, int full, int scaled) {
+    long r = static_cast<long>(v) * scaled / full;
+    return static_cast<int>(r);
+  };
+  int sx = scl(cx, W, ow), sy = scl(cy, H, oh);
+  int sw = scl(cw, W, ow), sh = scl(ch, H, oh);
+  if (sw < 1) sw = 1;
+  if (sh < 1) sh = 1;
+  if (sx + sw > ow) sx = ow - sw;
+  if (sy + sh > oh) sy = oh - sh;
+  if (sx < 0) sx = 0;
+  if (sy < 0) sy = 0;
+
+  JDIMENSION xoff = sx, xw = sw;
+  jpeg_crop_scanline(&cinfo, &xoff, &xw);  // aligns to the iMCU grid
+  const int xpad = sx - static_cast<int>(xoff);  // crop offset inside buffer
+  if (sy > 0) jpeg_skip_scanlines(&cinfo, sy);
+  buf.resize(static_cast<size_t>(sh) * xw * 3);
+  while (static_cast<int>(cinfo.output_scanline) < sy + sh) {
+    JSAMPROW row = buf.data() +
+        static_cast<size_t>(cinfo.output_scanline - sy) * xw * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_abort_decompress(&cinfo);  // discard the remaining rows unread
+  jpeg_destroy_decompress(&cinfo);
+
+  ResizeBilinear(buf.data() + static_cast<size_t>(xpad) * 3, sw, sh,
+                 static_cast<int>(xw), out, tw, th, mean, inv_std);
+  if (flip) {
+    for (int y = 0; y < th; ++y)
+      for (int x = 0; x < tw / 2; ++x)
+        for (int c = 0; c < 3; ++c)
+          std::swap(out[(y * tw + x) * 3 + c],
+                    out[(y * tw + (tw - 1 - x)) * 3 + c]);
+  }
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
@@ -313,6 +606,84 @@ int rr_next_batch_i32(void* h, const char* key, int32_t* out, int batch,
   }
   (void)r;
   return 1;
+}
+
+// Pulls `batch` records, decodes their `image_key` JPEGs and bilinearly
+// resizes to (th, tw) into out_images[batch][th][tw][3] (float32, 0..255),
+// and writes the `label_key` int64 into out_labels[batch]. JPEG decode +
+// resize run in `threads` parallel workers across the batch — the hot
+// host-side cost at ImageNet rates. Returns 1 ok, 0 EOF, <0 error.
+int rr_next_batch_images(void* h, const char* image_key,
+                         const char* label_key, float* out_images,
+                         int32_t* out_labels, int batch, int th, int tw,
+                         int threads, const uint64_t* crop_seeds,
+                         const float* mean, const float* stddev) {
+  // Standardization fused into the resize output write: one multiply-add
+  // per pixel instead of a second full pass over the batch in numpy.
+  float inv_std_buf[3];
+  const float* inv_std = nullptr;
+  if (mean != nullptr && stddev != nullptr) {
+    for (int c = 0; c < 3; ++c) inv_std_buf[c] = 1.0f / stddev[c];
+    inv_std = inv_std_buf;
+  } else {
+    mean = nullptr;
+  }
+  // Records must be pulled serially (queue order = deterministic resume
+  // contract); decode is the parallel part.
+  std::vector<std::vector<char>> records(batch);
+  for (int i = 0; i < batch; ++i) {
+    char* buf = nullptr;
+    long len = 0;
+    int rc = rr_next_record(h, &buf, &len);
+    if (rc <= 0) return rc;
+    records[i].assign(buf, buf + len);
+    std::free(buf);
+  }
+  std::atomic<int> next{0};
+  std::atomic<int> failed{-1};
+  int n_threads = threads > 0 ? threads : 8;
+  if (n_threads > batch) n_threads = batch;
+  auto work = [&] {
+    std::vector<uint8_t> rgb;
+    for (int i = next.fetch_add(1); i < batch; i = next.fetch_add(1)) {
+      const auto& rec = records[i];
+      const char* jpg = nullptr;
+      uint64_t jpg_len = 0;
+      if (ParseExampleBytes(rec.data(), rec.size(), image_key, &jpg,
+                            &jpg_len) != 1) {
+        failed = i;
+        return;
+      }
+      float* dst = out_images + static_cast<size_t>(i) * th * tw * 3;
+      if (crop_seeds != nullptr) {
+        // Train path: distorted crop + flip decoded via partial IDCT.
+        if (!DecodeJpegCropped(jpg, jpg_len, crop_seeds[i], tw, th, dst,
+                               mean, inv_std)) {
+          failed = i;
+          return;
+        }
+      } else {
+        int sw = 0, sh = 0;
+        if (!DecodeJpeg(jpg, jpg_len, &rgb, &sw, &sh, tw, th) ||
+            sw <= 0 || sh <= 0) {
+          failed = i;
+          return;
+        }
+        ResizeBilinear(rgb.data(), sw, sh, sw, dst, tw, th, mean, inv_std);
+      }
+      int32_t label = 0;
+      if (ParseExampleInt64(rec.data(), rec.size(), label_key, &label, 1) < 0) {
+        failed = i;
+        return;
+      }
+      out_labels[i] = label;
+    }
+  };
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) pool.emplace_back(work);
+  for (auto& t : pool) t.join();
+  return failed.load() >= 0 ? -3 : 1;
 }
 
 const char* rr_error(void* h) {

@@ -55,23 +55,7 @@ class Trainer:
         self.data_ckpt_state: dict = self.dataset.state()
 
     # -------------------------------------------------------------- setup --
-    def _validate_eval_config(self) -> None:
-        """Fail at BUILD time for eval configs that would only crash after
-        training finishes (the lazily-built eval pipeline would otherwise
-        raise at the first evaluate() — potentially hours in)."""
-        cfg = self.config
-        will_eval = cfg.train.eval_steps > 0 or cfg.train.eval_interval > 0
-        eval_cfg = cfg.eval_data or cfg.data
-        if (will_eval and eval_cfg.use_native_reader
-                and eval_cfg.name.lower() in ("text_mlm", "mlm")):
-            raise ValueError(
-                "use_native_reader has no exact-eval path (data/text_mlm.py) "
-                "— use the tf.data reader for eval_data, or disable eval "
-                "(train.eval_steps=0, train.eval_interval=0)"
-            )
-
     def build(self) -> None:
-        self._validate_eval_config()
         # Peek one batch for shapes, then restore the stream to the start.
         start_state = self.dataset.state()
         host_batch = next(self.dataset)
@@ -79,10 +63,14 @@ class Trainer:
         sample = to_global(host_batch, self.mesh)
         self.state = self.builder.init_state(self.config.train.seed, sample)
         self.train_step = self.builder.make_train_step(sample)
-        # eval_step is compiled lazily from the EVAL stream's sample batch
-        # (its element spec differs from training: weight key, no aug) —
-        # see _ensure_eval().
+        # eval_step compiles from the EVAL stream's sample batch (its
+        # element spec differs from training: weight key, no aug). Built
+        # HERE rather than at the first evaluate() when eval will run, so
+        # any eval-config error (e.g. a native reader with no exact-eval
+        # path) fails at startup — not hours in, after training finishes.
         self.eval_step = None
+        if self.config.train.eval_steps > 0 or self.config.train.eval_interval > 0:
+            self._ensure_eval()
         # Checkpoint manager + auto-restore (MonitoredTrainingSession
         # contract: restore latest from checkpoint_dir if present).
         if self.config.checkpoint.directory:
@@ -114,13 +102,21 @@ class Trainer:
                 )
             )
         if cfg.train.eval_interval > 0:
-            # Mid-training evals are BOUNDED by eval_steps (a full 50k-image
-            # pass every interval would stall training); the final eval and
-            # --eval-only walk the complete validation set.
-            hooks.append(hooks_lib.EvalHook(
-                self.evaluate, cfg.train.eval_interval,
-                num_batches=cfg.train.eval_steps or None,
-            ))
+            if cfg.train.eval_steps > 0:
+                # Mid-training evals are BOUNDED by eval_steps (a full
+                # 50k-image pass every interval would stall training); the
+                # final eval and --eval-only walk the complete set.
+                hooks.append(hooks_lib.EvalHook(
+                    self.evaluate, cfg.train.eval_interval,
+                    num_batches=cfg.train.eval_steps,
+                ))
+            else:
+                # eval_steps=0 disables eval everywhere — don't silently
+                # flip to a full-set pass per interval.
+                log.warning(
+                    "train.eval_interval=%d but eval_steps=0 — mid-training "
+                    "eval disabled", cfg.train.eval_interval,
+                )
         if cfg.train.profile_stop > cfg.train.profile_start and self.runtime.is_chief:
             import os
 

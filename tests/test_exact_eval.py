@@ -121,11 +121,12 @@ def test_native_reader_eval_rejected_at_build(devices, tmp_path):
     root = str(tmp_path / "mlm")
     os.makedirs(root)
     with tf.io.TFRecordWriter(os.path.join(root, "a.tfrecord")) as w:
-        ids = np.arange(16, dtype=np.int64) + 100
-        w.write(tf.train.Example(features=tf.train.Features(feature={
-            "input_ids": tf.train.Feature(
-                int64_list=tf.train.Int64List(value=ids)),
-        })).SerializeToString())
+        for r in range(8):  # a full train batch so the train-peek succeeds
+            ids = np.arange(16, dtype=np.int64) + 100 + r
+            w.write(tf.train.Example(features=tf.train.Features(feature={
+                "input_ids": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=ids)),
+            })).SerializeToString())
     cfg = load_config(base={
         "name": "native-eval-reject",
         "mesh": {"data": 8},
