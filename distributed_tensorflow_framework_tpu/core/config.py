@@ -194,6 +194,12 @@ class DataConfig:
     image_dtype: str = "float32"
     shuffle_buffer: int = 10_000
     prefetch: int = 2
+    # Run the host pipeline pull + device transfer on a producer thread so
+    # decode/augment work overlaps device steps (data/infeed.py). The
+    # batch/snapshot pairing and order are identical to the synchronous
+    # prefetcher; disable when debugging host-side pipeline errors (they
+    # surface with a cleaner stack synchronously).
+    async_infeed: bool = True
     seed: int = 0
     # text / MLM
     seq_len: int = 128

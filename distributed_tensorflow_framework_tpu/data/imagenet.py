@@ -257,8 +257,16 @@ def _make_imagenet_native(config: DataConfig, files: list[str],
                 # already-consumed records WITHOUT JPEG-decoding them —
                 # resume cost is IO-bound, not decode-bound.
                 raw = reader.records()
-                for _ in range(skip * b):
-                    next(raw)
+                for n in range(skip * b):
+                    try:
+                        next(raw)
+                    except StopIteration:
+                        raise RuntimeError(
+                            f"resume snapshot skips {skip * b} records but "
+                            f"this host's shard holds only {n} — the shard "
+                            f"set, process_count or batch size changed "
+                            f"since the checkpoint was taken"
+                        ) from None
             it = reader.batches_images(b, size, size,
                                        crop_seeds=seed_stream(),
                                        mean=mean, std=std)

@@ -28,24 +28,84 @@ def to_global(batch: Mapping[str, np.ndarray], mesh: Mesh,
     }
 
 
-def prefetch_to_device(dataset, mesh: Mesh, *, size: int = 2, spec: P | None = None):
+def prefetch_to_device(dataset, mesh: Mesh, *, size: int = 2,
+                       spec: P | None = None, background: bool = False):
     """Software-pipelined infeed: keep `size` global batches in flight.
 
     The analogue of tf.data's ``prefetch_to_device`` — device transfer of
     batch N+1 overlaps step N's compute (SURVEY.md §7 hard part 1: input
-    throughput, not the model, is the usual wall).
+    throughput, not the model, is the usual wall). With
+    ``background=True`` the host pipeline pull AND the device transfer run
+    on a producer thread, so host-side decode/augment work (e.g. the
+    native JPEG path) genuinely overlaps device steps instead of running
+    in the gaps between dispatches.
 
     Yields ``(global_batch, iterator_state_snapshot)``. The snapshot is the
     dataset's state immediately after the yielded batch was pulled from it —
     i.e. the state to checkpoint so a restore resumes with the NEXT batch.
     Because the prefetcher runs ahead of training, ``dataset.state()`` itself
     is not safe to checkpoint (it reflects the prefetched-ahead position);
-    the snapshot is (resume-exactness, SURVEY.md §7 hard part 3).
+    the snapshot is (resume-exactness, SURVEY.md §7 hard part 3). The
+    dataset is only ever touched from one thread (the producer), so the
+    snapshot/batch pairing is identical in both modes.
     """
+    snap = getattr(dataset, "state", lambda: {})
+
+    if background:
+        import queue as queue_mod
+        import threading
+
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=max(size, 1))
+        stop = threading.Event()
+        _EOF = object()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for host_batch in dataset:
+                    if stop.is_set():
+                        return
+                    if not put((to_global(host_batch, mesh, spec), snap())):
+                        return
+            except BaseException as e:  # surface in the consumer
+                put(e)
+                return
+            put(_EOF)
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="infeed-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _EOF:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # Consumer done (total_steps reached, early break, error):
+            # release the producer — it must NOT keep pulling from the
+            # dataset, which the caller may restore/reuse next.
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue_mod.Empty:
+                    break
+            t.join(timeout=10)
+
     import collections
 
-    queue: collections.deque = collections.deque()
-    snap = getattr(dataset, "state", lambda: {})
+    buf: collections.deque = collections.deque()
 
     def enqueue(n: int) -> None:
         for _ in range(n):
@@ -53,9 +113,9 @@ def prefetch_to_device(dataset, mesh: Mesh, *, size: int = 2, spec: P | None = N
                 host_batch = next(dataset)
             except StopIteration:
                 return
-            queue.append((to_global(host_batch, mesh, spec), snap()))
+            buf.append((to_global(host_batch, mesh, spec), snap()))
 
     enqueue(size)
-    while queue:
-        yield queue.popleft()
+    while buf:
+        yield buf.popleft()
         enqueue(1)

@@ -184,6 +184,14 @@ struct Cursor {
   const uint8_t* end;
   bool ok = true;
 
+  // End pointer for a nested length-delimited view, clamped to this
+  // view's end — a malformed length prefix (CRC only proves the writer
+  // wrote it, not that it is sane) must not create an out-of-bounds
+  // cursor.
+  const uint8_t* Sub(uint64_t len) const {
+    return len > static_cast<uint64_t>(end - p) ? end : p + len;
+  }
+
   uint64_t Varint() {
     uint64_t v = 0;
     int shift = 0;
@@ -201,7 +209,12 @@ struct Cursor {
     switch (wire_type) {
       case 0: Varint(); break;
       case 1: p += 8; break;
-      case 2: { uint64_t n = Varint(); p += n; break; }
+      case 2: {
+        uint64_t n = Varint();
+        if (n > static_cast<uint64_t>(end - p)) { ok = false; p = end; }
+        else { p += n; }
+        break;
+      }
       case 5: p += 4; break;
       default: ok = false;
     }
@@ -220,14 +233,14 @@ int ParseExampleInt64(const char* data, size_t size, const char* key,
     if (!ex.ok) return -1;
     if ((tag >> 3) != 1 || (tag & 7) != 2) { ex.Skip(tag & 7); continue; }
     uint64_t features_len = ex.Varint();
-    Cursor feats{ex.p, ex.p + features_len};
+    Cursor feats{ex.p, ex.Sub(features_len)};
     ex.p += features_len;
     while (feats.ok && feats.p < feats.end) {
       uint64_t ftag = feats.Varint();
       if (!feats.ok) return -1;
       if ((ftag >> 3) != 1 || (ftag & 7) != 2) { feats.Skip(ftag & 7); continue; }
       uint64_t entry_len = feats.Varint();
-      Cursor entry{feats.p, feats.p + entry_len};
+      Cursor entry{feats.p, feats.Sub(entry_len)};
       feats.p += entry_len;
       bool key_match = false;
       Cursor value{nullptr, nullptr};
@@ -241,7 +254,7 @@ int ParseExampleInt64(const char* data, size_t size, const char* key,
           entry.p += n;
         } else if ((etag >> 3) == 2 && (etag & 7) == 2) {
           uint64_t n = entry.Varint();
-          value = Cursor{entry.p, entry.p + n};
+          value = Cursor{entry.p, entry.Sub(n)};
           entry.p += n;
         } else {
           entry.Skip(etag & 7);
@@ -254,7 +267,7 @@ int ParseExampleInt64(const char* data, size_t size, const char* key,
         if (!value.ok) return -1;
         if ((vtag >> 3) != 3 || (vtag & 7) != 2) { value.Skip(vtag & 7); continue; }
         uint64_t list_len = value.Varint();
-        Cursor list{value.p, value.p + list_len};
+        Cursor list{value.p, value.Sub(list_len)};
         value.p += list_len;
         int count = 0;
         while (list.ok && list.p < list.end && count < width) {
@@ -263,7 +276,7 @@ int ParseExampleInt64(const char* data, size_t size, const char* key,
           if ((ltag >> 3) != 1) { list.Skip(ltag & 7); continue; }
           if ((ltag & 7) == 2) {  // packed
             uint64_t n = list.Varint();
-            const uint8_t* stop_at = list.p + n;
+            const uint8_t* stop_at = list.Sub(n);
             while (list.ok && list.p < stop_at && count < width)
               out[count++] = static_cast<int32_t>(list.Varint());
           } else {  // single varint
@@ -289,14 +302,14 @@ int ParseExampleBytes(const char* data, size_t size, const char* key,
     if (!ex.ok) return -1;
     if ((tag >> 3) != 1 || (tag & 7) != 2) { ex.Skip(tag & 7); continue; }
     uint64_t features_len = ex.Varint();
-    Cursor feats{ex.p, ex.p + features_len};
+    Cursor feats{ex.p, ex.Sub(features_len)};
     ex.p += features_len;
     while (feats.ok && feats.p < feats.end) {
       uint64_t ftag = feats.Varint();
       if (!feats.ok) return -1;
       if ((ftag >> 3) != 1 || (ftag & 7) != 2) { feats.Skip(ftag & 7); continue; }
       uint64_t entry_len = feats.Varint();
-      Cursor entry{feats.p, feats.p + entry_len};
+      Cursor entry{feats.p, feats.Sub(entry_len)};
       feats.p += entry_len;
       bool key_match = false;
       Cursor value{nullptr, nullptr};
@@ -310,7 +323,7 @@ int ParseExampleBytes(const char* data, size_t size, const char* key,
           entry.p += n;
         } else if ((etag >> 3) == 2 && (etag & 7) == 2) {
           uint64_t n = entry.Varint();
-          value = Cursor{entry.p, entry.p + n};
+          value = Cursor{entry.p, entry.Sub(n)};
           entry.p += n;
         } else {
           entry.Skip(etag & 7);
@@ -323,7 +336,7 @@ int ParseExampleBytes(const char* data, size_t size, const char* key,
         if (!value.ok) return -1;
         if ((vtag >> 3) != 1 || (vtag & 7) != 2) { value.Skip(vtag & 7); continue; }
         uint64_t list_len = value.Varint();
-        Cursor list{value.p, value.p + list_len};
+        Cursor list{value.p, value.Sub(list_len)};
         value.p += list_len;
         while (list.ok && list.p < list.end) {
           uint64_t ltag = list.Varint();
@@ -555,6 +568,20 @@ bool DecodeJpegCropped(const char* data, size_t n, uint64_t seed, int tw,
   return true;
 }
 
+// Pop one record out of the queue by MOVE — 1 ok, 0 EOF, -1 error.
+int PopRecord(Reader* r, Record* out) {
+  std::unique_lock<std::mutex> lock(r->mu);
+  r->cv_pop.wait(lock, [r] {
+    return !r->queue.empty() || r->done || r->stop;
+  });
+  if (!r->error.empty()) return -1;
+  if (r->queue.empty()) return 0;  // EOF
+  *out = std::move(r->queue.front());
+  r->queue.pop_front();
+  r->cv_push.notify_one();
+  return 1;
+}
+
 }  // namespace
 
 extern "C" {
@@ -567,19 +594,12 @@ void* rr_open(const char** paths, int n_paths, int prefetch) {
   return r;
 }
 
-// Pops one record; caller owns *buf (free with rr_free).
+// Pops one record; caller owns *buf (free with rr_free). (The malloc+
+// copy is the C-ABI handoff cost; the batch paths below move instead.)
 int rr_next_record(void* h, char** buf, long* len) {
-  auto* r = static_cast<Reader*>(h);
-  std::unique_lock<std::mutex> lock(r->mu);
-  r->cv_pop.wait(lock, [r] {
-    return !r->queue.empty() || r->done || r->stop;
-  });
-  if (!r->error.empty()) return -1;
-  if (r->queue.empty()) return 0;  // EOF
-  Record rec = std::move(r->queue.front());
-  r->queue.pop_front();
-  r->cv_push.notify_one();
-  lock.unlock();
+  Record rec;
+  int rc = PopRecord(static_cast<Reader*>(h), &rec);
+  if (rc <= 0) return rc;
   *len = static_cast<long>(rec.bytes.size());
   *buf = static_cast<char*>(std::malloc(rec.bytes.size()));
   std::memcpy(*buf, rec.bytes.data(), rec.bytes.size());
@@ -593,18 +613,16 @@ void rr_free(char* buf) { std::free(buf); }
 int rr_next_batch_i32(void* h, const char* key, int32_t* out, int batch,
                       int width) {
   auto* r = static_cast<Reader*>(h);
+  Record rec;
   for (int i = 0; i < batch; ++i) {
-    char* buf = nullptr;
-    long len = 0;
-    int rc = rr_next_record(h, &buf, &len);
+    int rc = PopRecord(r, &rec);
     if (rc <= 0) return rc;
-    int got = ParseExampleInt64(buf, len, key, out + i * width, width);
-    std::free(buf);
+    int got = ParseExampleInt64(rec.bytes.data(), rec.bytes.size(), key,
+                                out + i * width, width);
     if (got < 0) return -2;
     if (got < width)  // pad short sequences with zeros
       std::memset(out + i * width + got, 0, sizeof(int32_t) * (width - got));
   }
-  (void)r;
   return 1;
 }
 
@@ -630,14 +648,10 @@ int rr_next_batch_images(void* h, const char* image_key,
   }
   // Records must be pulled serially (queue order = deterministic resume
   // contract); decode is the parallel part.
-  std::vector<std::vector<char>> records(batch);
+  std::vector<Record> records(batch);
   for (int i = 0; i < batch; ++i) {
-    char* buf = nullptr;
-    long len = 0;
-    int rc = rr_next_record(h, &buf, &len);
-    if (rc <= 0) return rc;
-    records[i].assign(buf, buf + len);
-    std::free(buf);
+    int rc = PopRecord(static_cast<Reader*>(h), &records[i]);
+    if (rc <= 0) return rc;  // records pulled by MOVE, no copies
   }
   std::atomic<int> next{0};
   std::atomic<int> failed{-1};
@@ -646,7 +660,7 @@ int rr_next_batch_images(void* h, const char* image_key,
   auto work = [&] {
     std::vector<uint8_t> rgb;
     for (int i = next.fetch_add(1); i < batch; i = next.fetch_add(1)) {
-      const auto& rec = records[i];
+      const auto& rec = records[i].bytes;
       const char* jpg = nullptr;
       uint64_t jpg_len = 0;
       if (ParseExampleBytes(rec.data(), rec.size(), image_key, &jpg,
@@ -672,7 +686,9 @@ int rr_next_batch_images(void* h, const char* image_key,
         ResizeBilinear(rgb.data(), sw, sh, sw, dst, tw, th, mean, inv_std);
       }
       int32_t label = 0;
-      if (ParseExampleInt64(rec.data(), rec.size(), label_key, &label, 1) < 0) {
+      // < 1 covers BOTH malformed (-1) and key-missing (0): a silently
+      // defaulted label would train the model on garbage targets.
+      if (ParseExampleInt64(rec.data(), rec.size(), label_key, &label, 1) < 1) {
         failed = i;
         return;
       }

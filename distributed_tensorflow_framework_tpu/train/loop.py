@@ -69,7 +69,10 @@ class Trainer:
         # any eval-config error (e.g. a native reader with no exact-eval
         # path) fails at startup — not hours in, after training finishes.
         self.eval_step = None
-        if self.config.train.eval_steps > 0 or self.config.train.eval_interval > 0:
+        # eval_steps > 0 is the single eval on-switch (eval_interval alone
+        # does nothing — default_hooks logs that case), so only then pay
+        # the eval pipeline build + compile up front.
+        if self.config.train.eval_steps > 0:
             self._ensure_eval()
         # Checkpoint manager + auto-restore (MonitoredTrainingSession
         # contract: restore latest from checkpoint_dir if present).
@@ -141,36 +144,43 @@ class Trainer:
 
         last_metrics: dict[str, float] = {}
         infeed = prefetch_to_device(
-            self.dataset, self.mesh, size=self.config.data.prefetch
+            self.dataset, self.mesh, size=self.config.data.prefetch,
+            background=self.config.data.async_infeed,
         )
         # Host-side phase timing (core/profiling.py): infeed vs dispatch vs
         # metric-fetch wall time, reported at every log interval — the
         # cheap always-on signal for "is the input pipeline the wall?"
         # (SURVEY.md §7 hard part 1) without capturing a trace.
         timer = profiling.StepTimer()
-        while self.host_step < cfg.total_steps:
-            with timer.phase("infeed"):
-                batch, self.data_ckpt_state = next(infeed)
-            with timer.phase("dispatch"), profiling.annotate("train_step"):
-                self.state, metrics = self.train_step(self.state, batch)
-            self.host_step += 1
-            fetch = (
-                self.host_step % cfg.log_interval == 0
-                or self.host_step >= cfg.total_steps
-            )
-            host_metrics = None
-            if fetch:
-                # Only here does the host sync with the device; off-interval
-                # steps dispatch asynchronously.
-                with timer.phase("metrics_fetch"):
-                    host_metrics = {
-                        k: float(v) for k, v in jax.device_get(metrics).items()
-                    }
-                host_metrics.update(timer.means())
-                timer.reset()
-                last_metrics = host_metrics
-            for h in hooks:
-                h.after_step(self, self.host_step, host_metrics)
+        try:
+            while self.host_step < cfg.total_steps:
+                with timer.phase("infeed"):
+                    batch, self.data_ckpt_state = next(infeed)
+                with timer.phase("dispatch"), profiling.annotate("train_step"):
+                    self.state, metrics = self.train_step(self.state, batch)
+                self.host_step += 1
+                fetch = (
+                    self.host_step % cfg.log_interval == 0
+                    or self.host_step >= cfg.total_steps
+                )
+                host_metrics = None
+                if fetch:
+                    # Only here does the host sync with the device;
+                    # off-interval steps dispatch asynchronously.
+                    with timer.phase("metrics_fetch"):
+                        host_metrics = {
+                            k: float(v)
+                            for k, v in jax.device_get(metrics).items()
+                        }
+                    host_metrics.update(timer.means())
+                    timer.reset()
+                    last_metrics = host_metrics
+                for h in hooks:
+                    h.after_step(self, self.host_step, host_metrics)
+        finally:
+            # Stop the background producer (async_infeed): it must not
+            # keep pulling from the dataset the caller may reuse/restore.
+            infeed.close()
         for h in hooks:
             h.on_end(self)
         return last_metrics
