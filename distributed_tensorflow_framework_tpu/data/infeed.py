@@ -102,6 +102,18 @@ def prefetch_to_device(dataset, mesh: Mesh, *, size: int = 2,
                 except queue_mod.Empty:
                     break
             t.join(timeout=10)
+            if t.is_alive():
+                # Producer stuck inside a blocking dataset pull (e.g. a
+                # stalled filesystem read): it may complete ONE more pull
+                # after we return — restoring/reusing the dataset now
+                # races it. Surface the hazard instead of failing silent.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "infeed producer thread did not stop within 10s — "
+                    "the dataset may see one more pull; avoid reusing it "
+                    "until the process-level pipeline unblocks"
+                )
 
     import collections
 

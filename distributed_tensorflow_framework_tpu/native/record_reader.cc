@@ -249,6 +249,12 @@ int ParseExampleInt64(const char* data, size_t size, const char* key,
         if (!entry.ok) return -1;
         if ((etag >> 3) == 1 && (etag & 7) == 2) {
           uint64_t n = entry.Varint();
+          // Bound BEFORE memcmp: a truncated entry may claim key bytes
+          // that are not there (same malformed-length class Sub guards).
+          if (n > static_cast<uint64_t>(entry.end - entry.p)) {
+            entry.p = entry.end;
+            break;
+          }
           key_match = (n == key_len &&
                        std::memcmp(entry.p, key, key_len) == 0);
           entry.p += n;
@@ -318,6 +324,12 @@ int ParseExampleBytes(const char* data, size_t size, const char* key,
         if (!entry.ok) return -1;
         if ((etag >> 3) == 1 && (etag & 7) == 2) {
           uint64_t n = entry.Varint();
+          // Bound BEFORE memcmp: a truncated entry may claim key bytes
+          // that are not there (same malformed-length class Sub guards).
+          if (n > static_cast<uint64_t>(entry.end - entry.p)) {
+            entry.p = entry.end;
+            break;
+          }
           key_match = (n == key_len &&
                        std::memcmp(entry.p, key, key_len) == 0);
           entry.p += n;
@@ -343,7 +355,9 @@ int ParseExampleBytes(const char* data, size_t size, const char* key,
           if (!list.ok) return -1;
           if ((ltag >> 3) != 1 || (ltag & 7) != 2) { list.Skip(ltag & 7); continue; }
           uint64_t n = list.Varint();
-          if (list.p + n > list.end) return -1;
+          // Subtraction form: `list.p + n` could wrap on a near-2^64
+          // varint and sail past the check.
+          if (n > static_cast<uint64_t>(list.end - list.p)) return -1;
           *out = reinterpret_cast<const char*>(list.p);
           *out_len = n;
           return 1;
