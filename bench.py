@@ -43,7 +43,8 @@ CHIP_PEAKS: dict[str, tuple[float, float]] = {
 }
 
 
-def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3) -> dict:
+def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
+                   model_overrides: dict | None = None) -> dict:
     import jax
     import numpy as np
 
@@ -56,7 +57,16 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3) -> dict:
     cfg = load_config(
         base={
             "name": "bench-resnet50",
-            "model": {"name": "resnet50", "num_classes": 1000, "dtype": "bfloat16"},
+            "model": {"name": "resnet50", "num_classes": 1000,
+                      "dtype": "bfloat16",
+                      # Space-to-depth stem: exact reparametrization of the
+                      # 7×7/s2 conv (tests/test_s2d_stem.py), +8% img/s on
+                      # v5e — the 3-channel full-res conv wastes MXU lanes
+                      # and HBM BW (PERF_NOTES.md). BENCH_NO_S2D=1 reverts.
+                      "space_to_depth_stem":
+                          os.environ.get("BENCH_NO_S2D", "0")
+                          in ("", "0"),
+                      **(model_overrides or {})},
             "data": {
                 "name": "synthetic_images",
                 "global_batch_size": batch_size,

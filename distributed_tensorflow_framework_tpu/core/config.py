@@ -145,6 +145,13 @@ class ModelConfig:
     # part 2).
     bn_cross_replica: bool = True
     dtype: str = "bfloat16"     # compute dtype; params stay float32
+    # ResNet ImageNet-stem only: space-to-depth input transform — replaces
+    # the 7×7/s2 conv with an exactly-equivalent 4×4/s1 conv on a
+    # (H/2,W/2,12) regrouped input. Avoids the MXU-wasting 3-channel conv
+    # and the full-res activation's HBM round-trip (the step is
+    # HBM-BW-bound; see PERF_NOTES.md). Changes stem param shape, so
+    # checkpoints are not interchangeable with the conv7 stem.
+    space_to_depth_stem: bool = False
     # BERT-family knobs.
     vocab_size: int = 30522
     hidden_size: int = 768

@@ -38,16 +38,27 @@ MEAN_RGB = (0.485 * 255, 0.456 * 255, 0.406 * 255)
 STDDEV_RGB = (0.229 * 255, 0.224 * 255, 0.225 * 255)
 
 
-def _file_pattern(config: DataConfig, train: bool) -> str:
+_SIDECAR_EXTS = (".txt", ".json", ".yaml", ".csv")
+
+
+def _record_files(config: DataConfig, train: bool) -> list[str]:
+    # Canonical shard names: <split>-00000-of-00128, but accept any
+    # <split>-* record file; only known sidecar extensions (stray label
+    # maps, metadata json/csv a user drops next to the shards) are
+    # filtered out, so a dataset with non-canonical shard names keeps
+    # working.
+    if not config.data_dir:
+        return []
     sub = "train" if train else "validation"
-    return os.path.join(config.data_dir, f"{sub}-*")
+    files = glob.glob(os.path.join(config.data_dir, f"{sub}-*"))
+    return sorted(f for f in files if not f.lower().endswith(_SIDECAR_EXTS))
 
 
 
 
 def make_imagenet(config: DataConfig, process_index: int, process_count: int,
                   *, train: bool = True) -> HostDataset:
-    files = sorted(glob.glob(_file_pattern(config, train))) if config.data_dir else []
+    files = _record_files(config, train)
     if not files:
         log.warning(
             "ImageNet TFRecords not found under %r — synthetic fallback",

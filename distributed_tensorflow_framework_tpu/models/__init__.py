@@ -44,6 +44,11 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             "model.remat inside the pipelined stack is unsupported — the "
             "GPipe stage body manages its own activation lifetime"
         )
+    if config.space_to_depth_stem and not name.startswith("resnet"):
+        raise ValueError(
+            f"model.space_to_depth_stem is a ResNet ImageNet-stem "
+            f"optimization, not supported for {config.name!r}"
+        )
     if name in ("lenet", "lenet5", "lenet-5"):
         from distributed_tensorflow_framework_tpu.models.lenet import LeNet5
 
@@ -60,6 +65,7 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             dtype=dtype,
             bn_axis_name=bn_axis_name,
             cifar_stem=m.group(2) is not None,
+            space_to_depth_stem=config.space_to_depth_stem,
         )
     if name in ("inception_v3", "inception-v3", "inceptionv3"):
         from distributed_tensorflow_framework_tpu.models.inception import InceptionV3

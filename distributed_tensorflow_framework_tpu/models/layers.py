@@ -62,6 +62,21 @@ class BatchNorm(nn.Module):
         )(x)
 
 
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """NHWC space-to-depth: (N,H,W,C) → (N,H/b,W/b,b²·C).
+
+    Channel order of the output is (di, dj, c) flattened — pixel (2i+di,
+    2j+dj, c) lands at channel (di·b + dj)·C + c. The ResNet s2d stem's
+    kernel mapping (tests/test_s2d_stem.py) depends on this order.
+    """
+    n, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(f"space_to_depth: {h}x{w} not divisible by {block}")
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, block * block * c)
+
+
 class ConvBN(nn.Module):
     """Conv → BN → (optional) ReLU — the reference's fused conv/BN unit.
 

@@ -25,7 +25,11 @@ from typing import Any, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
-from distributed_tensorflow_framework_tpu.models.layers import ConvBN, dense_kernel_init
+from distributed_tensorflow_framework_tpu.models.layers import (
+    ConvBN,
+    dense_kernel_init,
+    space_to_depth,
+)
 
 
 class Bottleneck(nn.Module):
@@ -87,6 +91,17 @@ class ResNet(nn.Module):
     width: int = 64
     cifar_stem: bool = False
     basic_block: bool = False  # True → ResNet-18/34 topology
+    # Space-to-depth stem: reshape (H,W,3) → (H/2,W/2,12) and replace the
+    # 7×7/s2 conv with an equivalent 4×4/s1 conv. The 3-channel 7×7 conv
+    # wastes the MXU (3 input channels padded up to the tile) and streams
+    # the full 224² activation through HBM; s2d quadruples input channels
+    # and quarters the spatial extent at identical math — the classic TPU
+    # ResNet input optimization. The 4×4×12 kernel is an exact superset of
+    # the 7×7×3 kernel (zero-pad to 8×8, regroup; tests/test_s2d_stem.py
+    # proves output equivalence), so the topology, not the function class,
+    # is what changes. Param count differs from torchvision (12288 vs 9408
+    # stem weights) — off by default.
+    space_to_depth_stem: bool = False
     dtype: Any = jnp.bfloat16
     bn_axis_name: Any = None
 
@@ -96,6 +111,14 @@ class ResNet(nn.Module):
         if self.cifar_stem:
             x = ConvBN(self.width, (3, 3), train=train, dtype=self.dtype,
                        bn_axis_name=self.bn_axis_name, name="stem")(x)
+        elif self.space_to_depth_stem:
+            # Padding ((1,2),(1,2)) on the half-res grid reproduces the
+            # 7×7/s2 SAME padding (2 before / 3 after at full res).
+            x = space_to_depth(x, 2)
+            x = ConvBN(self.width, (4, 4), padding=((1, 2), (1, 2)),
+                       train=train, dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name, name="stem_s2d")(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         else:
             x = ConvBN(self.width, (7, 7), strides=(2, 2), train=train,
                        dtype=self.dtype, bn_axis_name=self.bn_axis_name,
@@ -133,14 +156,19 @@ RESNET_DEPTHS: dict[int, tuple[tuple[int, ...], bool]] = {
 
 def make_resnet(depth: int, num_classes: int = 1000,
                 dtype: Any = jnp.bfloat16, bn_axis_name: Any = None,
-                cifar_stem: bool = False) -> ResNet:
+                cifar_stem: bool = False,
+                space_to_depth_stem: bool = False) -> ResNet:
     if depth not in RESNET_DEPTHS:
         raise ValueError(
             f"resnet depth {depth} not in {sorted(RESNET_DEPTHS)}"
         )
+    if cifar_stem and space_to_depth_stem:
+        raise ValueError("space_to_depth_stem only applies to the ImageNet "
+                         "stem (cifar_stem=False)")
     stages, basic = RESNET_DEPTHS[depth]
     return ResNet(stage_sizes=stages, num_classes=num_classes,
-                  basic_block=basic, cifar_stem=cifar_stem, dtype=dtype,
+                  basic_block=basic, cifar_stem=cifar_stem,
+                  space_to_depth_stem=space_to_depth_stem, dtype=dtype,
                   bn_axis_name=bn_axis_name)
 
 

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Launch an N-process fake cluster on localhost — the reference's
+"multiple ports on one machine" development trick (SURVEY.md §4), rebuilt
+for the SPMD runtime.
+
+Where the reference had the user hand-write ``--ps_hosts/--worker_hosts``
+host maps and start each role by hand, this spawns N identical worker
+processes wired together through ``jax.distributed`` env vars
+(JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID — the same
+discovery path a real multi-host slice uses), each with
+``--devices-per-proc`` virtual CPU devices. Exercises the full DCN-path
+code (per-host data sharding, global-array assembly, cross-process
+collectives, chief-only checkpointing) with zero hardware.
+
+Usage:
+    python scripts/launch_local_cluster.py --procs 2 -- \
+        --config configs/lenet_mnist.yaml --set train.total_steps=20
+
+Everything after ``--`` is passed to train.py verbatim. Exit status is
+non-zero if any worker fails; worker logs stream to
+``<workdir>/worker-<i>.log`` (default /tmp/dtf-local-cluster).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--procs", type=int, default=2)
+    p.add_argument("--devices-per-proc", type=int, default=2)
+    p.add_argument("--workdir", default="/tmp/dtf-local-cluster")
+    p.add_argument("train_args", nargs=argparse.REMAINDER,
+                   help="arguments for train.py (prefix with --)")
+    args = p.parse_args(argv)
+    train_args = args.train_args
+    if train_args and train_args[0] == "--":
+        train_args = train_args[1:]
+    if not train_args:
+        p.error("pass train.py arguments after --")
+
+    os.makedirs(args.workdir, exist_ok=True)
+    port = free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs, logs = [], []
+    for i in range(args.procs):
+        env = dict(os.environ)
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["JAX_NUM_PROCESSES"] = str(args.procs)
+        env["JAX_PROCESS_ID"] = str(i)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices_per_proc}"
+        ).strip()
+        log = open(os.path.join(args.workdir, f"worker-{i}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(repo, "train.py"), *train_args],
+            env=env, cwd=repo, stdout=log, stderr=subprocess.STDOUT))
+    print(f"launched {args.procs} workers (coordinator 127.0.0.1:{port}); "
+          f"logs in {args.workdir}/worker-*.log", file=sys.stderr)
+
+    # Poll ALL workers: a crashed peer leaves the others blocked in a
+    # collective forever, so on the first nonzero exit the rest are
+    # terminated — the launcher must surface the failure, not hang on
+    # procs[0].wait().
+    rc = 0
+    try:
+        import time
+
+        live = dict(enumerate(procs))
+        killed: set[int] = set()
+        while live:
+            for i, proc in list(live.items()):
+                r = proc.poll()
+                if r is None:
+                    continue
+                del live[i]
+                if r != 0 and i not in killed:
+                    # Peers terminated below exit nonzero too — only the
+                    # first real failure is the root cause worth naming.
+                    print(f"worker {i} exited {r} — see "
+                          f"{args.workdir}/worker-{i}.log", file=sys.stderr)
+                    rc = rc or r
+                    for j, p in live.items():
+                        killed.add(j)
+                        p.terminate()
+            if live:
+                time.sleep(0.2)
+    except KeyboardInterrupt:
+        for proc in procs:
+            proc.terminate()
+        rc = 130
+    finally:
+        for log in logs:
+            log.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

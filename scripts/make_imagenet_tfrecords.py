@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Author ImageNet-style TFRecord shards from a directory tree of JPEGs.
+
+The reference framework consumes the canonical ImageNet TFRecord layout
+(SURVEY.md §2 row 5: ``image/encoded`` JPEG bytes + ``image/class/label``
+in [1, 1000]); this is the companion authoring tool so a user switching
+from the reference can produce that layout from raw images without the
+legacy TF build scripts.
+
+Input layout (torchvision/Keras convention):
+
+    <src>/<split>/<class_name>/<anything>.{jpg,jpeg,JPEG,png}
+
+Class names are sorted lexicographically and assigned labels 1..N (the
+1-based convention the pipeline's ``label - 1`` shift expects —
+data/imagenet.py). PNG inputs are transcoded to JPEG so the reader's
+decode path stays uniform.
+
+Usage:
+    python scripts/make_imagenet_tfrecords.py <src> <out> \
+        --split train --shards 128 [--quality 90] [--seed 0]
+
+Outputs ``<out>/<split>-XXXXX-of-NNNNN`` shards plus one ``labels.txt``
+mapping file (split-independent — classes are the union across splits),
+shuffling examples across shards with a seeded RNG so each shard is
+class-mixed (required for good shuffle behavior with small per-host
+shuffle buffers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def class_list(src: str) -> list[str]:
+    """Sorted union of class directories across ALL splits under src.
+
+    Labels must be consistent across splits — deriving them per split
+    would shift every id after a class that is missing from one split
+    (silently mislabeling eval). The union keeps train/validation/test
+    invocations agreeing on the same map.
+    """
+    classes: set[str] = set()
+    for split in os.listdir(src):
+        sdir = os.path.join(src, split)
+        if os.path.isdir(sdir):
+            classes.update(
+                d for d in os.listdir(sdir)
+                if os.path.isdir(os.path.join(sdir, d))
+            )
+    if not classes:
+        raise SystemExit(f"no <split>/<class> directories under {src}")
+    return sorted(classes)
+
+
+def find_examples(src: str, split: str,
+                  classes: list[str]) -> list[tuple[str, int]]:
+    split_dir = os.path.join(src, split)
+    if not os.path.isdir(split_dir):
+        raise SystemExit(f"no such split directory: {split_dir}")
+    exts = (".jpg", ".jpeg", ".png")
+    examples: list[tuple[str, int]] = []
+    for label0, cls in enumerate(classes):
+        cdir = os.path.join(split_dir, cls)
+        if not os.path.isdir(cdir):
+            continue
+        for fn in sorted(os.listdir(cdir)):
+            if fn.lower().endswith(exts):
+                # 1-based labels: the canonical ImageNet TFRecord convention.
+                examples.append((os.path.join(cdir, fn), label0 + 1))
+    if not examples:
+        raise SystemExit(f"no images found under {split_dir}")
+    return examples
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("src", help="root containing <split>/<class>/*.jpg")
+    p.add_argument("out", help="output directory for TFRecord shards")
+    p.add_argument("--split", default="train",
+                   help="split name (subdir of src and shard prefix)")
+    p.add_argument("--shards", type=int, default=128)
+    p.add_argument("--quality", type=int, default=90,
+                   help="JPEG quality when transcoding PNG inputs")
+    p.add_argument("--seed", type=int, default=0,
+                   help="shuffle seed for class-mixing across shards")
+    args = p.parse_args(argv)
+
+    import numpy as np
+    import tensorflow as tf
+
+    classes = class_list(args.src)
+    examples = find_examples(args.src, args.split, classes)
+    rng = np.random.default_rng(args.seed)
+    order = rng.permutation(len(examples))
+    os.makedirs(args.out, exist_ok=True)
+
+    # One split-independent map (classes are the union across splits).
+    with open(os.path.join(args.out, "labels.txt"), "w") as fh:
+        for i, cls in enumerate(classes):
+            fh.write(f"{i + 1} {cls}\n")
+
+    n_shards = max(1, min(args.shards, len(examples)))
+    writers = [
+        tf.io.TFRecordWriter(os.path.join(
+            args.out, f"{args.split}-{s:05d}-of-{n_shards:05d}"))
+        for s in range(n_shards)
+    ]
+    try:
+        for rank, idx in enumerate(order):
+            path, label = examples[idx]
+            with open(path, "rb") as fh:
+                data = fh.read()
+            if path.lower().endswith(".png"):
+                img = tf.io.decode_png(data, channels=3)
+                data = tf.io.encode_jpeg(img, quality=args.quality).numpy()
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "image/encoded": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[data])),
+                "image/class/label": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[label])),
+            }))
+            writers[rank % n_shards].write(ex.SerializeToString())
+    finally:
+        for w in writers:
+            w.close()
+    print(f"wrote {len(examples)} examples / {len(classes)} classes "
+          f"into {n_shards} shards under {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""Space-to-depth ResNet stem (models/resnet.py space_to_depth_stem).
+
+Proves the s2d stem is an exact reparametrization of the standard
+7×7/s2 SAME conv, not an approximation: zero-pad the 7×7×3 kernel to
+8×8×3 (bottom/right), regroup into 4×4×12, and the 4×4/s1 conv with
+padding ((1,2),(1,2)) on the space-to-depth input reproduces the
+original output bit-for-bit in f32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_framework_tpu.models.layers import space_to_depth
+
+
+def _s2d_kernel(w7: np.ndarray) -> np.ndarray:
+    """Map a (7,7,C,F) HWIO kernel to the (4,4,4C,F) s2d-equivalent."""
+    k, _, c, f = w7.shape
+    assert k == 7
+    w8 = np.zeros((8, 8, c, f), w7.dtype)
+    w8[:7, :7] = w7
+    # Output channel order of space_to_depth is (di, dj, c) flattened.
+    ws2d = np.zeros((4, 4, 4 * c, f), w7.dtype)
+    for a in range(4):
+        for e in range(4):
+            for bi in range(2):
+                for bj in range(2):
+                    ws2d[a, e, (bi * 2 + bj) * c:(bi * 2 + bj) * c + c] = (
+                        w8[2 * a + bi, 2 * e + bj]
+                    )
+    return ws2d
+
+
+def test_s2d_conv_exactly_reproduces_conv7x7_s2():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 32, 32, 3)).astype(np.float32)
+    w7 = rng.standard_normal((7, 7, 3, 16)).astype(np.float32)
+
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w7), window_strides=(2, 2),
+        padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    got = jax.lax.conv_general_dilated(
+        space_to_depth(jnp.asarray(x), 2), jnp.asarray(_s2d_kernel(w7)),
+        window_strides=(1, 1), padding=((1, 2), (1, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    assert ref.shape == got.shape == (2, 16, 16, 16)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-6, atol=1e-5)
+
+
+def test_space_to_depth_layout():
+    x = np.arange(2 * 4 * 4 * 3, dtype=np.float32).reshape(2, 4, 4, 3)
+    y = np.asarray(space_to_depth(jnp.asarray(x), 2))
+    assert y.shape == (2, 2, 2, 12)
+    # channel (di*2+dj)*3 + c holds pixel (2i+di, 2j+dj, c)
+    for di in range(2):
+        for dj in range(2):
+            for c in range(3):
+                np.testing.assert_array_equal(
+                    y[:, :, :, (di * 2 + dj) * 3 + c],
+                    x[:, di::2, dj::2, c])
+    with pytest.raises(ValueError):
+        space_to_depth(jnp.zeros((1, 5, 4, 3)), 2)
+
+
+def test_s2d_resnet_forward_and_step():
+    from distributed_tensorflow_framework_tpu.models.resnet import make_resnet
+
+    model = make_resnet(18, num_classes=10, dtype=jnp.float32,
+                        space_to_depth_stem=True)
+    x = jnp.ones((2, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    # Stem kernel is the regrouped 4×4×12 shape.
+    assert variables["params"]["stem_s2d"]["conv"]["kernel"].shape == (
+        4, 4, 12, 64)
+    # Same spatial pyramid as the conv7 stem on the same input.
+    ref = make_resnet(18, num_classes=10, dtype=jnp.float32)
+    ref_vars = ref.init(jax.random.key(0), x, train=False)
+    assert ref.apply(ref_vars, x, train=False).shape == logits.shape
+
+
+def test_s2d_rejected_for_non_resnet_and_cifar_stem():
+    from distributed_tensorflow_framework_tpu.core.config import ModelConfig
+    from distributed_tensorflow_framework_tpu.models import get_model
+    from distributed_tensorflow_framework_tpu.models.resnet import make_resnet
+
+    with pytest.raises(ValueError):
+        get_model(ModelConfig(name="lenet5", space_to_depth_stem=True))
+    with pytest.raises(ValueError):
+        make_resnet(50, cifar_stem=True, space_to_depth_stem=True)
