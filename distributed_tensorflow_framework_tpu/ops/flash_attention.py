@@ -158,6 +158,43 @@ def _fused_bwd(res, g):
 _fused.defvjp(_fused_fwd, _fused_bwd)
 
 
+@jax.custom_vjp
+def _fused_lse(q, k, v, bias):
+    """Like ``_fused`` but also returns the per-row logsumexp — the chunk
+    primitive for ring attention, whose online merge needs lse and
+    therefore flows a cotangent into it."""
+    return _flash_fwd(q, k, v, bias, interpret=_interpret())
+
+
+def _fused_lse_fwd(q, k, v, bias):
+    o, lse = _flash_fwd(q, k, v, bias, interpret=_interpret())
+    return (o, lse), (q, k, v, bias, o, lse)
+
+
+def _fused_lse_bwd(res, g):
+    do, dlse = g
+    q, k, v, bias, o, lse = res
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, o, lse, do, dlse=dlse,
+                                   interpret=_interpret())
+    return dq, dk, dv, dbias
+
+
+_fused_lse.defvjp(_fused_lse_fwd, _fused_lse_bwd)
+
+
+def flash_attention_chunk(q, k, v, bias):
+    """Per-chunk fused attention for the ring: (B,S,H,D) q/k/v (equal-length
+    shards) + additive key bias (B, Sk) → (o (B,S,H,D), lse (B,S,H,1)).
+
+    ``o`` is normalized *within the chunk*; the caller merges chunks with
+    the standard logsumexp reweighting. Differentiable in all inputs
+    including through ``lse``.
+    """
+    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    o, lse = _fused_lse(qt, kt, vt, bias[:, None, :].astype(jnp.float32))
+    return o.transpose(0, 2, 1, 3), lse.transpose(0, 2, 1, 3)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _flash_fwd(q, k, v, bias, *, interpret: bool):
     b, h, s, d = q.shape
@@ -186,13 +223,18 @@ def _flash_fwd(q, k, v, bias, *, interpret: bool):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _flash_bwd(q, k, v, bias, o, lse, do, *, interpret: bool):
+def _flash_bwd(q, k, v, bias, o, lse, do, dlse=None, *, interpret: bool):
     b, h, s, d = q.shape
     scale = 1.0 / (d ** 0.5)
     # delta_i = Σ_d dO_i·O_i — the softmax-jacobian row correction; an
     # O(S·D) elementwise+reduce, cheap in plain XLA.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)        # (B,H,S,1)
+    if dlse is not None:
+        # lse cotangent (ring-merge path): ∂lse_i/∂s_ij = p_ij, so the
+        # contribution folds into ds = p·(dp − delta + dlse) — i.e. the
+        # kernels run unchanged with delta := delta − dlse.
+        delta = delta - dlse.astype(jnp.float32)
 
     block_q = min(BLOCK_Q, s)
     dq = pl.pallas_call(
