@@ -241,9 +241,15 @@ class TrainConfig:
     # the reference's SyncReplicasOptimizer + NCCL pipeline).
     spmd_mode: str = "jit"
     # Wire dtype for the explicit gradient all-reduce (shard_map mode only):
-    # "" keeps the gradient dtype; "bfloat16" halves collective bytes
+    # "" keeps the gradient dtype; "bfloat16" narrows collective bytes
     # (EQuARX-style compression — most useful over DCN on multislice).
     grad_allreduce_dtype: str = ""
+    # Accumulation for the compressed all-reduce: "float32" (default)
+    # reduce-scatters in f32 (exact adds, 6/8 of f32 bytes, one
+    # n-independent rounding — the accuracy-safe choice for n≫8 DCN);
+    # "wire" reduces in the wire dtype itself (4/8 of f32 bytes, log2(n)
+    # narrow adds). See parallel/collectives.allreduce_gradients.
+    grad_allreduce_accum: str = "float32"
     nan_guard: bool = True
     label_smoothing: float = 0.0
     eval_use_ema: bool = True  # only meaningful with optimizer.ema_decay>0

@@ -96,11 +96,12 @@ class ResNet(nn.Module):
     # wastes the MXU (3 input channels padded up to the tile) and streams
     # the full 224² activation through HBM; s2d quadruples input channels
     # and quarters the spatial extent at identical math — the classic TPU
-    # ResNet input optimization. The 4×4×12 kernel is an exact superset of
-    # the 7×7×3 kernel (zero-pad to 8×8, regroup; tests/test_s2d_stem.py
-    # proves output equivalence), so the topology, not the function class,
-    # is what changes. Param count differs from torchvision (12288 vs 9408
-    # stem weights) — off by default.
+    # ResNet input optimization. The 4×4×12 kernel is a superset of the
+    # 7×7×3 kernel (zero-pad to 8×8, regroup; tests/test_s2d_stem.py
+    # verifies output equivalence to 1e-5). The 45 zero-padded kernel
+    # positions are trainable, so the trained function class is a strict
+    # superset of the 7×7 stem's. Param count differs from torchvision
+    # (12288 vs 9408 stem weights).
     space_to_depth_stem: bool = False
     dtype: Any = jnp.bfloat16
     bn_axis_name: Any = None

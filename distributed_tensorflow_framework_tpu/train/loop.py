@@ -229,6 +229,21 @@ class Trainer:
         ds.restore(self._eval_start)  # fresh pass every call
         if num_batches is not None:
             n = num_batches
+            if ds.cardinality is not None:
+                # Exact-by-construction: never trust config arithmetic to
+                # reproduce the set size. num_batches >= cardinality means
+                # "the full set" (clamped); below it is an explicit
+                # truncation, surfaced loudly because a silently dropped
+                # tail (e.g. eval_steps=12 vs 50k/4096=12.2) biases every
+                # mid-training accuracy ever logged.
+                if n >= ds.cardinality:
+                    n = ds.cardinality
+                else:
+                    log.warning(
+                        "eval truncated: %d of %d batches (set "
+                        "train.eval_steps >= %d for full coverage)",
+                        n, ds.cardinality, ds.cardinality,
+                    )
         elif ds.cardinality is not None:
             n = ds.cardinality  # exact: the full validation set
         else:

@@ -66,6 +66,11 @@ class StepBuilder:
                 "collective path — set train.spmd_mode='shard_map' (under "
                 "'jit' XLA owns the gradient reduction wire format)"
             )
+        if config.train.grad_allreduce_accum not in ("float32", "wire"):
+            raise ValueError(
+                "train.grad_allreduce_accum must be 'float32' or 'wire', "
+                f"got {config.train.grad_allreduce_accum!r}"
+            )
         if self.shard_map_mode and mesh.shape.get("expert", 1) > 1:
             raise ValueError(
                 "spmd_mode='shard_map' is the pure-DP reference-parity path; "
@@ -350,6 +355,7 @@ class StepBuilder:
         grads = coll.allreduce_gradients(
             grads, DATA_AXES,
             compute_dtype=jnp.dtype(wire) if wire else None,
+            accumulate_f32=self.config.train.grad_allreduce_accum == "float32",
         )
         metrics = coll.pmean(metrics, DATA_AXES)
         if self._has_bn(state):

@@ -28,6 +28,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 
 def free_port() -> int:
@@ -77,15 +78,21 @@ def main(argv: list[str] | None = None) -> int:
     # terminated — the launcher must surface the failure, not hang on
     # procs[0].wait().
     rc = 0
+    grace = 10.0  # seconds between SIGTERM and SIGKILL escalation
     try:
-        import time
-
         live = dict(enumerate(procs))
-        killed: set[int] = set()
+        killed: dict[int, float] = {}  # worker → time SIGTERM was sent
         while live:
+            now = time.monotonic()
             for i, proc in list(live.items()):
                 r = proc.poll()
                 if r is None:
+                    # A worker blocked inside a native collective can
+                    # ignore SIGTERM indefinitely — escalate to SIGKILL
+                    # after the grace period so the launcher never hangs.
+                    if i in killed and now - killed[i] > grace:
+                        proc.kill()
+                        killed[i] = float("inf")  # kill once
                     continue
                 del live[i]
                 if r != 0 and i not in killed:
@@ -95,15 +102,36 @@ def main(argv: list[str] | None = None) -> int:
                           f"{args.workdir}/worker-{i}.log", file=sys.stderr)
                     rc = rc or r
                     for j, p in live.items():
-                        killed.add(j)
+                        killed[j] = now
                         p.terminate()
             if live:
                 time.sleep(0.2)
     except KeyboardInterrupt:
+        rc = 130
         for proc in procs:
             proc.terminate()
-        rc = 130
+        try:
+            deadline = time.monotonic() + grace
+            for proc in procs:
+                try:
+                    proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        except KeyboardInterrupt:
+            # Second Ctrl-C: stop waiting politely, SIGKILL everything;
+            # the finally block reaps.
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
     finally:
+        # Reap everything — no orphaned children past this point.
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    proc.wait(timeout=grace)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            proc.wait()
         for log in logs:
             log.close()
     return rc

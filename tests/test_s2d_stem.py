@@ -1,10 +1,13 @@
 """Space-to-depth ResNet stem (models/resnet.py space_to_depth_stem).
 
-Proves the s2d stem is an exact reparametrization of the standard
-7×7/s2 SAME conv, not an approximation: zero-pad the 7×7×3 kernel to
-8×8×3 (bottom/right), regroup into 4×4×12, and the 4×4/s1 conv with
-padding ((1,2),(1,2)) on the space-to-depth input reproduces the
-original output bit-for-bit in f32.
+Proves the s2d stem is a reparametrization of the standard 7×7/s2 SAME
+conv, not an approximation: zero-pad the 7×7×3 kernel to 8×8×3
+(bottom/right), regroup into 4×4×12, and the 4×4/s1 conv with padding
+((1,2),(1,2)) on the space-to-depth input reproduces the original
+output numerically (tested to rtol 1e-6 / atol 1e-5 — reassociated
+matmul accumulation means the TPU results are not literally
+bit-identical). Note the 45 zero-padded kernel positions are trainable,
+so the trained function class is a strict superset of the 7×7 stem's.
 """
 
 from __future__ import annotations
