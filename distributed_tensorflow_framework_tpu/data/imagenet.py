@@ -77,13 +77,11 @@ def make_imagenet(config: DataConfig, process_index: int, process_count: int,
         )
 
     if config.use_native_reader:
-        if not train:
-            raise ValueError(
-                "use_native_reader has no exact-eval path — use the "
-                "tf.data reader (use_native_reader=false) for evaluation"
-            )
-        return _make_imagenet_native(config, files, process_index,
-                                     process_count)
+        if train:
+            return _make_imagenet_native(config, files, process_index,
+                                         process_count)
+        return _make_imagenet_native_eval(config, files, process_index,
+                                          process_count)
 
     import tensorflow as tf
 
@@ -222,13 +220,14 @@ def _make_imagenet_native(config: DataConfig, files: list[str],
     standardizes. Crop/flip randomness is seeded per (epoch, batch,
     process) through core/prng.py and sampled by a fixed C++ splitmix64,
     so record order AND augmentation replay deterministically; resume
-    fast-skips the consumed records through the raw framing cursor (no
-    JPEG decode of skipped batches). Shuffling is per-epoch FILE-order
-    (seeded, host-local) — there is no record-level shuffle buffer, so
-    within-file record order repeats across epochs (and which tail
-    records fall off the final partial batch varies by epoch with the
-    file order). Remaining delta vs the tf.data path: same crop family
-    (area 8-100%, aspect 3/4-4/3), bilinear rather than bicubic resize.
+    fast-skips the consumed records natively (no JPEG decode or C-ABI
+    copy of skipped batches). Shuffling matches the tf.data twin: a
+    per-epoch FILE-order permutation PLUS a windowed RECORD-level shuffle
+    (``config.shuffle_buffer``, C++-side, seeded per epoch) — so
+    within-file record order reshuffles every epoch and which records
+    fall off the final partial batch varies per epoch. Remaining delta vs
+    the tf.data path: same crop family (area 8-100%, aspect 3/4-4/3),
+    bilinear rather than bicubic resize.
     """
     from distributed_tensorflow_framework_tpu.core import prng
     from distributed_tensorflow_framework_tpu.data.native_reader import (
@@ -249,10 +248,14 @@ def _make_imagenet_native(config: DataConfig, files: list[str],
             epoch = state["epoch"]
             skip = state["batch_in_epoch"]
             # Per-epoch file-order shuffle (host-local stream → process
-            # index in the derivation; see core/prng.py rules).
-            order = prng.host_rng(config.seed, prng.ROLE_DATA,
-                                  epoch, process_index).permutation(len(shard))
+            # index in the derivation; see core/prng.py rules), plus a
+            # record-shuffle seed drawn from the SAME per-epoch stream so
+            # both reshuffle together and replay deterministically.
+            epoch_rng = prng.host_rng(config.seed, prng.ROLE_DATA,
+                                      epoch, process_index)
+            order = epoch_rng.permutation(len(shard))
             epoch_files = [shard[j] for j in order]
+            shuffle_seed = int(epoch_rng.integers(0, 2**63, dtype=np.uint64))
 
             def seed_stream(epoch=epoch, start=skip):
                 i = start
@@ -262,22 +265,25 @@ def _make_imagenet_native(config: DataConfig, files: list[str],
                     yield rng.integers(0, 2**63, size=b, dtype=np.uint64)
                     i += 1
 
-            reader = NativeRecordReader(epoch_files)
+            reader = NativeRecordReader(
+                epoch_files,
+                shuffle_window=config.shuffle_buffer,
+                shuffle_seed=shuffle_seed,
+            )
             if skip:
-                # Fast-skip: advance the raw framing cursor past the
-                # already-consumed records WITHOUT JPEG-decoding them —
-                # resume cost is IO-bound, not decode-bound.
-                raw = reader.records()
-                for n in range(skip * b):
-                    try:
-                        next(raw)
-                    except StopIteration:
-                        raise RuntimeError(
-                            f"resume snapshot skips {skip * b} records but "
-                            f"this host's shard holds only {n} — the shard "
-                            f"set, process_count or batch size changed "
-                            f"since the checkpoint was taken"
-                        ) from None
+                # Fast-skip: advance the shuffled record stream past the
+                # already-consumed records natively, WITHOUT JPEG-decoding
+                # them — resume cost is IO-bound, not decode-bound. Goes
+                # through the same shuffle window, so the stream resumes
+                # exactly where the checkpoint left it.
+                got = reader.skip_records(skip * b)
+                if got < skip * b:
+                    raise RuntimeError(
+                        f"resume snapshot skips {skip * b} records but "
+                        f"this host's shard holds only {got} — the shard "
+                        f"set, process_count or batch size changed "
+                        f"since the checkpoint was taken"
+                    )
             it = reader.batches_images(b, size, size,
                                        crop_seeds=seed_stream(),
                                        mean=mean, std=std)
@@ -303,4 +309,77 @@ def _make_imagenet_native(config: DataConfig, files: list[str],
             "label": ((b,), np.int32),
         },
         initial_state={"epoch": 0, "batch_in_epoch": 0},
+    )
+
+
+def _make_imagenet_native_eval(config: DataConfig, files: list[str],
+                               process_index: int, process_count: int
+                               ) -> HostDataset:
+    """Exact single-pass eval on the C++ reader (SURVEY.md §3.4 / §2 row 5).
+
+    Same contract as the tf.data eval twin: every record of this host's
+    file shard exactly once, in file order (no shuffle), deterministic
+    central crop (87.5%, tf.image.central_crop arithmetic in C++) +
+    resize + standardize; the final partial batch is zero-padded with
+    per-example weights, and hosts that exhaust early pad with zero-weight
+    batches up to the equalized batch count so multi-host collectives
+    never diverge. Pixel-level delta vs tf.data: bilinear vs bicubic
+    resize (the same documented delta as the train path).
+    """
+    from distributed_tensorflow_framework_tpu.data.native_reader import (
+        NativeRecordReader,
+        count_records_native,
+    )
+
+    b = host_batch_size(config.global_batch_size, process_count)
+    size = config.image_size
+    host_files = files[process_index::process_count]
+    out_dtype = image_np_dtype(config.image_dtype)
+    mean = np.asarray(MEAN_RGB, np.float32)
+    std = np.asarray(STDDEV_RGB, np.float32)
+    # Count through the C++ framing cursor (no TF dependency, no decode)
+    # so the native path stays native end to end.
+    num_batches = eval_batches_all_hosts(count_records_native(host_files), b)
+
+    def zero_batch():
+        return {
+            "image": np.zeros((b, size, size, 3), out_dtype),
+            "label": np.zeros((b,), np.int32),
+            "weight": np.zeros((b,), np.float32),
+        }
+
+    def make_iter(state):
+        state.setdefault("batches", 0)
+        skip = state["batches"]
+        reader = NativeRecordReader(host_files)
+        # Mid-pass resume: re-skip the consumed records (short skip just
+        # means the restore point was already inside the padded tail).
+        if skip:
+            reader.skip_records(skip * b)
+        it = reader.batches_images_eval(b, size, size, mean=mean, std=std)
+        for images, labels, k in it:
+            weight = np.zeros((b,), np.float32)
+            weight[:k] = 1.0
+            labels = labels - 1  # [1,1000] → [0,999]
+            labels[k:] = 0  # padding: valid class id, weighted out
+            state["batches"] += 1
+            yield {
+                "image": images.astype(out_dtype, copy=False),
+                "label": labels,
+                "weight": weight,
+            }
+        reader.close()
+        while state["batches"] < num_batches:
+            state["batches"] += 1
+            yield zero_batch()
+
+    return HostDataset(
+        make_iter,
+        element_spec={
+            "image": ((b, size, size, 3), out_dtype),
+            "label": ((b,), np.int32),
+            "weight": ((b,), np.float32),
+        },
+        initial_state={"batches": 0},
+        cardinality=num_batches,
     )

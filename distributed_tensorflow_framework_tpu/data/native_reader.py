@@ -51,7 +51,10 @@ def load_library():
             lib = ctypes.CDLL(_build())
             lib.rr_open.restype = ctypes.c_void_p
             lib.rr_open.argtypes = [ctypes.POINTER(ctypes.c_char_p),
-                                    ctypes.c_int, ctypes.c_int]
+                                    ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_long, ctypes.c_uint64]
+            lib.rr_skip.restype = ctypes.c_long
+            lib.rr_skip.argtypes = [ctypes.c_void_p, ctypes.c_long]
             lib.rr_next_record.restype = ctypes.c_int
             lib.rr_next_record.argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
@@ -70,6 +73,15 @@ def load_library():
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_float),
                 ctypes.POINTER(ctypes.c_float)]
+            lib.rr_next_batch_images_eval.restype = ctypes.c_int
+            lib.rr_next_batch_images_eval.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_float,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float)]
             lib.rr_error.restype = ctypes.c_char_p
             lib.rr_error.argtypes = [ctypes.c_void_p]
             lib.rr_close.argtypes = [ctypes.c_void_p]
@@ -77,13 +89,38 @@ def load_library():
     return _lib
 
 
+# files-tuple → record count (same one-shot cache contract as
+# tfdata.count_records, but through the C++ framing cursor — no TF
+# dependency and no decode; restores rebuild pipelines so the count per
+# shard set must not be repeated).
+_COUNT_CACHE: dict[tuple[str, ...], int] = {}
+
+
+def count_records_native(paths: Sequence[str]) -> int:
+    key = tuple(paths)
+    if key not in _COUNT_CACHE:
+        reader = NativeRecordReader(key)
+        try:
+            _COUNT_CACHE[key] = reader.skip_records(2**62)
+        finally:
+            reader.close()
+    return _COUNT_CACHE[key]
+
+
 class NativeRecordReader:
-    def __init__(self, paths: Sequence[str], prefetch: int = 256):
+    def __init__(self, paths: Sequence[str], prefetch: int = 256,
+                 *, shuffle_window: int = 0, shuffle_seed: int = 0):
+        """``shuffle_window > 1`` enables a windowed record-level shuffle
+        (tf.data shuffle-buffer semantics) applied to every iterator of
+        this handle, deterministic given ``shuffle_seed``. Memory cost is
+        ``window`` raw records held in C++ (same class as tf.data's
+        pre-decode shuffle buffer)."""
         self._lib = load_library()
         arr = (ctypes.c_char_p * len(paths))(
             *[p.encode() for p in paths]
         )
-        self._h = self._lib.rr_open(arr, len(paths), prefetch)
+        self._h = self._lib.rr_open(arr, len(paths), prefetch,
+                                    shuffle_window, shuffle_seed)
         if not self._h:
             raise RuntimeError("rr_open failed")
 
@@ -91,6 +128,16 @@ class NativeRecordReader:
         err = self._lib.rr_error(self._h)
         if err:
             raise RuntimeError(f"native reader: {err.decode()}")
+
+    def skip_records(self, n: int) -> int:
+        """Advance the (possibly shuffled) stream ``n`` records without
+        decode or C-ABI copies — the resume fast-skip. Returns how many
+        were actually skipped (short on EOF)."""
+        got = self._lib.rr_skip(self._h, n)
+        if got < 0:
+            self._check_error()
+            raise RuntimeError("native reader skip failed")
+        return int(got)
 
     def records(self) -> Iterator[bytes]:
         buf = ctypes.POINTER(ctypes.c_char)()
@@ -172,6 +219,51 @@ class NativeRecordReader:
             if rc == 0:
                 return
             yield images.copy(), labels.copy()
+
+    def batches_images_eval(self, batch: int, height: int, width: int,
+                            *, image_key: str = "image/encoded",
+                            label_key: str = "image/class/label",
+                            threads: int = 0,
+                            central_frac: float = 0.875,
+                            mean: np.ndarray | None = None,
+                            std: np.ndarray | None = None,
+                            ) -> Iterator[tuple[np.ndarray, np.ndarray, int]]:
+        """(images, labels, k) per batch for a SINGLE eval pass.
+
+        Deterministic central-crop (``central_frac``, tf.image.central_crop
+        arithmetic) + bilinear resize in C++ — the eval twin of
+        ``batches_images``. ``k <= batch`` is the number of real records in
+        the batch; the final batch is zero-padded past ``k`` (labels 0) so
+        callers can weight the padding out (exact-eval contract)."""
+        images = np.empty((batch, height, width, 3), np.float32)
+        labels = np.empty((batch,), np.int32)
+        iptr = images.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        lptr = labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        null_f = ctypes.POINTER(ctypes.c_float)()
+        if mean is not None and std is not None:
+            mean_arr = np.ascontiguousarray(mean, np.float32)
+            std_arr = np.ascontiguousarray(std, np.float32)
+            assert mean_arr.shape == (3,) and std_arr.shape == (3,)
+            mptr = mean_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            sptr_std = std_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        else:
+            mptr = sptr_std = null_f
+        while True:
+            rc = self._lib.rr_next_batch_images_eval(
+                self._h, image_key.encode(), label_key.encode(),
+                iptr, lptr, batch, height, width, threads,
+                central_frac, mptr, sptr_std)
+            if rc < 0:
+                self._check_error()
+                raise RuntimeError(f"native eval decode error (rc={rc})")
+            if rc == 0:
+                return
+            img = images.copy()
+            lab = labels.copy()
+            if rc < batch:  # zero the padded tail (weighted out by caller)
+                img[rc:] = 0.0
+                lab[rc:] = 0
+            yield img, lab, rc
 
     def close(self):
         if self._h:

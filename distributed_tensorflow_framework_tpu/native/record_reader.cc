@@ -11,13 +11,21 @@
 // GIL.
 //
 // Exposed C ABI (consumed by ctypes in data/native_reader.py):
-//   rr_open(paths, n_paths, prefetch)            -> handle
+//   rr_open(paths, n_paths, prefetch, shuffle_window, shuffle_seed)
+//                                                -> handle
+//     shuffle_window > 1 turns on a windowed record-level shuffle
+//     (tf.data shuffle-buffer semantics) applied to EVERY consumer of
+//     the handle, deterministically from shuffle_seed.
+//   rr_skip(h, n)                                -> records skipped, -1 err
 //   rr_next_record(h, &buf, &len)                -> 1 ok, 0 EOF, <0 error
 //   rr_free(buf)
 //   rr_next_batch_i32(h, key, out, batch, width) -> 1 ok, 0 EOF, <0 error
 //   rr_next_batch_images(h, ikey, lkey, imgs, labels, batch, th, tw,
 //                        threads, crop_seeds, mean, std)
 //                                                -> 1 ok, 0 EOF, <0 error
+//   rr_next_batch_images_eval(h, ikey, lkey, imgs, labels, batch, th, tw,
+//                             threads, central_frac, mean, std)
+//                                                -> k filled, 0 EOF, <0 err
 //     The native ImageNet input path (SURVEY.md §7 hard part 1):
 //     per-image Inception-style distorted crop + flip sampled from
 //     crop_seeds (host-derived; splitmix64 here), decoded via PARTIAL
@@ -75,6 +83,21 @@ uint32_t MaskedCrc(const char* data, size_t n) {
   return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
 }
 
+// --------------------------------------------------------------- tiny rng --
+// splitmix64 — deterministic PRNG shared by the crop sampler and the
+// record shuffle; seeds are derived host-side through the documented
+// core/prng.py discipline, the sampling algorithms are fixed here.
+struct Rng {
+  uint64_t s;
+  uint64_t Next() {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ull);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+    return z ^ (z >> 31);
+  }
+  float Uniform() { return (Next() >> 40) * (1.0f / (1 << 24)); }
+};
+
 // ------------------------------------------------------------ ring buffer --
 struct Record {
   std::vector<char> bytes;
@@ -89,6 +112,14 @@ struct Reader {
   std::thread worker;
   std::atomic<bool> done{false}, stop{false};
   std::string error;
+  // Windowed record-level shuffle (tf.data shuffle-buffer semantics):
+  // consumers pop a uniform-random slot out of a W-record window that is
+  // refilled from the in-order stream. Deterministic given (file order,
+  // seed, W) — the resume fast-skip replays the identical sequence.
+  size_t shuffle_window = 0;
+  Rng shuffle_rng{0};
+  std::vector<Record> shuffle_buf;
+  bool shuffle_primed = false;
 
   ~Reader() {
     {
@@ -465,21 +496,7 @@ void ResizeBilinear(const uint8_t* src, int sw, int sh, int src_stride,
   }
 }
 
-// --------------------------------------------------------------- crop rng --
-// splitmix64 — tiny deterministic PRNG for the crop sampler; the SEED is
-// derived host-side through the documented core/prng.py discipline, the
-// sampling algorithm is fixed here.
-struct Rng {
-  uint64_t s;
-  uint64_t Next() {
-    uint64_t z = (s += 0x9e3779b97f4a7c15ull);
-    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
-    z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
-    return z ^ (z >> 31);
-  }
-  float Uniform() { return (Next() >> 40) * (1.0f / (1 << 24)); }
-};
-
+// ------------------------------------------------------------ crop sampler --
 // Inception-style distorted crop in full-res pixel coords: area fraction
 // U[0.08,1], aspect U[3/4,4/3], 10 attempts, central-full fallback.
 void SampleCrop(Rng* rng, int W, int H, int* cx, int* cy, int* cw, int* ch) {
@@ -503,15 +520,17 @@ void SampleCrop(Rng* rng, int W, int H, int* cx, int* cy, int* cw, int* ch) {
   *cx = 0; *cy = 0; *cw = W; *ch = H;
 }
 
-// Decode ONLY the sampled crop window: DCT-scaled decode sized to the
+// Decode ONLY a chosen crop window: DCT-scaled decode sized to the
 // crop, jpeg_crop_scanline for the column range (iMCU-aligned),
 // jpeg_skip_scanlines for the rows above/below — the libjpeg-turbo
 // equivalent of tf.data's fused decode_and_crop, so the IDCT cost tracks
-// the CROP area (8%–100% of the image), not the full frame.
-bool DecodeJpegCropped(const char* data, size_t n, uint64_t seed, int tw,
-                       int th, float* out /* th*tw*3 */,
-                       const float* mean = nullptr,
-                       const float* inv_std = nullptr) {
+// the CROP area, not the full frame. `choose(W, H, &cx, &cy, &cw, &ch,
+// &flip)` picks the full-resolution window once the header is parsed —
+// shared by the train distorted-crop and eval central-crop paths.
+template <typename ChooseCrop>
+bool DecodeJpegWindow(const char* data, size_t n, int tw, int th,
+                      float* out /* th*tw*3 */, const float* mean,
+                      const float* inv_std, ChooseCrop choose) {
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
@@ -527,10 +546,9 @@ bool DecodeJpegCropped(const char* data, size_t n, uint64_t seed, int tw,
   cinfo.out_color_space = JCS_RGB;
   const int W = cinfo.image_width, H = cinfo.image_height;
 
-  Rng rng{seed};
   int cx, cy, cw, ch;
-  SampleCrop(&rng, W, H, &cx, &cy, &cw, &ch);
-  const bool flip = rng.Uniform() < 0.5f;  // horizontal flip, same stream
+  bool flip = false;
+  choose(W, H, &cx, &cy, &cw, &ch, &flip);
 
   // DCT-scale so the SCALED crop still covers the resize target.
   cinfo.scale_num = 1;
@@ -582,6 +600,40 @@ bool DecodeJpegCropped(const char* data, size_t n, uint64_t seed, int tw,
   return true;
 }
 
+// Train path: seeded Inception-style distorted crop + coin-flip mirror.
+bool DecodeJpegCropped(const char* data, size_t n, uint64_t seed, int tw,
+                       int th, float* out, const float* mean = nullptr,
+                       const float* inv_std = nullptr) {
+  return DecodeJpegWindow(
+      data, n, tw, th, out, mean, inv_std,
+      [seed](int W, int H, int* cx, int* cy, int* cw, int* ch, bool* flip) {
+        Rng rng{seed};
+        SampleCrop(&rng, W, H, cx, cy, cw, ch);
+        *flip = rng.Uniform() < 0.5f;  // horizontal flip, same stream
+      });
+}
+
+// Eval path: deterministic central crop. The window arithmetic mirrors
+// tf.image.central_crop — offset = int((D - D*frac) / 2) computed in
+// float, target = D - 2*offset — so the native eval sees the same pixels
+// as the tf.data eval twin (resize filter remains bilinear-vs-bicubic,
+// the documented delta).
+bool DecodeJpegCentral(const char* data, size_t n, float central_frac,
+                       int tw, int th, float* out,
+                       const float* mean = nullptr,
+                       const float* inv_std = nullptr) {
+  return DecodeJpegWindow(
+      data, n, tw, th, out, mean, inv_std,
+      [central_frac](int W, int H, int* cx, int* cy, int* cw, int* ch,
+                     bool* flip) {
+        *cx = static_cast<int>((W - W * central_frac) / 2);
+        *cy = static_cast<int>((H - H * central_frac) / 2);
+        *cw = W - 2 * *cx;
+        *ch = H - 2 * *cy;
+        *flip = false;
+      });
+}
+
 // Pop one record out of the queue by MOVE — 1 ok, 0 EOF, -1 error.
 int PopRecord(Reader* r, Record* out) {
   std::unique_lock<std::mutex> lock(r->mu);
@@ -596,23 +648,77 @@ int PopRecord(Reader* r, Record* out) {
   return 1;
 }
 
+// Pop through the shuffle window when one is configured. All consumers
+// (raw records, i32 batches, image batches, skip) share this path, so a
+// resume that skips k records replays exactly what reading-and-discarding
+// k records would have produced. Single-consumer (the Python side is one
+// thread per handle), so no extra locking.
+int PopNext(Reader* r, Record* out) {
+  if (r->shuffle_window <= 1) return PopRecord(r, out);
+  if (!r->shuffle_primed) {
+    r->shuffle_buf.reserve(r->shuffle_window);
+    while (r->shuffle_buf.size() < r->shuffle_window) {
+      Record rec;
+      int rc = PopRecord(r, &rec);
+      if (rc < 0) return rc;
+      if (rc == 0) break;
+      r->shuffle_buf.push_back(std::move(rec));
+    }
+    r->shuffle_primed = true;
+  }
+  if (r->shuffle_buf.empty()) return PopRecord(r, out);  // EOF (or error)
+  size_t j = static_cast<size_t>(r->shuffle_rng.Next() % r->shuffle_buf.size());
+  *out = std::move(r->shuffle_buf[j]);
+  Record rec;
+  int rc = PopRecord(r, &rec);
+  if (rc < 0) return rc;
+  if (rc == 1) {
+    r->shuffle_buf[j] = std::move(rec);
+  } else {  // stream drained: shrink the window
+    r->shuffle_buf[j] = std::move(r->shuffle_buf.back());
+    r->shuffle_buf.pop_back();
+  }
+  return 1;
+}
+
 }  // namespace
 
 extern "C" {
 
-void* rr_open(const char** paths, int n_paths, int prefetch) {
+// shuffle_window > 1 enables the windowed record shuffle (tf.data
+// shuffle-buffer semantics, deterministic given shuffle_seed).
+void* rr_open(const char** paths, int n_paths, int prefetch,
+              long shuffle_window, uint64_t shuffle_seed) {
   auto* r = new Reader();
   for (int i = 0; i < n_paths; ++i) r->paths.emplace_back(paths[i]);
   r->prefetch = prefetch > 0 ? prefetch : 256;
+  r->shuffle_window = shuffle_window > 1 ? static_cast<size_t>(shuffle_window)
+                                         : 0;
+  r->shuffle_rng.s = shuffle_seed;
   r->worker = std::thread(ReadLoop, r);
   return r;
+}
+
+// Skip `n` records of the (possibly shuffled) stream without the C-ABI
+// handoff copy or JPEG decode — the resume fast-skip. Returns the number
+// actually skipped (short on EOF), or -1 on a reader error.
+long rr_skip(void* h, long n) {
+  auto* r = static_cast<Reader*>(h);
+  Record rec;
+  long i = 0;
+  for (; i < n; ++i) {
+    int rc = PopNext(r, &rec);
+    if (rc < 0) return -1;
+    if (rc == 0) break;
+  }
+  return i;
 }
 
 // Pops one record; caller owns *buf (free with rr_free). (The malloc+
 // copy is the C-ABI handoff cost; the batch paths below move instead.)
 int rr_next_record(void* h, char** buf, long* len) {
   Record rec;
-  int rc = PopRecord(static_cast<Reader*>(h), &rec);
+  int rc = PopNext(static_cast<Reader*>(h), &rec);
   if (rc <= 0) return rc;
   *len = static_cast<long>(rec.bytes.size());
   *buf = static_cast<char*>(std::malloc(rec.bytes.size()));
@@ -629,7 +735,7 @@ int rr_next_batch_i32(void* h, const char* key, int32_t* out, int batch,
   auto* r = static_cast<Reader*>(h);
   Record rec;
   for (int i = 0; i < batch; ++i) {
-    int rc = PopRecord(r, &rec);
+    int rc = PopNext(r, &rec);
     if (rc <= 0) return rc;
     int got = ParseExampleInt64(rec.bytes.data(), rec.bytes.size(), key,
                                 out + i * width, width);
@@ -664,7 +770,7 @@ int rr_next_batch_images(void* h, const char* image_key,
   // contract); decode is the parallel part.
   std::vector<Record> records(batch);
   for (int i = 0; i < batch; ++i) {
-    int rc = PopRecord(static_cast<Reader*>(h), &records[i]);
+    int rc = PopNext(static_cast<Reader*>(h), &records[i]);
     if (rc <= 0) return rc;  // records pulled by MOVE, no copies
   }
   std::atomic<int> next{0};
@@ -714,6 +820,72 @@ int rr_next_batch_images(void* h, const char* image_key,
   for (int t = 0; t < n_threads; ++t) pool.emplace_back(work);
   for (auto& t : pool) t.join();
   return failed.load() >= 0 ? -3 : 1;
+}
+
+// Eval twin of rr_next_batch_images: deterministic central-crop
+// (central_frac) decode + bilinear resize, single pass — no crop seeds,
+// no flip. Pops UP TO `batch` records and returns the number filled
+// (0 = clean EOF, <0 error); rows past the returned count are untouched
+// (the caller zero-pads and weights them) — this is what lets the exact-
+// eval contract (every record once, padded final batch) run through the
+// native path.
+int rr_next_batch_images_eval(void* h, const char* image_key,
+                              const char* label_key, float* out_images,
+                              int32_t* out_labels, int batch, int th, int tw,
+                              int threads, float central_frac,
+                              const float* mean, const float* stddev) {
+  float inv_std_buf[3];
+  const float* inv_std = nullptr;
+  if (mean != nullptr && stddev != nullptr) {
+    for (int c = 0; c < 3; ++c) inv_std_buf[c] = 1.0f / stddev[c];
+    inv_std = inv_std_buf;
+  } else {
+    mean = nullptr;
+  }
+  std::vector<Record> records;
+  records.reserve(batch);
+  for (int i = 0; i < batch; ++i) {
+    Record rec;
+    int rc = PopNext(static_cast<Reader*>(h), &rec);
+    if (rc < 0) return rc;
+    if (rc == 0) break;  // partial final batch
+    records.push_back(std::move(rec));
+  }
+  const int k = static_cast<int>(records.size());
+  if (k == 0) return 0;
+  std::atomic<int> next{0};
+  std::atomic<int> failed{-1};
+  int n_threads = threads > 0 ? threads : 8;
+  if (n_threads > k) n_threads = k;
+  auto work = [&] {
+    for (int i = next.fetch_add(1); i < k; i = next.fetch_add(1)) {
+      const auto& rec = records[i].bytes;
+      const char* jpg = nullptr;
+      uint64_t jpg_len = 0;
+      if (ParseExampleBytes(rec.data(), rec.size(), image_key, &jpg,
+                            &jpg_len) != 1) {
+        failed = i;
+        return;
+      }
+      float* dst = out_images + static_cast<size_t>(i) * th * tw * 3;
+      if (!DecodeJpegCentral(jpg, jpg_len, central_frac, tw, th, dst, mean,
+                             inv_std)) {
+        failed = i;
+        return;
+      }
+      int32_t label = 0;
+      if (ParseExampleInt64(rec.data(), rec.size(), label_key, &label, 1) < 1) {
+        failed = i;
+        return;
+      }
+      out_labels[i] = label;
+    }
+  };
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) pool.emplace_back(work);
+  for (auto& t : pool) t.join();
+  return failed.load() >= 0 ? -3 : k;
 }
 
 const char* rr_error(void* h) {

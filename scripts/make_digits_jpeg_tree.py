@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Render sklearn's handwritten-digit scans as an ImageNet-style JPEG tree.
+
+Companion to scripts/make_digits_npz.py (same 1,797 real scans, same
+seeded 1500/297 split) but emitting the torchvision directory layout that
+scripts/make_imagenet_tfrecords.py consumes:
+
+    <out>/train/<digit>/<idx>.jpg
+    <out>/validation/<digit>/<idx>.jpg
+
+This closes the full north-star input loop with real image files: raw
+JPEGs → TFRecord authoring → (native or tf.data) ImageNet pipeline →
+train → exact eval (SURVEY.md §3.1/§3.4), in an environment where actual
+ImageNet is unreachable.
+
+Upsampling: 8x8 → nearest-neighbor x8 (64x64) RGB, JPEG quality 92. The
+64x64 canvas leaves room for the Inception-style distorted crops of the
+train transform.
+
+Usage: python scripts/make_digits_jpeg_tree.py [out_dir]  (default
+/tmp/digits_jpeg)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/digits_jpeg"
+    import tensorflow as tf
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    images = digits.images.astype(np.float32)  # (1797, 8, 8), values 0..16
+    labels = digits.target.astype(np.int64)
+
+    up = np.kron(images, np.ones((8, 8), np.float32))       # (N, 64, 64)
+    up = (up / 16.0 * 255.0).astype(np.uint8)
+    rgb = np.repeat(up[..., None], 3, axis=-1)              # (N, 64, 64, 3)
+
+    # Same split discipline as make_digits_npz.py: seeded shuffle so the
+    # writer-ordered raw file doesn't become a distribution-shifted split.
+    perm = np.random.default_rng(0).permutation(len(rgb))
+    rgb, labels = rgb[perm], labels[perm]
+    n_train = 1500
+
+    counts = {"train": 0, "validation": 0}
+    for i, (img, lab) in enumerate(zip(rgb, labels)):
+        split = "train" if i < n_train else "validation"
+        d = os.path.join(out_dir, split, f"digit_{lab}")
+        os.makedirs(d, exist_ok=True)
+        jpg = tf.io.encode_jpeg(img, quality=92).numpy()
+        with open(os.path.join(d, f"{i:05d}.jpg"), "wb") as fh:
+            fh.write(jpg)
+        counts[split] += 1
+    print(f"wrote {out_dir}: train {counts['train']}, "
+          f"validation {counts['validation']} (10 classes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

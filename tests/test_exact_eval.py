@@ -113,6 +113,82 @@ def test_exact_eval_matches_numpy_reference(devices, mnist_npz):
     assert results["eval_loss"] == pytest.approx(ref_loss, rel=1e-5)
 
 
+def test_native_eval_parity_with_tfdata(tmp_path):
+    """The native ImageNet eval must match the tf.data eval twin on the
+    same fabricated records: identical cardinality, labels, weights and
+    coverage; pixels equal to decode tolerance. 64×64 JPEGs with
+    image_size 56 make the resize an identity for BOTH paths (central
+    crop 87.5% of 64 = 56), so the bilinear-vs-bicubic filter delta drops
+    out and the comparison isolates decode + crop + standardize."""
+    import tensorflow as tf
+
+    from distributed_tensorflow_framework_tpu.core.config import DataConfig
+    from distributed_tensorflow_framework_tpu.data.imagenet import make_imagenet
+
+    root = str(tmp_path / "imgnet")
+    os.makedirs(root)
+    rng = np.random.default_rng(11)
+    n = 17  # batch 5 → 4 batches, last padded (2 real + 3 pad)
+    with tf.io.TFRecordWriter(
+            os.path.join(root, "validation-00000-of-00001")) as w:
+        for i in range(n):
+            img = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+            w.write(tf.train.Example(features=tf.train.Features(feature={
+                "image/encoded": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(
+                        value=[tf.io.encode_jpeg(img).numpy()])),
+                "image/class/label": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[i + 1])),
+            })).SerializeToString())
+
+    def batches(native: bool):
+        cfg = DataConfig(name="imagenet", data_dir=root, global_batch_size=5,
+                         image_size=56, use_native_reader=native, seed=0)
+        ds = make_imagenet(cfg, 0, 1, train=False)
+        out = list(ds)
+        return ds, out
+
+    ds_tf, tf_batches = batches(False)
+    ds_nat, nat_batches = batches(True)
+    assert ds_tf.cardinality == ds_nat.cardinality == 4
+    assert len(tf_batches) == len(nat_batches) == 4
+    for bt, bn in zip(tf_batches, nat_batches):
+        np.testing.assert_array_equal(bt["label"], bn["label"])
+        np.testing.assert_array_equal(bt["weight"], bn["weight"])
+        a = np.asarray(bt["image"], np.float32)
+        b = np.asarray(bn["image"], np.float32)
+        # Standardized units (std ≈ 57 raw counts): decoder IDCT deltas of
+        # a few counts → mean ~0.02, worst pixel ~3 counts on noise JPEGs;
+        # identical geometry means no resize delta.
+        assert np.abs(a - b).mean() < 0.05
+        assert np.abs(a - b).max() < 1.0
+    assert sum(float(b["weight"].sum()) for b in nat_batches) == n
+
+    # Mid-pass resume on the native eval stream: restore after batch 1
+    # replays batches 2..4 identically.
+    ds2 = make_imagenet(
+        DataConfig(name="imagenet", data_dir=root, global_batch_size=5,
+                   image_size=56, use_native_reader=True, seed=0),
+        0, 1, train=False)
+    first = next(ds2)
+    np.testing.assert_array_equal(first["label"], nat_batches[0]["label"])
+    snap = ds2.state()
+    ds3 = make_imagenet(
+        DataConfig(name="imagenet", data_dir=root, global_batch_size=5,
+                   image_size=56, use_native_reader=True, seed=0),
+        0, 1, train=False)
+    ds3.restore(snap)
+    for want in nat_batches[1:]:
+        got = next(ds3)
+        np.testing.assert_array_equal(want["label"], got["label"])
+        np.testing.assert_array_equal(want["weight"], got["weight"])
+        np.testing.assert_array_equal(
+            np.asarray(want["image"], np.float32),
+            np.asarray(got["image"], np.float32))
+    with pytest.raises(StopIteration):
+        next(ds3)
+
+
 def test_native_reader_eval_rejected_at_build(devices, tmp_path):
     """A config that would crash at the FIRST evaluate() (native MLM reader
     has no exact-eval path) must fail at build time, not after training."""

@@ -89,17 +89,18 @@ def image_record_files(tmp_path_factory):
                 }))
                 w.write(ex.SerializeToString())
         paths.append(p)
-    # One validation shard so the eval-refusal path (which globs
-    # validation-*) is reachable.
+    # A validation shard (5 records, deliberately not a batch multiple)
+    # for the native exact-eval path.
     vp = str(d / "validation-00000-of-00001")
     with tf.io.TFRecordWriter(vp) as w:
-        img = rng.integers(0, 255, (40, 40, 3), dtype=np.uint8)
-        w.write(tf.train.Example(features=tf.train.Features(feature={
-            "image/encoded": tf.train.Feature(bytes_list=tf.train.BytesList(
-                value=[tf.io.encode_jpeg(img).numpy()])),
-            "image/class/label": tf.train.Feature(
-                int64_list=tf.train.Int64List(value=[1])),
-        })).SerializeToString())
+        for i in range(5):
+            img = rng.integers(0, 255, (40 + 4 * i, 40, 3), dtype=np.uint8)
+            w.write(tf.train.Example(features=tf.train.Features(feature={
+                "image/encoded": tf.train.Feature(bytes_list=tf.train.BytesList(
+                    value=[tf.io.encode_jpeg(img).numpy()])),
+                "image/class/label": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[i + 1])),
+            })).SerializeToString())
     return paths, raws, labels
 
 
@@ -150,7 +151,7 @@ def test_native_imagenet_pipeline_and_resume(image_record_files):
     assert abs(float(a0["image"].mean())) < 3.0
 
     # Snapshot after batch 1, restore into a fresh pipeline → batch 2
-    # replays exactly (flip augmentation included).
+    # replays exactly (record shuffle AND flip augmentation included).
     ds2 = make_imagenet(cfg, 0, 1, train=True)
     b0 = next(ds2)
     np.testing.assert_array_equal(a0["image"], b0["image"])
@@ -161,9 +162,52 @@ def test_native_imagenet_pipeline_and_resume(image_record_files):
     np.testing.assert_array_equal(a1["image"], c1["image"])
     np.testing.assert_array_equal(a1["label"], c1["label"])
 
-    # Eval through the native reader must refuse (no exact-eval path).
-    with pytest.raises(ValueError, match="exact-eval"):
-        make_imagenet(cfg, 0, 1, train=False)
+    # Native exact eval: one padded pass over the 5 validation records.
+    eval_ds = make_imagenet(cfg, 0, 1, train=False)
+    assert eval_ds.cardinality == 2  # ceil(5/4)
+    batches = list(eval_ds)
+    assert len(batches) == 2
+    assert sum(float(b["weight"].sum()) for b in batches) == 5
+    labels = np.concatenate([b["label"][b["weight"] > 0] for b in batches])
+    assert sorted(labels.tolist()) == [0, 1, 2, 3, 4]  # [1,5] shifted
+    # Padded rows are zeroed.
+    tail = batches[-1]
+    assert (np.asarray(tail["image"], np.float32)[tail["weight"] == 0] == 0).all()
+
+
+def test_record_shuffle_window(tfrecord_files):
+    """Windowed record shuffle: same multiset, shuffled order, seed-
+    deterministic, and skip == read-and-discard through the window."""
+    from distributed_tensorflow_framework_tpu.data.native_reader import (
+        NativeRecordReader,
+    )
+
+    def read_all(**kw):
+        r = NativeRecordReader(tfrecord_files, **kw)
+        out = list(r.records())
+        r.close()
+        return out
+
+    plain = read_all()
+    s7 = read_all(shuffle_window=16, shuffle_seed=7)
+    s7b = read_all(shuffle_window=16, shuffle_seed=7)
+    s9 = read_all(shuffle_window=16, shuffle_seed=9)
+    assert sorted(plain) == sorted(s7) == sorted(s9)  # no loss, no dupes
+    assert s7 == s7b          # deterministic given the seed
+    assert s7 != plain        # actually shuffled
+    assert s7 != s9           # seed matters
+
+    # skip(k) then read == read-and-discard k (the resume contract).
+    r = NativeRecordReader(tfrecord_files, shuffle_window=16, shuffle_seed=7)
+    assert r.skip_records(7) == 7
+    rest = list(r.records())
+    r.close()
+    assert rest == s7[7:]
+
+    # Skipping past EOF reports the short count instead of hanging.
+    r = NativeRecordReader(tfrecord_files, shuffle_window=16, shuffle_seed=7)
+    assert r.skip_records(10_000) == 40
+    r.close()
 
 
 def test_crc_detects_corruption(tfrecord_files, tmp_path):
