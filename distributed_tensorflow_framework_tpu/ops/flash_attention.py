@@ -187,9 +187,30 @@ def flash_attention_chunk(q, k, v, bias):
     shards) + additive key bias (B, Sk) → (o (B,S,H,D), lse (B,S,H,1)).
 
     ``o`` is normalized *within the chunk*; the caller merges chunks with
-    the standard logsumexp reweighting. Differentiable in all inputs
-    including through ``lse``.
+    the standard logsumexp reweighting (parallel/ring.py). Differentiable
+    in all inputs including through ``lse``.
     """
+    s_q, s_k = q.shape[1], k.shape[1]
+    if s_q != s_k or v.shape[1] != s_k:
+        # _flash_fwd indexes K/V blocks by q's length; unequal shards
+        # would silently read a K/V prefix.
+        raise ValueError(
+            f"flash_attention_chunk needs equal-length q/k/v shards, got "
+            f"q={s_q} k={s_k} v={v.shape[1]}"
+        )
+    if s_q % min(BLOCK_Q, s_q):
+        # The fwd grid is s // block_q: a non-multiple chunk (e.g.
+        # seq/ring_shards = 192) would silently drop the tail rows.
+        raise ValueError(
+            f"chunk len {s_q} must be a multiple of {BLOCK_Q} (or smaller "
+            f"than {BLOCK_Q}) — pick mesh.seq so the per-shard chunk "
+            f"seq/ring_shards is a {BLOCK_Q}-multiple"
+        )
+    if s_k > MAX_SEQ_VMEM:
+        raise ValueError(
+            f"flash_attention_chunk holds the full K/V chunk in VMEM; "
+            f"chunk {s_k} > {MAX_SEQ_VMEM} — raise the ring shard count"
+        )
     qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     o, lse = _fused_lse(qt, kt, vt, bias[:, None, :].astype(jnp.float32))
     return o.transpose(0, 2, 1, 3), lse.transpose(0, 2, 1, 3)

@@ -6,8 +6,12 @@ sharded over the ``seq`` mesh axis; each device holds its local Q/K/V shard
 and the K/V shards rotate around the ring via ``ppermute`` while every
 device accumulates its queries' attention over the full sequence with an
 online (flash-style) softmax. Communication rides ICI neighbor links and
-overlaps with the per-chunk attention compute; peak memory per device is
-O(S/n · S/n) scores instead of O(S²).
+overlaps with the per-chunk attention compute. Chunks merge by logsumexp
+reweighting; the per-chunk attention dispatches between the fused Pallas
+flash kernel (ops/flash_attention.flash_attention_chunk — long chunks,
+where it keeps the (S/n)² score block out of HBM entirely) and a plain
+XLA chain (short chunks, where XLA's fusion wins) at the measured
+FLASH_CHUNK_MIN crossover.
 
 ``ring_attention`` is the per-shard body (call inside shard_map);
 ``ring_attention_sharded`` wraps it for use from jit-level code (e.g. the
@@ -23,20 +27,53 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from distributed_tensorflow_framework_tpu.ops.flash_attention import (
+    flash_attention_chunk,
+)
 
-def _chunk_scores(q, k, v, bias, scale):
-    """Unnormalized attention stats for one K/V chunk.
+# Per-chunk implementation crossover, measured on TPU v5 lite (PERF_NOTES
+# round 3): the Pallas flash chunk wins once the per-shard sequence is
+# long enough that the (S/n)² score block dominates HBM traffic
+# (fwd+bwd 27.3 vs 30.4 ms at chunk 2048); below it XLA's fused unblocked
+# chain is faster (11.9 vs 22.5 ms at chunk 512). Module-level so tests
+# can force either path.
+FLASH_CHUNK_MIN = 2048
 
-    q: (B, Sq, H, D); k,v: (B, Sk, H, D); bias: (B, Sk) additive mask →
-    (max (B,H,Sq,1), exp-sum (B,H,Sq,1), weighted-v (B,Sq,H,D)).
+
+def _chunk_attention(q, k, v, bias):
+    """One K/V chunk → (chunk-normalized o (B,Sq,H,D) f32, lse (B,Sq,H,1)).
+
+    Dispatches on the static chunk length: Pallas flash kernel at/above
+    FLASH_CHUNK_MIN (see crossover note above), but ONLY when the chunk
+    fits the kernel's constraints (≤ its VMEM budget, q length a
+    BLOCK_Q multiple); everything else takes the plain-XLA chain, which
+    handles any shape — so no previously-valid ring config errors out.
     """
+    from distributed_tensorflow_framework_tpu.ops import flash_attention as fa
+
+    c = q.shape[1]
+    if (FLASH_CHUNK_MIN <= c <= fa.MAX_SEQ_VMEM
+            and c % min(fa.BLOCK_Q, c) == 0):
+        o, lse = flash_attention_chunk(q, k, v, bias)
+        return o.astype(jnp.float32), lse
+    scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     s = s + bias[:, None, None, :]
     m = jnp.max(s, axis=-1, keepdims=True)                   # (B,H,Sq,1)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)                   # (B,H,Sq,1)
     pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
-    return m, l, pv
+    o = pv / l.transpose(0, 2, 1, 3)
+    lse = (m + jnp.log(l)).transpose(0, 2, 1, 3)             # (B,Sq,H,1)
+    return o, lse
+
+
+def _merge_chunks(o, lse, o_c, lse_c):
+    """Logsumexp-reweighted online merge of two chunk-normalized partial
+    attentions: o,o_c (B,Sq,H,D) f32, lse,lse_c (B,Sq,H,1)."""
+    lse_new = jnp.logaddexp(lse, lse_c)
+    o_new = o * jnp.exp(lse - lse_new) + o_c * jnp.exp(lse_c - lse_new)
+    return o_new, lse_new
 
 
 def ring_attention(q, k, v, bias, *, axis_name: str = "seq"):
@@ -45,35 +82,27 @@ def ring_attention(q, k, v, bias, *, axis_name: str = "seq"):
     sequence dim. Shapes per shard: (B, S/n, H, D); ``bias`` is the
     additive key-mask shard (B, S/n) and rotates with its K/V."""
     n = lax.axis_size(axis_name)
-    scale = 1.0 / (q.shape[-1] ** 0.5)
 
-    m0, l0, pv0 = _chunk_scores(q, k, v, bias, scale)
+    o0, lse0 = _chunk_attention(q, k, v, bias)
 
     def body(i, carry):
-        m, l, pv, k_cur, v_cur, b_cur = carry
+        o, lse, k_cur, v_cur, b_cur = carry
         # Rotate K/V (and their mask shard) to the next ring position; the
         # send overlaps with the local chunk's attention compute below (XLA
         # schedules the collective-permute concurrently with the
-        # independent einsum).
+        # independent kernel call).
         perm = [(j, (j + 1) % n) for j in range(n)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         b_nxt = lax.ppermute(b_cur, axis_name, perm)
-        m_c, l_c, pv_c = _chunk_scores(q, k_nxt, v_nxt, b_nxt, scale)
-        # Online-softmax merge of the running stats with the new chunk.
-        m_new = jnp.maximum(m, m_c)
-        a = jnp.exp(m - m_new)
-        b = jnp.exp(m_c - m_new)
-        l_new = l * a + l_c * b
-        # pv carries (B,Sq,H,D); scale factors are (B,H,Sq,1) → align axes.
-        a_t = a.transpose(0, 2, 1, 3)  # (B,Sq,H,1)
-        b_t = b.transpose(0, 2, 1, 3)
-        pv_new = pv * a_t + pv_c * b_t
-        return m_new, l_new, pv_new, k_nxt, v_nxt, b_nxt
+        o_c, lse_c = _chunk_attention(q, k_nxt, v_nxt, b_nxt)
+        o, lse = _merge_chunks(o, lse, o_c, lse_c)
+        return o, lse, k_nxt, v_nxt, b_nxt
 
-    m, l, pv, _, _, _ = lax.fori_loop(0, n - 1, body, (m0, l0, pv0, k, v, bias))
-    out = pv / l.transpose(0, 2, 1, 3)
-    return out.astype(q.dtype)
+    # Static trip count → lowered as scan, so reverse-mode AD flows
+    # through the merge (incl. the lse cotangent into the chunk kernel).
+    o, _, _, _, _ = lax.fori_loop(0, n - 1, body, (o0, lse0, k, v, bias))
+    return o.astype(q.dtype)
 
 
 def ring_attention_sharded(q, k, v, *, mesh, mask=None, axis_name: str = "seq"):

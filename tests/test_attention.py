@@ -91,14 +91,20 @@ def test_flash_attention_backward_no_quadratic_residual(devices):
         )
 
 
-def test_ring_attention_matches_xla(devices):
-    """Ring attention over a seq=8 mesh axis reproduces full attention."""
+@pytest.mark.parametrize("chunk_impl", ["xla", "flash"])
+def test_ring_attention_matches_xla(devices, monkeypatch, chunk_impl):
+    """Ring attention over a seq=8 mesh axis reproduces full attention —
+    through BOTH per-chunk implementations (the FLASH_CHUNK_MIN dispatch
+    picks by chunk length in production; tests force each path)."""
     from distributed_tensorflow_framework_tpu.core.config import MeshConfig
     from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+    from distributed_tensorflow_framework_tpu.parallel import ring
     from distributed_tensorflow_framework_tpu.parallel.ring import (
         ring_attention_sharded,
     )
 
+    monkeypatch.setattr(
+        ring, "FLASH_CHUNK_MIN", 0 if chunk_impl == "flash" else 10**9)
     mesh = create_mesh(MeshConfig(data=1, seq=8))
     q, k, v = _rand_qkv(jax.random.key(2), b=2, s=256, h=2, d=32)
     ref = dot_product_attention(q, k, v)
@@ -109,16 +115,21 @@ def test_ring_attention_matches_xla(devices):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_ring_attention_mask_and_gradients(devices):
+@pytest.mark.parametrize("chunk_impl", ["xla", "flash"])
+def test_ring_attention_mask_and_gradients(devices, monkeypatch, chunk_impl):
     """Ring attention under a key mask must match XLA attention for the
     output AND the q/k/v gradients (the training path differentiates
-    through the ppermute ring — previously only the forward was pinned)."""
+    through the ppermute ring; the flash variant additionally exercises
+    the lse-cotangent path of the Pallas backward)."""
     from distributed_tensorflow_framework_tpu.core.config import MeshConfig
     from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+    from distributed_tensorflow_framework_tpu.parallel import ring
     from distributed_tensorflow_framework_tpu.parallel.ring import (
         ring_attention_sharded,
     )
 
+    monkeypatch.setattr(
+        ring, "FLASH_CHUNK_MIN", 0 if chunk_impl == "flash" else 10**9)
     mesh = create_mesh(MeshConfig(data=1, seq=8))
     q, k, v = _rand_qkv(jax.random.key(5), b=2, s=256, h=2, d=32)
     # Mask out the last 40 keys (cuts across the final ring shard).
@@ -144,3 +155,48 @@ def test_ring_attention_mask_and_gradients(devices):
     for name, a, b in zip("qkv", g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-5, err_msg=f"d{name}")
+
+
+def test_flash_chunk_guards(devices):
+    """flash_attention_chunk must refuse shapes its grid would silently
+    truncate: non-multiple-of-BLOCK_Q chunk lengths (e.g. seq/ring_shards
+    = 192), oversized K/V chunks, and unequal shard lengths."""
+    from distributed_tensorflow_framework_tpu.ops.flash_attention import (
+        flash_attention_chunk,
+    )
+
+    def qkv(s, sk=None):
+        sk = s if sk is None else sk
+        q = jnp.zeros((1, s, 2, 8), jnp.float32)
+        k = jnp.zeros((1, sk, 2, 8), jnp.float32)
+        bias = jnp.zeros((1, sk), jnp.float32)
+        return q, k, k, bias
+
+    q, k, v, bias = qkv(192)  # > BLOCK_Q but not a multiple
+    with pytest.raises(ValueError, match="multiple of"):
+        flash_attention_chunk(q, k, v, bias)
+    q, k, v, bias = qkv(8192)  # past the VMEM budget
+    with pytest.raises(ValueError, match="VMEM"):
+        flash_attention_chunk(q, k, v, bias)
+    q, k, v, bias = qkv(128, sk=256)  # unequal shards
+    with pytest.raises(ValueError, match="equal-length"):
+        flash_attention_chunk(q, k, v, bias)
+    # A legal sub-block chunk still runs (block_q clamps to s).
+    q, k, v, bias = qkv(32)
+    o, lse = flash_attention_chunk(q, k, v, bias)
+    assert o.shape == (1, 32, 2, 8) and lse.shape == (1, 32, 2, 1)
+
+
+def test_ring_chunk_dispatch_falls_back_for_incompatible_shapes(devices):
+    """Chunks the Pallas kernel can't take (non-128-multiples above the
+    crossover, or beyond its VMEM budget) must silently use the XLA chain
+    — every chunk length the old pure-XLA ring handled still works."""
+    from distributed_tensorflow_framework_tpu.parallel.ring import (
+        _chunk_attention,
+    )
+
+    for c in (2112, 8192):  # non-multiple above crossover; > MAX_SEQ_VMEM
+        q = jnp.zeros((1, c, 1, 8), jnp.float32)
+        bias = jnp.zeros((1, c), jnp.float32)
+        o, lse = _chunk_attention(q, q, q, bias)  # must not raise
+        assert o.shape == (1, c, 1, 8) and lse.shape == (1, c, 1, 1)
