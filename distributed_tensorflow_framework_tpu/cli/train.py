@@ -15,10 +15,29 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 
 from distributed_tensorflow_framework_tpu.core.config import load_config
 from distributed_tensorflow_framework_tpu.core.metrics import setup_logging
+
+
+def _honor_platform_env() -> None:
+    """Restore stock JAX semantics for the JAX_PLATFORMS env var.
+
+    Some images pin the platform via ``jax.config`` in sitecustomize,
+    which silently beats the env var — a launcher that sets
+    ``JAX_PLATFORMS=cpu`` (e.g. scripts/launch_local_cluster.py spawning
+    virtual-CPU workers) would otherwise end up on the pinned backend
+    with the wrong device count. Re-assert the env var through
+    jax.config BEFORE any backend query; unset/empty leaves the default
+    untouched.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
 
 
 def parse_args(argv=None):
@@ -39,6 +58,7 @@ def parse_args(argv=None):
 
 def main(argv=None) -> int:
     setup_logging()
+    _honor_platform_env()
     args = parse_args(argv)
     config = load_config(args.config, overrides=args.overrides)
     from distributed_tensorflow_framework_tpu.train import Trainer

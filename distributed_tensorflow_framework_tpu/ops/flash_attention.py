@@ -182,6 +182,14 @@ def _fused_lse_bwd(res, g):
 _fused_lse.defvjp(_fused_lse_fwd, _fused_lse_bwd)
 
 
+def chunk_supported(s: int) -> bool:
+    """Whether a ring chunk of per-shard length ``s`` fits the kernel's
+    constraints (the same ones flash_attention_chunk's guards enforce) —
+    the single source of truth for dispatch-vs-fallback decisions
+    (parallel/ring.py)."""
+    return s % min(BLOCK_Q, s) == 0 and s <= MAX_SEQ_VMEM
+
+
 def flash_attention_chunk(q, k, v, bias):
     """Per-chunk fused attention for the ring: (B,S,H,D) q/k/v (equal-length
     shards) + additive key bias (B, Sk) → (o (B,S,H,D), lse (B,S,H,1)).

@@ -28,6 +28,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from distributed_tensorflow_framework_tpu.ops.flash_attention import (
+    chunk_supported,
     flash_attention_chunk,
 )
 
@@ -45,15 +46,12 @@ def _chunk_attention(q, k, v, bias):
 
     Dispatches on the static chunk length: Pallas flash kernel at/above
     FLASH_CHUNK_MIN (see crossover note above), but ONLY when the chunk
-    fits the kernel's constraints (≤ its VMEM budget, q length a
-    BLOCK_Q multiple); everything else takes the plain-XLA chain, which
+    fits the kernel's constraints (chunk_supported — the kernel module's
+    own predicate); everything else takes the plain-XLA chain, which
     handles any shape — so no previously-valid ring config errors out.
     """
-    from distributed_tensorflow_framework_tpu.ops import flash_attention as fa
-
     c = q.shape[1]
-    if (FLASH_CHUNK_MIN <= c <= fa.MAX_SEQ_VMEM
-            and c % min(fa.BLOCK_Q, c) == 0):
+    if c >= FLASH_CHUNK_MIN and chunk_supported(c):
         o, lse = flash_attention_chunk(q, k, v, bias)
         return o.astype(jnp.float32), lse
     scale = 1.0 / (q.shape[-1] ** 0.5)

