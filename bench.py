@@ -22,11 +22,9 @@ Set BENCH_TRACE=<dir> to also capture an XPlane trace of the timed window
 
 from __future__ import annotations
 
-import contextlib
 import json
 import os
 import sys
-import time
 
 TARGET_PER_CHIP = 10_000 / 64  # BASELINE.json north star on v5e-64
 
@@ -43,14 +41,63 @@ CHIP_PEAKS: dict[str, tuple[float, float]] = {
 }
 
 
+def _compile_and_time(builder, state, batch, steps: int, warmup: int) -> dict:
+    """AOT-compile the train step ONCE (the same executable serves the
+    XLA cost model AND the timed loop), then measure wall-clock.
+
+    NOTE: sync via device_get of a VALUE, not block_until_ready — the
+    latter returns early through the axon remote-execution tunnel and
+    inflates throughput ~10x. Fetch a param leaf so the barrier includes
+    the final step's optimizer update, not just its forward pass.
+    """
+    import contextlib
+    import time
+
+    import jax
+
+    from distributed_tensorflow_framework_tpu.core.profiling import trace
+
+    step = builder.make_train_step(batch)
+    flops_per_step = bytes_per_step = None
+    try:
+        compiled = step.lower(state, batch).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        flops_per_step = float(ca.get("flops", 0.0)) or None
+        bytes_per_step = float(ca.get("bytes accessed", 0.0)) or None
+        step = compiled
+    except Exception as e:  # cost model unavailable on some backends
+        print(f"bench: cost_analysis unavailable ({type(e).__name__})",
+              file=sys.stderr)
+
+    def sync(s):
+        leaf = jax.tree.leaves(s.params)[0]
+        jax.device_get(leaf)
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    sync(state)
+    trace_dir = os.environ.get("BENCH_TRACE")
+    ctx = trace(trace_dir) if trace_dir else contextlib.nullcontext()
+    with ctx:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        sync(state)
+        dt = time.perf_counter() - t0
+    return {
+        "sec_per_step": dt / steps,
+        "flops_per_step": flops_per_step,
+        "bytes_per_step": bytes_per_step,
+    }
+
+
 def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
                    model_overrides: dict | None = None) -> dict:
-    import jax
     import numpy as np
 
     from distributed_tensorflow_framework_tpu.core.config import load_config
     from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
-    from distributed_tensorflow_framework_tpu.core.profiling import trace
     from distributed_tensorflow_framework_tpu.data.infeed import to_global
     from distributed_tensorflow_framework_tpu.train.step import StepBuilder
 
@@ -96,48 +143,90 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
     }
     batch = to_global(host, mesh)
     state = builder.init_state(0, batch)
-    step = builder.make_train_step(batch)
+    out = _compile_and_time(builder, state, batch, steps, warmup)
+    out["images_per_sec"] = batch_size / out["sec_per_step"]
+    return out
 
-    # AOT-compile ONCE; the same executable serves the cost model (flops /
-    # HBM bytes per step) AND the warmup/timed loops — a second tracing
-    # through the jit cache would double ResNet-50's compile time.
-    flops_per_step = bytes_per_step = None
-    try:
-        compiled = step.lower(state, batch).compile()
-        ca = compiled.cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        flops_per_step = float(ca.get("flops", 0.0)) or None
-        bytes_per_step = float(ca.get("bytes accessed", 0.0)) or None
-        step = compiled
-    except Exception as e:  # cost model unavailable on some backends
-        print(f"bench: cost_analysis unavailable ({type(e).__name__})",
-              file=sys.stderr)
 
-    # NOTE: sync via device_get of a VALUE, not block_until_ready — the
-    # latter returns early through the axon remote-execution tunnel and
-    # inflates throughput ~10x. Fetch a param leaf so the barrier includes
-    # the final step's optimizer update, not just its forward pass.
-    def sync(s):
-        leaf = jax.tree.leaves(s.params)[0]
-        jax.device_get(leaf)
+def bench_bert(batch_size: int, steps: int = 20, warmup: int = 3,
+               *, seq_len: int = 512, attention_impl: str = "pallas",
+               remat: bool = False) -> dict:
+    """BERT-base MLM train-step throughput — the MXU-bound side of the
+    perf story (PERF_NOTES.md). Knobs via env in main(): BENCH_ATTN
+    (pallas|xla|ring), BENCH_REMAT=1, BENCH_SEQ=<len>."""
+    from distributed_tensorflow_framework_tpu.core.config import load_config
+    from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+    from distributed_tensorflow_framework_tpu.data import get_dataset
+    from distributed_tensorflow_framework_tpu.data.infeed import to_global
+    from distributed_tensorflow_framework_tpu.train.step import StepBuilder
 
-    for _ in range(warmup):
-        state, metrics = step(state, batch)
-    sync(state)
-    trace_dir = os.environ.get("BENCH_TRACE")
-    ctx = trace(trace_dir) if trace_dir else contextlib.nullcontext()
-    with ctx:
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = step(state, batch)
-        sync(state)
-        dt = time.perf_counter() - t0
-    return {
-        "images_per_sec": batch_size * steps / dt,
-        "sec_per_step": dt / steps,
-        "flops_per_step": flops_per_step,
-        "bytes_per_step": bytes_per_step,
-    }
+    cfg = load_config(
+        base={
+            "name": "bench-bert",
+            # configs/bert_base_mlm.yaml shapes (BASELINE config 5).
+            "model": {"name": "bert", "vocab_size": 30522,
+                      "hidden_size": 768, "num_layers": 12, "num_heads": 12,
+                      "mlp_dim": 3072, "max_seq_len": seq_len,
+                      "dtype": "bfloat16", "attention_impl": attention_impl,
+                      "remat": remat},
+            "data": {"name": "synthetic_mlm", "global_batch_size": batch_size,
+                     "seq_len": seq_len},
+            "optimizer": {"name": "adamw", "learning_rate": 1e-4,
+                          "weight_decay": 0.01},
+            "train": {"total_steps": 1000},
+        }
+    )
+    mesh = create_mesh(cfg.mesh)
+    builder = StepBuilder(cfg, mesh)
+    host = next(get_dataset(cfg.data))
+    batch = to_global(host, mesh)
+    state = builder.init_state(0, batch)
+    out = _compile_and_time(builder, state, batch, steps, warmup)
+    out["examples_per_sec"] = batch_size / out["sec_per_step"]
+    out["tokens_per_sec"] = batch_size * seq_len / out["sec_per_step"]
+    return out
+
+
+def _annotate_roofline(out: dict, result: dict, chip: str, n_chips: int) -> None:
+    """Achieved TFLOP/s, MFU, arithmetic intensity and the bottleneck
+    verdict from the XLA cost model + public chip peaks."""
+    peak = CHIP_PEAKS.get(chip)
+    if not result["flops_per_step"]:
+        return
+    achieved = result["flops_per_step"] / result["sec_per_step"] / n_chips
+    out["tflops_per_sec"] = round(achieved / 1e12, 2)
+    intensity = None
+    if result["bytes_per_step"]:
+        intensity = result["flops_per_step"] / result["bytes_per_step"]
+        out["arith_intensity"] = round(intensity, 1)
+    if peak:
+        peak_flops, hbm_bw = peak
+        out["mfu"] = round(achieved / peak_flops, 4)
+        if intensity is not None:
+            ridge = peak_flops / hbm_bw
+            out["bound"] = "hbm_bandwidth" if intensity < ridge else "compute"
+            # Fraction of peak HBM bandwidth actually sustained.
+            out["hbm_bw_util"] = round(
+                result["bytes_per_step"] / result["sec_per_step"]
+                / n_chips / hbm_bw, 4,
+            )
+
+
+def _run_ladder(bench_fn, sizes, failure_metric: str, failure_unit: str):
+    """Try batch sizes largest-first (OOM → retry smaller); on total
+    failure print the zero-value JSON line and return None."""
+    for bs in sizes:
+        try:
+            return bench_fn(bs)
+        except Exception as e:
+            print(f"bench: batch {bs} failed ({type(e).__name__}: {e}), "
+                  f"retrying", file=sys.stderr)
+    import jax
+
+    print(json.dumps({"metric": failure_metric, "value": 0.0,
+                      "unit": failure_unit, "vs_baseline": 0.0,
+                      "chip": jax.devices()[0].device_kind}))
+    return None
 
 
 def main() -> int:
@@ -145,18 +234,48 @@ def main() -> int:
 
     n_chips = jax.device_count()
     chip = jax.devices()[0].device_kind
-    result = None
-    for bs in (256 * n_chips, 128 * n_chips, 64 * n_chips):
-        try:
-            result = bench_resnet50(bs)
-            break
-        except Exception as e:  # OOM → retry smaller
-            print(f"bench: batch {bs} failed ({type(e).__name__}), retrying",
-                  file=sys.stderr)
+    workload = os.environ.get("BENCH_WORKLOAD", "resnet50")
+
+    if workload == "bert":
+        # The MXU-bound transformer workload (kept OFF the driver's default
+        # path — the ONE default JSON line stays ResNet, the tracked
+        # BASELINE metric). Knobs: BENCH_ATTN, BENCH_REMAT, BENCH_SEQ.
+        seq = int(os.environ.get("BENCH_SEQ", "512"))
+        attn = os.environ.get("BENCH_ATTN", "pallas")
+        remat = os.environ.get("BENCH_REMAT", "0") not in ("", "0")
+        ladder = (64 * n_chips, 32 * n_chips, 16 * n_chips)
+        if os.environ.get("BENCH_BS"):
+            ladder = (int(os.environ["BENCH_BS"]) * n_chips,)
+        result = _run_ladder(
+            lambda bs: bench_bert(bs, seq_len=seq, attention_impl=attn,
+                                  remat=remat),
+            ladder, "bert_base_mlm_examples_per_sec_per_chip",
+            "examples/sec/chip")
+        if result is None:
+            return 1
+        out = {
+            "metric": "bert_base_mlm_examples_per_sec_per_chip",
+            "value": round(result["examples_per_sec"] / n_chips, 2),
+            "unit": "examples/sec/chip",
+            # No reference-published BERT number exists (BASELINE.md);
+            # report the absolute rates and roofline position instead.
+            "vs_baseline": 0.0,
+            "chip": chip,
+            "num_chips": n_chips,
+            "seq_len": seq,
+            "attention_impl": attn,
+            "remat": remat,
+            "tokens_per_sec_per_chip": round(
+                result["tokens_per_sec"] / n_chips, 1),
+        }
+        _annotate_roofline(out, result, chip, n_chips)
+        print(json.dumps(out))
+        return 0
+
+    result = _run_ladder(
+        bench_resnet50, (256 * n_chips, 128 * n_chips, 64 * n_chips),
+        "resnet50_images_per_sec_per_chip", "images/sec/chip")
     if result is None:
-        print(json.dumps({"metric": "resnet50_images_per_sec_per_chip",
-                          "value": 0.0, "unit": "images/sec/chip",
-                          "vs_baseline": 0.0, "chip": chip}))
         return 1
 
     per_chip = result["images_per_sec"] / n_chips
@@ -168,26 +287,7 @@ def main() -> int:
         "chip": chip,
         "num_chips": n_chips,
     }
-    peak = CHIP_PEAKS.get(chip)
-    if result["flops_per_step"]:
-        achieved = result["flops_per_step"] / result["sec_per_step"] / n_chips
-        out["tflops_per_sec"] = round(achieved / 1e12, 2)
-        if result["bytes_per_step"]:
-            intensity = result["flops_per_step"] / result["bytes_per_step"]
-            out["arith_intensity"] = round(intensity, 1)
-        if peak:
-            peak_flops, hbm_bw = peak
-            out["mfu"] = round(achieved / peak_flops, 4)
-            if result["bytes_per_step"]:
-                ridge = peak_flops / hbm_bw
-                out["bound"] = (
-                    "hbm_bandwidth" if intensity < ridge else "compute"
-                )
-                # Fraction of peak HBM bandwidth actually sustained.
-                out["hbm_bw_util"] = round(
-                    result["bytes_per_step"] / result["sec_per_step"]
-                    / n_chips / hbm_bw, 4,
-                )
+    _annotate_roofline(out, result, chip, n_chips)
     print(json.dumps(out))
     return 0
 

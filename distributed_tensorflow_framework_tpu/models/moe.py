@@ -46,6 +46,14 @@ def topk_dispatch(
     Returns ``(dispatch, combine, aux_loss)`` where dispatch/combine are
     (B, S, E, C) one-hot/weighted one-hot tensors and aux_loss is the
     scalar load-balancing loss.
+
+    Scale limits (v1, dense dispatch): the one-hot dispatch/combine
+    tensors are O(B·S·E·C) with C ≈ topk·S/E·cf, i.e. memory grows
+    ~linearly with topk·S·B and the top-k loop is Python-unrolled (topk
+    compiled matmul passes). Fine for the mixture sizes this framework
+    ships (E ≤ 64, topk ≤ 2); at hundreds of experts or topk ≫ 2 a
+    sort-based (argsort-over-expert-affinity) dispatch that never
+    materializes (B,S,E,C) is the known replacement — not implemented.
     """
     b, s, e = gate_logits.shape
     if not 1 <= topk <= e:

@@ -187,7 +187,7 @@ def chunk_supported(s: int) -> bool:
     constraints (the same ones flash_attention_chunk's guards enforce) —
     the single source of truth for dispatch-vs-fallback decisions
     (parallel/ring.py)."""
-    return s % min(BLOCK_Q, s) == 0 and s <= MAX_SEQ_VMEM
+    return s > 0 and s % min(BLOCK_Q, s) == 0 and s <= MAX_SEQ_VMEM
 
 
 def flash_attention_chunk(q, k, v, bias):
