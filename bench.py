@@ -113,6 +113,11 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
                       "space_to_depth_stem":
                           os.environ.get("BENCH_NO_S2D", "0")
                           in ("", "0"),
+                      # Per-block remat: trades idle MXU headroom for HBM
+                      # bytes on the BW-bound step (PERF_NOTES.md).
+                      "remat":
+                          os.environ.get("BENCH_REMAT", "0")
+                          not in ("", "0"),
                       **(model_overrides or {})},
             "data": {
                 "name": "synthetic_images",
@@ -151,9 +156,12 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
 def bench_bert(batch_size: int, steps: int = 20, warmup: int = 3,
                *, seq_len: int = 512, attention_impl: str = "pallas",
                remat: bool = False) -> dict:
-    """BERT-base MLM train-step throughput — the MXU-bound side of the
-    perf story (PERF_NOTES.md). Knobs via env in main(): BENCH_ATTN
-    (pallas|xla|ring), BENCH_REMAT=1, BENCH_SEQ=<len>."""
+    """BERT-base MLM train-step throughput — the transformer side of the
+    perf story. Measured on v5e it saturates NEITHER roofline (MFU ~27%,
+    HBM ~41%): the step is fragmented across medium GEMMs, so the lever
+    is fatter per-matmul work, not bandwidth (PERF_NOTES.md round 3).
+    Knobs via env in main(): BENCH_ATTN (pallas|xla|ring), BENCH_REMAT=1,
+    BENCH_SEQ=<len>, BENCH_BS=<per-chip batch>."""
     from distributed_tensorflow_framework_tpu.core.config import load_config
     from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
     from distributed_tensorflow_framework_tpu.data import get_dataset
@@ -229,6 +237,13 @@ def _run_ladder(bench_fn, sizes, failure_metric: str, failure_unit: str):
     return None
 
 
+def _ladder_override(default: tuple, n_chips: int) -> tuple:
+    """BENCH_BS=<per-chip batch> pins the batch ladder to one size."""
+    if os.environ.get("BENCH_BS"):
+        return (int(os.environ["BENCH_BS"]) * n_chips,)
+    return default
+
+
 def main() -> int:
     import jax
 
@@ -237,15 +252,14 @@ def main() -> int:
     workload = os.environ.get("BENCH_WORKLOAD", "resnet50")
 
     if workload == "bert":
-        # The MXU-bound transformer workload (kept OFF the driver's default
-        # path — the ONE default JSON line stays ResNet, the tracked
-        # BASELINE metric). Knobs: BENCH_ATTN, BENCH_REMAT, BENCH_SEQ.
+        # The transformer workload (kept OFF the driver's default path —
+        # the ONE default JSON line stays ResNet, the tracked BASELINE
+        # metric). Knobs: BENCH_ATTN, BENCH_REMAT, BENCH_SEQ, BENCH_BS.
         seq = int(os.environ.get("BENCH_SEQ", "512"))
         attn = os.environ.get("BENCH_ATTN", "pallas")
         remat = os.environ.get("BENCH_REMAT", "0") not in ("", "0")
-        ladder = (64 * n_chips, 32 * n_chips, 16 * n_chips)
-        if os.environ.get("BENCH_BS"):
-            ladder = (int(os.environ["BENCH_BS"]) * n_chips,)
+        ladder = _ladder_override(
+            (64 * n_chips, 32 * n_chips, 16 * n_chips), n_chips)
         result = _run_ladder(
             lambda bs: bench_bert(bs, seq_len=seq, attention_impl=attn,
                                   remat=remat),
@@ -272,8 +286,10 @@ def main() -> int:
         print(json.dumps(out))
         return 0
 
+    ladder = _ladder_override(
+        (256 * n_chips, 128 * n_chips, 64 * n_chips), n_chips)
     result = _run_ladder(
-        bench_resnet50, (256 * n_chips, 128 * n_chips, 64 * n_chips),
+        bench_resnet50, ladder,
         "resnet50_images_per_sec_per_chip", "images/sec/chip")
     if result is None:
         return 1

@@ -30,14 +30,13 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
     dtype = jnp.dtype(config.dtype)
     name = config.name.lower()
     is_bert = name in ("bert", "bert_base", "bert-base")
-    if config.remat and not is_bert:
+    if config.remat and not (is_bert or name.startswith("resnet")):
         # Honest failure beats a silently-ignored knob: activation remat is
-        # wired for the transformer encoder stack (models/bert.py); the
-        # conv models' activation footprint is pooling-dominated and has
-        # not needed it.
+        # wired for the transformer encoder stack (models/bert.py) and the
+        # ResNet residual blocks (models/resnet.py).
         raise ValueError(
-            f"model.remat is only supported for the transformer (bert) "
-            f"models, not {config.name!r}"
+            f"model.remat is only supported for the transformer (bert) and "
+            f"resnet models, not {config.name!r}"
         )
     if config.remat and config.pipeline_stages > 1:
         raise ValueError(
@@ -66,6 +65,7 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             bn_axis_name=bn_axis_name,
             cifar_stem=m.group(2) is not None,
             space_to_depth_stem=config.space_to_depth_stem,
+            remat=config.remat,
         )
     if name in ("inception_v3", "inception-v3", "inceptionv3"):
         from distributed_tensorflow_framework_tpu.models.inception import InceptionV3

@@ -103,6 +103,14 @@ class ResNet(nn.Module):
     # superset of the 7×7 stem's. Param count differs from torchvision
     # (12288 vs 9408 stem weights).
     space_to_depth_stem: bool = False
+    # Per-block activation rematerialization (jax.checkpoint via nn.remat):
+    # the backward re-runs each residual block's forward from its input
+    # instead of reading the stored intermediate conv activations back
+    # from HBM. On the HBM-bandwidth-bound ImageNet step this trades MXU
+    # FLOPs (idle headroom: MFU ~31%, PERF_NOTES.md) for bytes; it is
+    # also the memory lever for deep variants (101/152) at large batch.
+    # Numerically exact (same ops replayed; tests/test_remat.py).
+    remat: bool = False
     dtype: Any = jnp.bfloat16
     bn_axis_name: Any = None
 
@@ -126,6 +134,10 @@ class ResNet(nn.Module):
                        name="stem")(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         block_cls = BasicBlock if self.basic_block else Bottleneck
+        if self.remat:
+            # All block config is module attributes (train included), so no
+            # static_argnums are needed; BN stat mutations replay exactly.
+            block_cls = nn.remat(block_cls)
         for stage, size in enumerate(self.stage_sizes):
             for block in range(size):
                 strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
@@ -158,7 +170,8 @@ RESNET_DEPTHS: dict[int, tuple[tuple[int, ...], bool]] = {
 def make_resnet(depth: int, num_classes: int = 1000,
                 dtype: Any = jnp.bfloat16, bn_axis_name: Any = None,
                 cifar_stem: bool = False,
-                space_to_depth_stem: bool = False) -> ResNet:
+                space_to_depth_stem: bool = False,
+                remat: bool = False) -> ResNet:
     if depth not in RESNET_DEPTHS:
         raise ValueError(
             f"resnet depth {depth} not in {sorted(RESNET_DEPTHS)}"
@@ -169,8 +182,8 @@ def make_resnet(depth: int, num_classes: int = 1000,
     stages, basic = RESNET_DEPTHS[depth]
     return ResNet(stage_sizes=stages, num_classes=num_classes,
                   basic_block=basic, cifar_stem=cifar_stem,
-                  space_to_depth_stem=space_to_depth_stem, dtype=dtype,
-                  bn_axis_name=bn_axis_name)
+                  space_to_depth_stem=space_to_depth_stem, remat=remat,
+                  dtype=dtype, bn_axis_name=bn_axis_name)
 
 
 def ResNet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16,

@@ -60,6 +60,7 @@ def _wait_for_checkpoint(ckpt_dir: str, timeout: float = 240.0) -> None:
 
 
 @pytest.mark.slow
+@pytest.mark.slowest
 def test_sigkill_and_relaunch_resumes(tmp_path):
     ckpt_dir = str(tmp_path / "ckpt")
     steps = 4000  # far more than survive the kill window

@@ -30,6 +30,7 @@ def test_inception_v3_shapes_and_params():
     assert count == 27_161_264, count
 
 
+@pytest.mark.slowest
 def test_inception_v3_forward(devices):
     cfg = ModelConfig(name="inception_v3", num_classes=12, dtype="float32")
     model = get_model(cfg)

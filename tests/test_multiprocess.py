@@ -59,6 +59,7 @@ def test_two_process_training():
 
 
 @pytest.mark.slow
+@pytest.mark.slowest
 def test_two_process_exact_eval_uneven_shards(tmp_path):
     """Multi-host exact eval: hosts hold UNEVEN file shards (proc0: 2
     files/8 records, proc1: 1 file/4 records), agree on the padded batch

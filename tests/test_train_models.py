@@ -60,6 +60,7 @@ def test_bert_tensor_parallel(devices):
 
 
 @pytest.mark.slow
+@pytest.mark.slowest
 def test_inception_trains(devices):
     cfg = load_config(base={
         "name": "inception-tiny",
