@@ -212,6 +212,12 @@ class DataConfig:
     seq_len: int = 128
     mask_prob: float = 0.15
     vocab_size: int = 30522  # must match ModelConfig.vocab_size
+    # Sequence packing (MLM train path): each batch consumes pack_factor
+    # raw record batches and lays the documents end-to-end with per-row
+    # segment ids (block-diagonal attention, data/text_mlm.pack_documents)
+    # — more useful tokens per step when documents are shorter than
+    # seq_len. 1 = off. Train-only; eval streams stay unpacked.
+    pack_factor: int = 1
     # native C++ record reader (ops/native) when available
     use_native_reader: bool = False
 

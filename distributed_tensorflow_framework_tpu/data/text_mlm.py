@@ -47,6 +47,44 @@ def apply_mlm_mask(tokens: np.ndarray, rng: np.random.Generator,
     return inputs, targets
 
 
+def pack_documents(tokens: np.ndarray, out_rows: int, seq_len: int
+                   ) -> tuple[np.ndarray, np.ndarray, int]:
+    """Greedy in-order first-fit packing of zero-padded token rows.
+
+    ``tokens`` (n, s): one document per row, trailing-zero padded (token 0
+    is [PAD], never interior). Documents are laid end-to-end into
+    ``out_rows`` rows of ``seq_len``; per-row ``segment_ids`` number the
+    documents 1..k (0 = padding) for block-diagonal attention. In-order
+    packing keeps the stream deterministic (resume replays identically);
+    documents that do not fit the row budget are dropped and counted —
+    the caller sizes ``out_rows`` so drops are rare and logs them.
+
+    Returns (packed (out_rows, seq_len), segment_ids, dropped_docs).
+    """
+    packed = np.zeros((out_rows, seq_len), np.int32)
+    segs = np.zeros((out_rows, seq_len), np.int32)
+    row, col, seg = 0, 0, 0
+    dropped = 0
+    for i, doc in enumerate(tokens):
+        length = int(np.count_nonzero(doc))
+        if length == 0:
+            continue
+        if col + length > seq_len:
+            row += 1
+            col = 0
+            seg = 0
+            if row >= out_rows:
+                dropped = sum(
+                    1 for d in tokens[i:] if np.count_nonzero(d)
+                )
+                break
+        packed[row, col:col + length] = doc[:length]
+        seg += 1
+        segs[row, col:col + length] = seg
+        col += length
+    return packed, segs, dropped
+
+
 def make_mlm(config: DataConfig, process_index: int, process_count: int,
              *, train: bool = True) -> HostDataset:
     files = (
@@ -79,6 +117,11 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
             raise ValueError(
                 "use_native_reader has no exact-eval path — use the "
                 "tf.data reader (use_native_reader=false) for evaluation"
+            )
+        if config.pack_factor > 1:
+            raise ValueError(
+                "data.pack_factor>1 (sequence packing) is wired for the "
+                "tf.data MLM path only — set use_native_reader=false"
             )
         return _make_mlm_native(config, files, process_index, process_count)
 
@@ -145,32 +188,73 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
             pad_tail_to=num_batches,
         )
 
+    # Sequence packing (train only): each packed batch consumes
+    # ``pack_factor`` raw record batches, lays the (zero-padded) documents
+    # end-to-end into b rows and emits per-row segment ids for
+    # block-diagonal attention — fewer pad positions per step means more
+    # useful tokens through the same GEMMs (PERF_NOTES.md BERT findings).
+    # Eval streams stay unpacked: the exact-eval contract counts real
+    # masked tokens either way, and unpacked rows keep per-document
+    # metrics comparable across configs.
+    pack = config.pack_factor if train else 1
+
     # Wrap with host-side dynamic masking (rng keyed off batch counter so
     # restores re-create identical masks).
     def make_iter(state):
         base.restore(state.get("inner", base.state()))
-        for batch in base:
+        it = iter(base)
+        while True:
+            if pack > 1:
+                raws = []
+                for _ in range(pack):
+                    try:
+                        raws.append(next(it)["tokens"])
+                    except StopIteration:
+                        break
+                if not raws:
+                    return
+                tokens, seg_ids, dropped = pack_documents(
+                    np.concatenate(raws, axis=0), b, s)
+                if dropped:
+                    state["dropped_docs"] = (
+                        state.get("dropped_docs", 0) + dropped)
+                    log.warning(
+                        "sequence packing dropped %d docs this batch "
+                        "(%d total) — lower data.pack_factor",
+                        dropped, state["dropped_docs"])
+            else:
+                try:
+                    tokens = next(it)["tokens"]
+                except StopIteration:
+                    return
+                seg_ids = None
             state["inner"] = base.state()
             rng = prng.host_rng(
                 config.seed, prng.ROLE_MASK,
                 state["inner"].get("batches", 0), process_index,
             )
-            inputs, targets = apply_mlm_mask(batch["tokens"], rng,
+            inputs, targets = apply_mlm_mask(tokens, rng,
                                              config.mask_prob,
                                              config.vocab_size)
-            yield {
+            out = {
                 "input_ids": inputs,
                 "targets": targets,
-                "attention_mask": (batch["tokens"] != 0).astype(np.int32),
+                "attention_mask": (tokens != 0).astype(np.int32),
             }
+            if seg_ids is not None:
+                out["segment_ids"] = seg_ids
+            yield out
 
+    element_spec = {
+        "input_ids": ((b, s), np.int32),
+        "targets": ((b, s), np.int32),
+        "attention_mask": ((b, s), np.int32),
+    }
+    if pack > 1:
+        element_spec["segment_ids"] = ((b, s), np.int32)
     return HostDataset(
         make_iter,
-        element_spec={
-            "input_ids": ((b, s), np.int32),
-            "targets": ((b, s), np.int32),
-            "attention_mask": ((b, s), np.int32),
-        },
+        element_spec=element_spec,
         initial_state={"inner": base.state()},
         cardinality=num_batches,
     )

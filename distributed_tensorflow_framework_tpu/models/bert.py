@@ -29,13 +29,25 @@ import jax.numpy as jnp
 from distributed_tensorflow_framework_tpu.models.layers import dense_kernel_init
 
 
-def dot_product_attention(q, k, v, *, mask=None, dtype=jnp.float32):
-    """Reference XLA attention. q,k,v: (B, S, H, D); mask: (B, 1, 1, S)."""
+def dot_product_attention(q, k, v, *, mask=None, segment_ids=None,
+                          dtype=jnp.float32):
+    """Reference XLA attention. q,k,v: (B, S, H, D); mask: (B, 1, 1, S) or
+    any shape broadcastable to (B, H, Sq, Sk); segment_ids: (B, S) packed-
+    sequence ids (attend only within equal ids) or None."""
     d = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    # Mask in f32: f32-min rounds to -inf in bf16, and a fully-masked row
+    # (a padding query under packing) would then softmax to NaN
+    # (max=-inf → -inf-(-inf)); in f32 the min is finite so the row
+    # degrades to a harmless uniform distribution instead.
+    scores = scores.astype(jnp.float32)
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    if segment_ids is not None:
+        seg_mask = (segment_ids[:, None, :, None]
+                    == segment_ids[:, None, None, :])
+        scores = jnp.where(seg_mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -46,7 +58,7 @@ class MultiHeadAttention(nn.Module):
     mesh: Any = None  # required for attention_impl="ring"
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, segment_ids=None):
         b, s, h = x.shape
         head_dim = h // self.num_heads
         dense = lambda name: nn.Dense(  # noqa: E731
@@ -62,15 +74,19 @@ class MultiHeadAttention(nn.Module):
                 flash_attention,
             )
 
-            out = flash_attention(q, k, v, mask=mask)
+            out = flash_attention(q, k, v, mask=mask,
+                                  segment_ids=segment_ids)
         elif self.attention_impl == "ring":
             from distributed_tensorflow_framework_tpu.parallel.ring import (
                 ring_attention_sharded,
             )
 
-            out = ring_attention_sharded(q, k, v, mesh=self.mesh, mask=mask)
+            out = ring_attention_sharded(q, k, v, mesh=self.mesh, mask=mask,
+                                         segment_ids=segment_ids)
         else:
-            out = dot_product_attention(q, k, v, mask=mask, dtype=self.dtype)
+            out = dot_product_attention(q, k, v, mask=mask,
+                                        segment_ids=segment_ids,
+                                        dtype=self.dtype)
         out = out.reshape(b, s, h)
         return nn.Dense(h, dtype=self.dtype, param_dtype=jnp.float32,
                         kernel_init=dense_kernel_init, name="attn_out")(out)
@@ -89,13 +105,13 @@ class EncoderLayer(nn.Module):
     capacity_factor: float = 1.25
 
     @nn.compact
-    def __call__(self, x, mask=None, train: bool = True):
+    def __call__(self, x, mask=None, train: bool = True, segment_ids=None):
         # NOTE: ``train`` is positional-able (not keyword-only) so nn.remat
         # can mark it static by argnum (BertForMLM.remat).
         attn = MultiHeadAttention(
             self.num_heads, dtype=self.dtype,
             attention_impl=self.attention_impl, mesh=self.mesh, name="attn",
-        )(x, mask)
+        )(x, mask, segment_ids)
         attn = nn.Dropout(self.dropout_rate, deterministic=not train)(attn)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x + attn)
         aux_loss = jnp.zeros((), jnp.float32)
@@ -128,7 +144,7 @@ class BertEmbed(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, input_ids, *, train: bool = True):
+    def __call__(self, input_ids, position_ids=None, *, train: bool = True):
         s = input_ids.shape[1]
         embed = nn.Embed(self.vocab_size, self.hidden_size,
                          param_dtype=jnp.float32, dtype=self.dtype,
@@ -139,7 +155,13 @@ class BertEmbed(nn.Module):
             "pos_embedding", nn.initializers.normal(0.02),
             (self.max_seq_len, self.hidden_size), jnp.float32,
         )
-        x = x + pos[None, :s, :].astype(self.dtype)
+        if position_ids is None:
+            x = x + pos[None, :s, :].astype(self.dtype)
+        else:
+            # Packed rows: per-document positions (reset at each segment
+            # boundary) so packed training sees the same position
+            # distribution as unpacked training/eval.
+            x = x + jnp.take(pos, position_ids, axis=0).astype(self.dtype)
         x = nn.LayerNorm(dtype=jnp.float32, name="embed_ln")(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         return x.astype(self.dtype), embed.embedding
@@ -197,11 +219,25 @@ class BertForMLM(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, input_ids, attention_mask=None, *, train: bool = True):
+    def __call__(self, input_ids, attention_mask=None, segment_ids=None,
+                 *, train: bool = True):
+        position_ids = None
+        if segment_ids is not None:
+            # Positions restart at every segment boundary: each packed
+            # document sees pos_embedding[0..len) exactly as it would
+            # unpacked (index i minus the running start-of-segment index).
+            idx = jnp.arange(segment_ids.shape[1], dtype=jnp.int32)
+            change = jnp.concatenate([
+                jnp.ones_like(segment_ids[:, :1], bool),
+                segment_ids[:, 1:] != segment_ids[:, :-1],
+            ], axis=1)
+            starts = jax.lax.cummax(
+                jnp.where(change, idx[None, :], 0), axis=1)
+            position_ids = idx[None, :] - starts
         x, emb_table = BertEmbed(
             self.vocab_size, self.hidden_size, self.max_seq_len,
             self.dropout_rate, self.dtype, name="embed_block",
-        )(input_ids, train=train)
+        )(input_ids, position_ids, train=train)
 
         mask = None
         if attention_mask is not None:
@@ -228,7 +264,7 @@ class BertForMLM(nn.Module):
                 expert_topk=self.expert_topk,
                 capacity_factor=self.capacity_factor,
                 name=f"layer{i}",
-            )(x, mask, train)
+            )(x, mask, train, segment_ids)
             if use_moe:
                 aux_total = aux_total + aux
                 n_moe += 1

@@ -35,8 +35,14 @@ BLOCK_K = 128
 MAX_SEQ_VMEM = 4096
 
 
-def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                     *, scale: float):
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
+                     scale: float, segmented: bool):
+    # Segment-id refs only exist in the segmented variant — the common
+    # unsegmented path carries no extra operands (and no VMEM traffic).
+    if segmented:
+        qseg_ref, kseg_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     q = q_ref[0, 0].astype(jnp.float32)          # (BQ, D)
     k = k_ref[0, 0].astype(jnp.float32)          # (S, D)
     v = v_ref[0, 0].astype(jnp.float32)          # (S, D)
@@ -45,6 +51,13 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         preferred_element_type=jnp.float32,
     ) * scale                                     # (BQ, S)
     s = s + bias_ref[0]                           # additive mask bias, (1,S)
+    if segmented:
+        # Packed-sequence block-diagonal mask: token i may attend token j
+        # only within the same segment (segment ids ride as f32 so the
+        # custom_vjp stays all-float; equality on small ints is exact).
+        qs = qseg_ref[0, 0]                       # (BQ,)
+        ks = kseg_ref[0, 0]                       # (S,)
+        s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -57,9 +70,13 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     lse_ref[0, 0] = (m + jnp.log(l)).astype(jnp.float32)
 
 
-def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
-                        delta_ref, dq_ref, *, scale: float):
+def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
+                        scale: float, segmented: bool):
     """dQ for one q-block: recompute p from (q, k, lse), no S×S residual."""
+    if segmented:
+        qseg_ref, kseg_ref, do_ref, lse_ref, delta_ref, dq_ref = rest
+    else:
+        do_ref, lse_ref, delta_ref, dq_ref = rest
     q = q_ref[0, 0].astype(jnp.float32)           # (BQ, D)
     k = k_ref[0, 0].astype(jnp.float32)           # (S, D)
     v = v_ref[0, 0].astype(jnp.float32)           # (S, D)
@@ -70,6 +87,10 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale + bias_ref[0]                       # (BQ, S)
+    if segmented:
+        qs = qseg_ref[0, 0]
+        ks = kseg_ref[0, 0]
+        s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
     p = jnp.exp(s - lse)                          # recomputed probabilities
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
@@ -83,10 +104,14 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
-                         delta_ref, dk_ref, dv_ref, dbias_ref,
-                         *, scale: float):
+def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
+                         scale: float, segmented: bool):
     """dK/dV (+ per-head dbias) for one k-block: full Q/dO in VMEM."""
+    if segmented:
+        (qseg_ref, kseg_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dbias_ref) = rest
+    else:
+        do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dbias_ref = rest
     q = q_ref[0, 0].astype(jnp.float32)           # (S, D)
     k = k_ref[0, 0].astype(jnp.float32)           # (BK, D)
     v = v_ref[0, 0].astype(jnp.float32)           # (BK, D)
@@ -97,6 +122,10 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale + bias_ref[0]                       # (S, BK)
+    if segmented:
+        qs = qseg_ref[0, 0]                       # (S,)
+        ks = kseg_ref[0, 0]                       # (BK,)
+        s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
     p = jnp.exp(s - lse)
     dv = jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())),
@@ -136,50 +165,65 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@jax.custom_vjp
-def _fused(q, k, v, bias):
-    o, _ = _flash_fwd(q, k, v, bias, interpret=_interpret())
-    return o
+def _make_fused(segmented: bool, return_lse: bool):
+    """Build the custom-VJP fused attention for one (segmented, lse)
+    variant. Unsegmented signature: (q, k, v, bias) — the common path
+    carries NO segment operands or VMEM traffic. Segmented adds
+    (qseg, kseg): (B,1,Sq)/(B,1,Sk) FLOAT segment ids (all-float
+    custom_vjp; zero cotangents). ``return_lse`` additionally returns the
+    per-row logsumexp — the chunk primitive for ring attention, whose
+    online merge needs lse and therefore flows a cotangent into it.
+    Residuals are all O(S·D)/O(S): no score-matrix-shaped tensor is ever
+    saved.
+    """
+    if segmented:
+        @jax.custom_vjp
+        def fused(q, k, v, bias, qseg, kseg):
+            o, lse = _flash_fwd(q, k, v, bias, qseg, kseg,
+                                segmented=True, interpret=_interpret())
+            return (o, lse) if return_lse else o
+
+        def fwd(q, k, v, bias, qseg, kseg):
+            o, lse = _flash_fwd(q, k, v, bias, qseg, kseg,
+                                segmented=True, interpret=_interpret())
+            out = (o, lse) if return_lse else o
+            return out, (q, k, v, bias, qseg, kseg, o, lse)
+
+        def bwd(res, g):
+            q, k, v, bias, qseg, kseg, o, lse = res
+            do, dlse = g if return_lse else (g, None)
+            dq, dk, dv, dbias = _flash_bwd(
+                q, k, v, bias, qseg, kseg, o, lse, do, dlse=dlse,
+                segmented=True, interpret=_interpret())
+            return (dq, dk, dv, dbias,
+                    jnp.zeros_like(qseg), jnp.zeros_like(kseg))
+    else:
+        @jax.custom_vjp
+        def fused(q, k, v, bias):
+            o, lse = _flash_fwd(q, k, v, bias,
+                                segmented=False, interpret=_interpret())
+            return (o, lse) if return_lse else o
+
+        def fwd(q, k, v, bias):
+            o, lse = _flash_fwd(q, k, v, bias,
+                                segmented=False, interpret=_interpret())
+            out = (o, lse) if return_lse else o
+            return out, (q, k, v, bias, o, lse)
+
+        def bwd(res, g):
+            q, k, v, bias, o, lse = res
+            do, dlse = g if return_lse else (g, None)
+            dq, dk, dv, dbias = _flash_bwd(
+                q, k, v, bias, o, lse, do, dlse=dlse,
+                segmented=False, interpret=_interpret())
+            return dq, dk, dv, dbias
+
+    fused.defvjp(fwd, bwd)
+    return fused
 
 
-def _fused_fwd(q, k, v, bias):
-    o, lse = _flash_fwd(q, k, v, bias, interpret=_interpret())
-    # Residuals are all O(S·D) / O(S): no score-matrix-shaped tensor saved.
-    return o, (q, k, v, bias, o, lse)
-
-
-def _fused_bwd(res, g):
-    q, k, v, bias, o, lse = res
-    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, o, lse, g,
-                                   interpret=_interpret())
-    return dq, dk, dv, dbias
-
-
-_fused.defvjp(_fused_fwd, _fused_bwd)
-
-
-@jax.custom_vjp
-def _fused_lse(q, k, v, bias):
-    """Like ``_fused`` but also returns the per-row logsumexp — the chunk
-    primitive for ring attention, whose online merge needs lse and
-    therefore flows a cotangent into it."""
-    return _flash_fwd(q, k, v, bias, interpret=_interpret())
-
-
-def _fused_lse_fwd(q, k, v, bias):
-    o, lse = _flash_fwd(q, k, v, bias, interpret=_interpret())
-    return (o, lse), (q, k, v, bias, o, lse)
-
-
-def _fused_lse_bwd(res, g):
-    do, dlse = g
-    q, k, v, bias, o, lse = res
-    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, o, lse, do, dlse=dlse,
-                                   interpret=_interpret())
-    return dq, dk, dv, dbias
-
-
-_fused_lse.defvjp(_fused_lse_fwd, _fused_lse_bwd)
+_FUSED = {(seg, lse): _make_fused(seg, lse)
+          for seg in (False, True) for lse in (False, True)}
 
 
 def chunk_supported(s: int) -> bool:
@@ -190,13 +234,22 @@ def chunk_supported(s: int) -> bool:
     return s > 0 and s % min(BLOCK_Q, s) == 0 and s <= MAX_SEQ_VMEM
 
 
-def flash_attention_chunk(q, k, v, bias):
+def _seg_f32(seg):
+    """(B,1,S) f32 view of integer segment ids for the fused kernels
+    (float ids keep the custom_vjp all-float; equality on small ints is
+    exact in f32)."""
+    return seg.astype(jnp.float32)[:, None, :]
+
+
+def flash_attention_chunk(q, k, v, bias, q_seg=None, kv_seg=None):
     """Per-chunk fused attention for the ring: (B,S,H,D) q/k/v (equal-length
     shards) + additive key bias (B, Sk) → (o (B,S,H,D), lse (B,S,H,1)).
 
+    ``q_seg``/``kv_seg`` (B, Sq)/(B, Sk) optional packed-sequence segment
+    ids: tokens attend only within equal ids (block-diagonal mask).
     ``o`` is normalized *within the chunk*; the caller merges chunks with
     the standard logsumexp reweighting (parallel/ring.py). Differentiable
-    in all inputs including through ``lse``.
+    in all float inputs including through ``lse``.
     """
     s_q, s_k = q.shape[1], k.shape[1]
     if s_q != s_k or v.shape[1] != s_k:
@@ -220,40 +273,62 @@ def flash_attention_chunk(q, k, v, bias):
             f"chunk {s_k} > {MAX_SEQ_VMEM} — raise the ring shard count"
         )
     qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-    o, lse = _fused_lse(qt, kt, vt, bias[:, None, :].astype(jnp.float32))
+    bias_f = bias[:, None, :].astype(jnp.float32)
+    if q_seg is None:
+        o, lse = _FUSED[(False, True)](qt, kt, vt, bias_f)
+    else:
+        o, lse = _FUSED[(True, True)](qt, kt, vt, bias_f,
+                                      _seg_f32(q_seg), _seg_f32(kv_seg))
     return o.transpose(0, 2, 1, 3), lse.transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _flash_fwd(q, k, v, bias, *, interpret: bool):
+@functools.partial(jax.jit, static_argnames=("segmented", "interpret"))
+def _flash_fwd(q, k, v, bias, qseg=None, kseg=None, *, segmented: bool,
+               interpret: bool):
     b, h, s, d = q.shape
+    s_k = k.shape[2]
     scale = 1.0 / (d ** 0.5)
     block_q = min(BLOCK_Q, s)
     grid = (b, h, s // block_q)
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, s_k, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        pl.BlockSpec((1, 1, s_k, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        pl.BlockSpec((1, 1, s_k), lambda bi, hi, qi: (bi, 0, 0)),
+    ]
+    operands = [q, k, v, bias]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, s_k), lambda bi, hi, qi: (bi, 0, 0)),
+        ]
+        operands += [qseg, kseg]
     return pl.pallas_call(
-        functools.partial(_attn_fwd_kernel, scale=scale),
+        functools.partial(_attn_fwd_kernel, scale=scale, segmented=segmented),
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s), lambda bi, hi, qi: (bi, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         interpret=interpret,
-    )(q, k, v, bias)
+    )(*operands)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _flash_bwd(q, k, v, bias, o, lse, do, dlse=None, *, interpret: bool):
+@functools.partial(jax.jit, static_argnames=("segmented", "interpret"))
+def _flash_bwd(q, k, v, bias, *seg_then_rest, segmented: bool,
+               interpret: bool, dlse=None):
+    if segmented:
+        qseg, kseg, o, lse, do = seg_then_rest
+    else:
+        qseg = kseg = None
+        o, lse, do = seg_then_rest
     b, h, s, d = q.shape
+    s_k = k.shape[2]
     scale = 1.0 / (d ** 0.5)
     # delta_i = Σ_d dO_i·O_i — the softmax-jacobian row correction; an
     # O(S·D) elementwise+reduce, cheap in plain XLA.
@@ -265,16 +340,24 @@ def _flash_bwd(q, k, v, bias, o, lse, do, dlse=None, *, interpret: bool):
         # kernels run unchanged with delta := delta − dlse.
         delta = delta - dlse.astype(jnp.float32)
 
+    seg_operands = [qseg, kseg] if segmented else []
+
     block_q = min(BLOCK_Q, s)
+    dq_seg_specs = [
+        pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, 0, qi)),
+        pl.BlockSpec((1, 1, s_k), lambda bi, hi, qi: (bi, 0, 0)),
+    ] if segmented else []
     dq = pl.pallas_call(
-        functools.partial(_attn_bwd_dq_kernel, scale=scale),
+        functools.partial(_attn_bwd_dq_kernel, scale=scale,
+                          segmented=segmented),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         grid=(b, h, s // block_q),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s), lambda bi, hi, qi: (bi, 0, 0)),
+            pl.BlockSpec((1, 1, s_k, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s_k, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s_k), lambda bi, hi, qi: (bi, 0, 0)),
+        ] + dq_seg_specs + [
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -283,22 +366,28 @@ def _flash_bwd(q, k, v, bias, o, lse, do, dlse=None, *, interpret: bool):
             (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)
         ),
         interpret=interpret,
-    )(q, k, v, bias, do, lse, delta)
+    )(q, k, v, bias, *seg_operands, do, lse, delta)
 
-    block_k = min(BLOCK_K, s)
+    block_k = min(BLOCK_K, s_k)
+    dkv_seg_specs = [
+        pl.BlockSpec((1, 1, s), lambda bi, hi, ki: (bi, 0, 0)),
+        pl.BlockSpec((1, 1, block_k), lambda bi, hi, ki: (bi, 0, ki)),
+    ] if segmented else []
     dk, dv, dbias_h = pl.pallas_call(
-        functools.partial(_attn_bwd_dkv_kernel, scale=scale),
+        functools.partial(_attn_bwd_dkv_kernel, scale=scale,
+                          segmented=segmented),
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
-            jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s_k, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, s_k), jnp.float32),
         ],
-        grid=(b, h, s // block_k),
+        grid=(b, h, s_k // block_k),
         in_specs=[
             pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, block_k), lambda bi, hi, ki: (bi, 0, ki)),
+        ] + dkv_seg_specs + [
             pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
@@ -309,13 +398,16 @@ def _flash_bwd(q, k, v, bias, o, lse, do, dlse=None, *, interpret: bool):
             pl.BlockSpec((1, 1, 1, block_k), lambda bi, hi, ki: (bi, hi, 0, ki)),
         ],
         interpret=interpret,
-    )(q, k, v, bias, do, lse, delta)
+    )(q, k, v, bias, *seg_operands, do, lse, delta)
     dbias = jnp.sum(dbias_h, axis=1)               # (B, 1, S): Σ over heads
     return dq, dk, dv, dbias
 
 
-def flash_attention(q, k, v, *, mask=None):
-    """Fused attention. q,k,v: (B, S, H, D); mask: (B,1,1,S) bool or None.
+def flash_attention(q, k, v, *, mask=None, segment_ids=None):
+    """Fused attention. q,k,v: (B, S, H, D); mask: (B,1,1,S) bool or None;
+    segment_ids: (B, S) int packed-sequence ids or None — tokens attend
+    only within equal ids (block-diagonal mask computed INSIDE the kernel
+    from O(S) ids, so packing never materializes an S×S mask).
 
     Returns (B, S, H, D) in q's dtype. Differentiable end to end with
     Pallas forward AND backward kernels (module docstring).
@@ -336,5 +428,9 @@ def flash_attention(q, k, v, *, mask=None):
         bias = jnp.where(mask[:, 0, :, :], 0.0, NEG_INF).astype(jnp.float32)
     else:
         bias = jnp.zeros((b, 1, s), jnp.float32)
-    out = _fused(qt, kt, vt, bias)
+    if segment_ids is None:
+        out = _FUSED[(False, False)](qt, kt, vt, bias)
+    else:
+        seg = _seg_f32(segment_ids)
+        out = _FUSED[(True, False)](qt, kt, vt, bias, seg, seg)
     return out.transpose(0, 2, 1, 3)

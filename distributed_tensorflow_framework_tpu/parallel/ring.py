@@ -41,22 +41,28 @@ from distributed_tensorflow_framework_tpu.ops.flash_attention import (
 FLASH_CHUNK_MIN = 2048
 
 
-def _chunk_attention(q, k, v, bias):
+def _chunk_attention(q, k, v, bias, q_seg=None, kv_seg=None):
     """One K/V chunk → (chunk-normalized o (B,Sq,H,D) f32, lse (B,Sq,H,1)).
 
-    Dispatches on the static chunk length: Pallas flash kernel at/above
-    FLASH_CHUNK_MIN (see crossover note above), but ONLY when the chunk
-    fits the kernel's constraints (chunk_supported — the kernel module's
-    own predicate); everything else takes the plain-XLA chain, which
-    handles any shape — so no previously-valid ring config errors out.
+    ``q_seg``/``kv_seg`` (B,Sq)/(B,Sk) optional packed-sequence segment
+    ids (attend only within equal ids). Dispatches on the static chunk
+    length: Pallas flash kernel at/above FLASH_CHUNK_MIN (see crossover
+    note above), but ONLY when the chunk fits the kernel's constraints
+    (chunk_supported — the kernel module's own predicate); everything
+    else takes the plain-XLA chain, which handles any shape — so no
+    previously-valid ring config errors out.
     """
     c = q.shape[1]
     if c >= FLASH_CHUNK_MIN and chunk_supported(c):
-        o, lse = flash_attention_chunk(q, k, v, bias)
+        o, lse = flash_attention_chunk(q, k, v, bias, q_seg, kv_seg)
         return o.astype(jnp.float32), lse
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     s = s + bias[:, None, None, :]
+    if q_seg is not None:
+        s = jnp.where(
+            q_seg[:, None, :, None] == kv_seg[:, None, None, :],
+            s, jnp.finfo(jnp.float32).min)
     m = jnp.max(s, axis=-1, keepdims=True)                   # (B,H,Sq,1)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)                   # (B,H,Sq,1)
@@ -74,41 +80,51 @@ def _merge_chunks(o, lse, o_c, lse_c):
     return o_new, lse_new
 
 
-def ring_attention(q, k, v, bias, *, axis_name: str = "seq"):
+def ring_attention(q, k, v, bias, segment_ids=None, *, axis_name: str = "seq"):
     """Exact attention with K/V rotating around the ring. Per-shard code —
     must run inside shard_map with q,k,v sharded over ``axis_name`` on the
     sequence dim. Shapes per shard: (B, S/n, H, D); ``bias`` is the
-    additive key-mask shard (B, S/n) and rotates with its K/V."""
+    additive key-mask shard (B, S/n) and rotates with its K/V;
+    ``segment_ids`` (B, S/n) optional packed-sequence ids — the K/V-side
+    shard rotates with its chunk while the local shard masks queries, so
+    packing works across ring shard boundaries."""
     n = lax.axis_size(axis_name)
 
-    o0, lse0 = _chunk_attention(q, k, v, bias)
+    seg = segment_ids
+    o0, lse0 = _chunk_attention(q, k, v, bias, seg, seg)
 
     def body(i, carry):
-        o, lse, k_cur, v_cur, b_cur = carry
-        # Rotate K/V (and their mask shard) to the next ring position; the
-        # send overlaps with the local chunk's attention compute below (XLA
-        # schedules the collective-permute concurrently with the
-        # independent kernel call).
+        o, lse, k_cur, v_cur, b_cur, s_cur = carry
+        # Rotate K/V (and their mask/segment shards) to the next ring
+        # position; the send overlaps with the local chunk's attention
+        # compute below (XLA schedules the collective-permute concurrently
+        # with the independent kernel call).
         perm = [(j, (j + 1) % n) for j in range(n)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         b_nxt = lax.ppermute(b_cur, axis_name, perm)
-        o_c, lse_c = _chunk_attention(q, k_nxt, v_nxt, b_nxt)
+        s_nxt = (lax.ppermute(s_cur, axis_name, perm)
+                 if s_cur is not None else None)
+        o_c, lse_c = _chunk_attention(q, k_nxt, v_nxt, b_nxt, seg, s_nxt)
         o, lse = _merge_chunks(o, lse, o_c, lse_c)
-        return o, lse, k_nxt, v_nxt, b_nxt
+        return o, lse, k_nxt, v_nxt, b_nxt, s_nxt
 
     # Static trip count → lowered as scan, so reverse-mode AD flows
     # through the merge (incl. the lse cotangent into the chunk kernel).
-    o, _, _, _, _ = lax.fori_loop(0, n - 1, body, (o0, lse0, k, v, bias))
+    o, _, _, _, _, _ = lax.fori_loop(
+        0, n - 1, body, (o0, lse0, k, v, bias, seg))
     return o.astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, *, mesh, mask=None, axis_name: str = "seq"):
+def ring_attention_sharded(q, k, v, *, mesh, mask=None, segment_ids=None,
+                           axis_name: str = "seq"):
     """jit-level wrapper: shard q,k,v over the seq axis and run the ring.
 
     Usable inside an outer jit (nested shard_map); batch stays sharded over
     the data axes, heads/features replicated across ``seq``. ``mask`` is the
-    (B,1,1,S) bool key mask (as produced by the BERT module) or None.
+    (B,1,1,S) bool key mask (as produced by the BERT module) or None;
+    ``segment_ids`` (B, S) optional packed-sequence ids, sharded over the
+    seq axis like the tokens they describe.
     """
     if mesh is None:
         raise ValueError("ring attention needs the physical mesh "
@@ -124,11 +140,17 @@ def ring_attention_sharded(q, k, v, *, mesh, mask=None, axis_name: str = "seq"):
     data_axes = batch_spec(mesh)[0]  # the canonical batch-sharding axes
     spec = P(data_axes, axis_name, None, None)
     bias_spec = P(data_axes, axis_name)
+    if segment_ids is None:
+        in_specs = (spec, spec, spec, bias_spec)
+        args = (q, k, v, bias)
+    else:
+        in_specs = (spec, spec, spec, bias_spec, bias_spec)
+        args = (q, k, v, bias, segment_ids)
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=axis_name),
         mesh=mesh,
-        in_specs=(spec, spec, spec, bias_spec),
+        in_specs=in_specs,
         out_specs=spec,
         check_vma=False,
     )
-    return fn(q, k, v, bias)
+    return fn(*args)

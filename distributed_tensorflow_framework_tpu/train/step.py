@@ -46,6 +46,11 @@ def task_for_model(name: str) -> str:
 
 def model_inputs(task: str, batch: Any) -> tuple:
     if task == "mlm":
+        if "segment_ids" in batch:
+            # Packed sequences (data.pack_factor>1): block-diagonal
+            # attention over the per-row segment ids.
+            return (batch["input_ids"], batch["attention_mask"],
+                    batch["segment_ids"])
         if "attention_mask" in batch:
             return (batch["input_ids"], batch["attention_mask"])
         return (batch["input_ids"],)
