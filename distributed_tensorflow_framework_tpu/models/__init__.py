@@ -30,13 +30,15 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
     dtype = jnp.dtype(config.dtype)
     name = config.name.lower()
     is_bert = name in ("bert", "bert_base", "bert-base")
-    if config.remat and not (is_bert or name.startswith("resnet")):
+    if config.remat and not (is_bert or name.startswith("resnet")
+                             or name.startswith("inception")):
         # Honest failure beats a silently-ignored knob: activation remat is
-        # wired for the transformer encoder stack (models/bert.py) and the
-        # ResNet residual blocks (models/resnet.py).
+        # wired for the transformer encoder stack (models/bert.py), the
+        # ResNet residual blocks (models/resnet.py) and the Inception
+        # mixed/reduction blocks (models/inception.py).
         raise ValueError(
-            f"model.remat is only supported for the transformer (bert) and "
-            f"resnet models, not {config.name!r}"
+            f"model.remat is only supported for the transformer (bert), "
+            f"resnet and inception models, not {config.name!r}"
         )
     if config.remat and config.pipeline_stages > 1:
         raise ValueError(
@@ -74,6 +76,7 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             num_classes=config.num_classes,
             dtype=dtype,
             bn_axis_name=bn_axis_name,
+            remat=config.remat,
         )
     if is_bert:
         if config.pipeline_stages > 1:

@@ -148,12 +148,20 @@ class InceptionV3(nn.Module):
     num_classes: int = 1000
     aux_head: bool = True
     dropout_rate: float = 0.2
+    # Per-block activation remat (jax.checkpoint via nn.remat on the
+    # mixed/reduction blocks): trades recompute FLOPs for activation
+    # bytes. Replays the same ops but is NOT guaranteed bitwise (XLA may
+    # fuse the wrapped forward differently, ~1e-6/block), and the deep
+    # train-mode BN cascade amplifies that — equivalent training, not
+    # bit-identical trajectories (tests/test_remat.py).
+    remat: bool = False
     dtype: Any = jnp.bfloat16
     bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
         kw = dict(train=train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        ck = nn.remat if self.remat else (lambda cls: cls)
         x = x.astype(self.dtype)
         x = _C(32, (3, 3), strides=(2, 2), padding="VALID", **kw, name="stem1")(x)
         x = _C(32, (3, 3), padding="VALID", **kw, name="stem2")(x)
@@ -163,14 +171,14 @@ class InceptionV3(nn.Module):
         x = _C(192, (3, 3), padding="VALID", **kw, name="stem5")(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
 
-        x = InceptionA(32, **kw, name="mixed1")(x)
-        x = InceptionA(64, **kw, name="mixed2")(x)
-        x = InceptionA(64, **kw, name="mixed3")(x)
-        x = ReductionA(**kw, name="reduce1")(x)
-        x = InceptionB(128, **kw, name="mixed4")(x)
-        x = InceptionB(160, **kw, name="mixed5")(x)
-        x = InceptionB(160, **kw, name="mixed6")(x)
-        x = InceptionB(192, **kw, name="mixed7")(x)
+        x = ck(InceptionA)(32, **kw, name="mixed1")(x)
+        x = ck(InceptionA)(64, **kw, name="mixed2")(x)
+        x = ck(InceptionA)(64, **kw, name="mixed3")(x)
+        x = ck(ReductionA)(**kw, name="reduce1")(x)
+        x = ck(InceptionB)(128, **kw, name="mixed4")(x)
+        x = ck(InceptionB)(160, **kw, name="mixed5")(x)
+        x = ck(InceptionB)(160, **kw, name="mixed6")(x)
+        x = ck(InceptionB)(192, **kw, name="mixed7")(x)
 
         # Built whenever aux_head is on (params must exist at init regardless
         # of mode); returned only in train mode — XLA dead-code-eliminates
@@ -191,9 +199,9 @@ class InceptionV3(nn.Module):
                            kernel_init=dense_kernel_init,
                            name="aux_classifier")(a.astype(jnp.float32))
 
-        x = ReductionB(**kw, name="reduce2")(x)
-        x = InceptionC(**kw, name="mixed8")(x)
-        x = InceptionC(**kw, name="mixed9")(x)
+        x = ck(ReductionB)(**kw, name="reduce2")(x)
+        x = ck(InceptionC)(**kw, name="mixed8")(x)
+        x = ck(InceptionC)(**kw, name="mixed9")(x)
 
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
