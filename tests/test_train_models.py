@@ -76,3 +76,31 @@ def test_inception_trains(devices):
     metrics = t.train()
     assert np.isfinite(metrics["loss"])
     assert "aux_loss" in metrics  # aux head active in training
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("chunk_impl", ["xla", "flash"])
+def test_long_ring_config_recipe_builds_and_steps(devices, monkeypatch,
+                                                  chunk_impl):
+    """configs/bert_long_ring.yaml (the long-context recipe) drives the
+    Trainer end to end when scaled down to CPU-mesh size: ring attention
+    over seq=8 with remat on. The scaled chunk (32) would dispatch to the
+    XLA chain, so the flash variant forces FLASH_CHUNK_MIN=0 to cover the
+    Pallas-kernel branch the real 16k config (chunk 2048) takes."""
+    from distributed_tensorflow_framework_tpu.parallel import ring
+
+    monkeypatch.setattr(
+        ring, "FLASH_CHUNK_MIN", 0 if chunk_impl == "flash" else 10**9)
+    cfg = load_config("configs/bert_long_ring.yaml", overrides=[
+        "mesh.data=1", "mesh.seq=8",
+        "model.vocab_size=512", "model.hidden_size=32",
+        "model.num_layers=2", "model.num_heads=2", "model.mlp_dim=64",
+        "model.max_seq_len=256",
+        "data.vocab_size=512", "data.seq_len=256",
+        "data.global_batch_size=4",
+        "train.total_steps=4", "train.log_interval=2",
+        "checkpoint.directory=",
+    ])
+    t = Trainer(cfg)
+    metrics = t.train()
+    assert np.isfinite(metrics["loss"])
