@@ -6,14 +6,81 @@ Inception-v3 (the reference's async-PS workload, here sync replicas), and
 BERT-base MLM (the new-build transformer workload).
 
 ``get_model(config)`` is the registry — the analogue of the reference's
-model-name flag dispatch.
+model-name flag dispatch. The reference is a framework TEMPLATE whose
+extension point is "user plugs in a model build function" (SURVEY.md §1
+L4); ``register_model`` is that extension point here: a user package
+registers a builder under a name and every runtime feature (Trainer,
+sharding rules, checkpointing, eval) works unchanged.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable
 
 from distributed_tensorflow_framework_tpu.core.config import ModelConfig
+
+# name → (builder(config, bn_axis_name=..., mesh=...) -> module, task).
+_CUSTOM_MODELS: dict[str, tuple[Callable[..., Any], str]] = {}
+
+
+def _is_builtin_model_name(name: str) -> bool:
+    """Name-pattern twin of get_model's built-in dispatch below — keep the
+    two in sync when adding a model family. The whole resnet-N pattern is
+    reserved (including depths that don't exist yet)."""
+    import re
+
+    return (
+        name in ("lenet", "lenet5", "lenet-5",
+                 "bert", "bert_base", "bert-base",
+                 "inception_v3", "inception-v3", "inceptionv3")
+        or re.fullmatch(r"resnet-?(\d+)(_cifar|-cifar)?", name) is not None
+    )
+
+
+def register_model(name: str, *, task: str = "classification"):
+    """Register a user model builder under ``model.name`` (decorator).
+
+    The builder receives the full ModelConfig plus the same keyword
+    context the built-ins get (``bn_axis_name``, ``mesh``) and returns a
+    Flax module. The module's ``__call__`` MUST accept a ``train``
+    keyword (the Trainer calls ``init(..., train=False)`` and
+    ``apply(..., train=True, rngs={"dropout": ...})``) and its positional
+    inputs must match ``task``: "classification" (images → logits) or
+    "mlm" ((ids, mask[, segment_ids]) → logits) — the task picks the
+    loss and batch wiring (train/step.py). The builder owns the
+    interpretation of every other ModelConfig knob (e.g. ``remat``).
+    Built-in names cannot be shadowed, and duplicate registrations fail
+    loudly.
+
+        @register_model("my_net")
+        def build(config, *, bn_axis_name=None, mesh=None):
+            return MyNet(num_classes=config.num_classes)
+
+        class MyNet(nn.Module):
+            num_classes: int
+            @nn.compact
+            def __call__(self, x, *, train: bool = True):
+                ...
+    """
+    key = name.lower()
+    if task not in ("classification", "mlm"):
+        raise ValueError(f"unknown task {task!r} for model {name!r}")
+
+    def deco(builder):
+        if key in _CUSTOM_MODELS:
+            raise ValueError(f"model {name!r} already registered")
+        if _is_builtin_model_name(key):
+            raise ValueError(f"model {name!r} shadows a built-in")
+        _CUSTOM_MODELS[key] = (builder, task)
+        return builder
+
+    return deco
+
+
+def custom_model_task(name: str) -> str | None:
+    """Task family of a registered custom model, or None if not custom."""
+    entry = _CUSTOM_MODELS.get(name.lower())
+    return entry[1] if entry else None
 
 
 def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
@@ -29,6 +96,9 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
 
     dtype = jnp.dtype(config.dtype)
     name = config.name.lower()
+    if name in _CUSTOM_MODELS:
+        return _CUSTOM_MODELS[name][0](
+            config, bn_axis_name=bn_axis_name, mesh=mesh)
     is_bert = name in ("bert", "bert_base", "bert-base")
     if config.remat and not (is_bert or name.startswith("resnet")
                              or name.startswith("inception")):

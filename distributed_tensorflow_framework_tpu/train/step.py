@@ -41,6 +41,11 @@ DATA_AXES = ("data", "fsdp")
 
 
 def task_for_model(name: str) -> str:
+    from distributed_tensorflow_framework_tpu.models import custom_model_task
+
+    custom = custom_model_task(name)
+    if custom is not None:
+        return custom
     return "mlm" if "bert" in name.lower() else "classification"
 
 
