@@ -76,6 +76,10 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list[int]:
+        """Steps with a complete saved checkpoint (post max_to_keep GC)."""
+        return list(self._mgr.all_steps())
+
     def restore(self, template: TrainState, *,
                 dataset: HostDataset | None = None,
                 step: int | None = None) -> TrainState | None:

@@ -229,6 +229,11 @@ class CheckpointConfig:
     max_to_keep: int = 3
     async_save: bool = True
     restore: bool = True  # auto-restore latest on startup (MonitoredTrainingSession contract)
+    # Restore a SPECIFIC saved step instead of the latest (-1 = latest) —
+    # the Saver's restore-any-checkpoint capability, e.g. to branch an
+    # experiment off an earlier snapshot. Fails loudly if the step was
+    # never saved (or was GC'd by max_to_keep).
+    restore_step: int = -1
 
 
 @config_dataclass

@@ -76,6 +76,17 @@ class Trainer:
             self._ensure_eval()
         # Checkpoint manager + auto-restore (MonitoredTrainingSession
         # contract: restore latest from checkpoint_dir if present).
+        if self.config.checkpoint.restore_step >= 0 and not (
+                self.config.checkpoint.directory
+                and self.config.checkpoint.restore):
+            # The knob's contract is fail-loudly; silently starting from
+            # scratch because restore is off would be the exact fallback
+            # it exists to prevent.
+            raise ValueError(
+                "checkpoint.restore_step set but restoring is disabled — "
+                "need checkpoint.directory non-empty and "
+                "checkpoint.restore=true"
+            )
         if self.config.checkpoint.directory:
             from distributed_tensorflow_framework_tpu.ckpt import CheckpointManager
 
@@ -83,7 +94,19 @@ class Trainer:
                 self.config.checkpoint, is_chief=self.runtime.is_chief
             )
             if self.config.checkpoint.restore:
-                restored = self._ckpt_manager.restore(self.state, dataset=self.dataset)
+                want = self.config.checkpoint.restore_step
+                if want >= 0 and want not in self._ckpt_manager.all_steps():
+                    # Saver contract: asking for a specific snapshot that
+                    # does not exist (never saved, or GC'd by max_to_keep)
+                    # must fail loudly, not fall back to latest.
+                    raise ValueError(
+                        f"checkpoint.restore_step={want} not found in "
+                        f"{self.config.checkpoint.directory!r} (available: "
+                        f"{sorted(self._ckpt_manager.all_steps())})"
+                    )
+                restored = self._ckpt_manager.restore(
+                    self.state, dataset=self.dataset,
+                    step=want if want >= 0 else None)
                 if restored is not None:
                     self.state = restored
                     self.host_step = int(jax.device_get(self.state.step))
@@ -137,6 +160,22 @@ class Trainer:
     def train(self, hooks: list | None = None) -> dict[str, float]:
         if self.state is None:
             self.build()
+        ck = self.config.checkpoint
+        if (self._ckpt_manager is not None and ck.restore_step >= 0
+                and ck.restore_step < (self._ckpt_manager.latest_step() or 0)):
+            # Saving a branched lineage into a directory that already holds
+            # NEWER steps would silently no-op at every already-saved step
+            # (CheckpointManager.save skips existing steps) and a restart
+            # would re-restore restore_step, losing the branch. Evaluating
+            # an old snapshot (--eval-only) is fine; branched TRAINING
+            # needs a fresh directory.
+            raise ValueError(
+                f"checkpoint.restore_step={ck.restore_step} is older than "
+                f"the directory's latest step "
+                f"({self._ckpt_manager.latest_step()}) — training would "
+                f"interleave two lineages. Copy the checkpoint into a "
+                f"fresh checkpoint.directory to branch, or use --eval-only."
+            )
         cfg = self.config.train
         hooks = self.default_hooks() if hooks is None else hooks
         for h in hooks:

@@ -47,3 +47,51 @@ def test_resume_exactness(devices, tmp_path):
 
     for a, b in zip(jax.tree.leaves(full_params), jax.tree.leaves(resumed_params)):
         np.testing.assert_array_equal(a, b)
+
+
+def test_restore_specific_step(devices, tmp_path):
+    """checkpoint.restore_step pins an EARLIER snapshot (the Saver's
+    restore-any-checkpoint capability): latest is 6 but the run restores
+    3 (the eval-old-snapshot use). Guard rails: a missing step fails
+    loudly instead of falling back; TRAINING on an older restore in a
+    directory holding newer steps refuses (two lineages would
+    interleave); restore_step with restoring disabled refuses."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    base = {"train.total_steps": 6, "train.log_interval": 3}
+    cfg = lenet_config(**base)
+    cfg.checkpoint.directory = ckpt_dir
+    cfg.checkpoint.save_interval_steps = 3
+    cfg.checkpoint.async_save = False
+    t = Trainer(cfg)
+    t.train()
+    assert sorted(t._ckpt_manager.all_steps()) == [3, 6]
+
+    cfg_b = lenet_config(**base)
+    cfg_b.checkpoint.directory = ckpt_dir
+    cfg_b.checkpoint.restore_step = 3
+    cfg_b.checkpoint.async_save = False
+    t_b = Trainer(cfg_b)
+    t_b.build()
+    assert t_b.host_step == 3  # pinned at 3, not latest (6)
+    # Pinned params differ from the final ones (training moved them).
+    moved = any(
+        not np.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(jax.device_get(t_b.state.params)),
+                        jax.tree.leaves(jax.device_get(t.state.params))))
+    assert moved
+    # Branch-TRAINING into the same directory must refuse.
+    with pytest.raises(ValueError, match="fresh checkpoint.directory"):
+        t_b.train()
+
+    cfg_c = lenet_config(**base)
+    cfg_c.checkpoint.directory = ckpt_dir
+    cfg_c.checkpoint.restore_step = 5  # never saved
+    t_c = Trainer(cfg_c)
+    with pytest.raises(ValueError, match="restore_step=5"):
+        t_c.build()
+
+    cfg_d = lenet_config(**base)
+    cfg_d.checkpoint.restore_step = 3  # no directory -> silent-start guard
+    t_d = Trainer(cfg_d)
+    with pytest.raises(ValueError, match="restoring is disabled"):
+        t_d.build()
