@@ -135,6 +135,14 @@ class MoEMlp(nn.Module):
         dispatch, combine, aux_loss = topk_dispatch(
             gate_logits, self.topk, capacity
         )
+        # Router overflow diagnostic: fraction of the B·S·topk assignments
+        # dropped by the static capacity. Sown (not returned) so the layer
+        # signature stays stable; retrieve with
+        # ``apply(..., mutable=["intermediates"])`` when debugging a
+        # capacity_factor choice — persistently high drop means the gate
+        # is imbalanced or cf is too tight.
+        self.sow("intermediates", "moe_drop_frac",
+                 1.0 - dispatch.sum() / (b * s * self.topk))
 
         wi = self.param("wi", expert_kernel_init, (e, h, self.mlp_dim),
                         jnp.float32)

@@ -41,8 +41,13 @@ def _write(path: str, seqs: np.ndarray) -> None:
             })).SerializeToString())
 
 
-def make_split(rng: np.random.Generator, n: int, seq_len: int) -> np.ndarray:
-    base = rng.integers(0, BAND, n)
+def make_split(rng: np.random.Generator, n: int, seq_len: int,
+               *, parity: int) -> np.ndarray:
+    """Sequences whose (base mod 2) == parity. Train takes parity 0 and
+    eval parity 1, so the splits are DISJOINT sequence sets: a model can
+    only score on eval by generalizing the stride grammar, never by
+    memorizing training sequences."""
+    base = rng.integers(0, BAND // 2, n) * 2 + parity
     stride = rng.integers(1, 4, n)
     idx = np.arange(seq_len)
     toks = (base[:, None] + idx[None, :] * stride[:, None]) % BAND + BAND_LO
@@ -60,11 +65,12 @@ def main() -> int:
     a = p.parse_args()
 
     rng = np.random.default_rng(a.seed)
-    for split, n, shards in (("train", a.train_seqs, a.shards),
-                             ("eval", a.eval_seqs, max(1, a.shards // 2))):
+    for split, n, shards, parity in (
+            ("train", a.train_seqs, a.shards, 0),
+            ("eval", a.eval_seqs, max(1, a.shards // 2), 1)):
         d = os.path.join(a.out, split)
         os.makedirs(d, exist_ok=True)
-        seqs = make_split(rng, n, a.seq_len)
+        seqs = make_split(rng, n, a.seq_len, parity=parity)
         for s, part in enumerate(np.array_split(seqs, shards)):
             _write(os.path.join(d, f"mlm-{s:03d}.tfrecord"), part)
         print(f"wrote {n} seqs (len {a.seq_len}) into {shards} shards "

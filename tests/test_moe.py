@@ -135,3 +135,30 @@ def test_topk_exceeding_experts_rejected():
     logits = jnp.zeros((1, 4, 2), jnp.float32)
     with pytest.raises(ValueError, match="num_experts"):
         topk_dispatch(logits, topk=3, capacity=4)
+
+
+def test_drop_frac_diagnostic(devices):
+    """The sown router-overflow diagnostic: zero drops at generous
+    capacity, positive at a starved one, retrievable via mutable
+    intermediates (and absent from a plain apply)."""
+    from distributed_tensorflow_framework_tpu.models.moe import MoEMlp
+
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((2, 16, 8)), jnp.float32)
+
+    def drop_frac(capacity_factor):
+        m = MoEMlp(num_experts=4, mlp_dim=16, topk=1,
+                   capacity_factor=capacity_factor, dtype=jnp.float32)
+        vs = m.init(jax.random.key(0), x)
+        (out, aux), inter = m.apply(
+            vs, x, mutable=["intermediates"])
+        leaves = jax.tree.leaves(inter["intermediates"])
+        assert len(leaves) == 1
+        # Plain apply keeps the stable two-tuple return — the sow never
+        # leaks into the call signature.
+        out2, aux2 = m.apply(vs, x)
+        assert out2.shape == out.shape
+        return float(leaves[0])
+
+    assert drop_frac(4.0) == 0.0          # room for every token
+    assert drop_frac(0.25) > 0.2          # starved capacity drops plenty
