@@ -348,12 +348,29 @@ def _make_imagenet_native_eval(config: DataConfig, files: list[str],
             "weight": np.zeros((b,), np.float32),
         }
 
+    total_records = count_records_native(host_files)
+
     def make_iter(state):
         state.setdefault("batches", 0)
+        # The record count rides in the snapshot so a resume can detect a
+        # shard set that changed SINCE the checkpoint — a re-derived
+        # count can't (skip_records is short on EOF by definition, so
+        # comparing against the current files is a tautology). Mirrors
+        # the train path's loud failure (ADVICE r3).
+        state.setdefault("records", total_records)
+        if state["records"] != total_records:
+            raise RuntimeError(
+                f"eval resume snapshot was taken over {state['records']} "
+                f"records but this host's shard now holds "
+                f"{total_records} — the shard set changed since the "
+                f"checkpoint was taken"
+            )
         skip = state["batches"]
         reader = NativeRecordReader(host_files)
-        # Mid-pass resume: re-skip the consumed records (short skip just
-        # means the restore point was already inside the padded tail).
+        # Mid-pass resume: re-skip the consumed records (a short skip is
+        # fine only because the count-match above already proved the
+        # shard set is unchanged — it means the restore point sits in
+        # the padded tail past this shard's real records).
         if skip:
             reader.skip_records(skip * b)
         it = reader.batches_images_eval(b, size, size, mean=mean, std=std)

@@ -35,12 +35,25 @@ _lib = None
 def _build() -> str:
     lib = os.path.abspath(_LIB_CACHE)
     src = os.path.abspath(_SRC)
-    if os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
-        return lib
+    # Cache validity = source CONTENT hash (sidecar file), not mtimes: a
+    # fresh clone gives lib and source the same checkout mtime, so an
+    # mtime gate would silently load a stale committed .so after a source
+    # change (ADVICE r3).
+    import hashlib
+
+    with open(src, "rb") as f:
+        src_hash = hashlib.sha256(f.read()).hexdigest()
+    sidecar = lib + ".sha256"
+    if os.path.exists(lib) and os.path.exists(sidecar):
+        with open(sidecar) as f:
+            if f.read().strip() == src_hash:
+                return lib
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
            src, "-ljpeg", "-o", lib]
     log.info("building native record reader: %s", " ".join(cmd))
     subprocess.run(cmd, check=True, capture_output=True)
+    with open(sidecar, "w") as f:
+        f.write(src_hash + "\n")
     return lib
 
 
@@ -94,6 +107,25 @@ def load_library():
 # dependency and no decode; restores rebuild pipelines so the count per
 # shard set must not be repeated).
 _COUNT_CACHE: dict[tuple[str, ...], int] = {}
+
+
+def _norm_pointers(mean, std, null_f):
+    """Per-channel (mean, std) → C float pointers, or nulls when neither
+    is given. Exactly one of the pair is a caller bug — silently skipping
+    normalization would feed unnormalized pixels downstream (ADVICE r3)."""
+    if (mean is None) != (std is None):
+        raise ValueError(
+            "normalization needs BOTH mean and std (got only "
+            + ("mean" if std is None else "std") + ")"
+        )
+    if mean is None:
+        return None, None, null_f, null_f
+    mean_arr = np.ascontiguousarray(mean, np.float32)
+    std_arr = np.ascontiguousarray(std, np.float32)
+    assert mean_arr.shape == (3,) and std_arr.shape == (3,)
+    return (mean_arr, std_arr,
+            mean_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            std_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
 
 
 def count_records_native(paths: Sequence[str]) -> int:
@@ -194,14 +226,8 @@ class NativeRecordReader:
         lptr = labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
         null_seeds = ctypes.POINTER(ctypes.c_uint64)()
         null_f = ctypes.POINTER(ctypes.c_float)()
-        if mean is not None and std is not None:
-            mean_arr = np.ascontiguousarray(mean, np.float32)
-            std_arr = np.ascontiguousarray(std, np.float32)
-            assert mean_arr.shape == (3,) and std_arr.shape == (3,)
-            mptr = mean_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
-            sptr_std = std_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
-        else:
-            mptr = sptr_std = null_f
+        # keep mean/std arrays referenced while their pointers are in use
+        _mean_arr, _std_arr, mptr, sptr_std = _norm_pointers(mean, std, null_f)
         while True:
             if crop_seeds is not None:
                 seeds = np.ascontiguousarray(next(crop_seeds), np.uint64)
@@ -240,14 +266,8 @@ class NativeRecordReader:
         iptr = images.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
         lptr = labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
         null_f = ctypes.POINTER(ctypes.c_float)()
-        if mean is not None and std is not None:
-            mean_arr = np.ascontiguousarray(mean, np.float32)
-            std_arr = np.ascontiguousarray(std, np.float32)
-            assert mean_arr.shape == (3,) and std_arr.shape == (3,)
-            mptr = mean_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
-            sptr_std = std_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
-        else:
-            mptr = sptr_std = null_f
+        # keep mean/std arrays referenced while their pointers are in use
+        _mean_arr, _std_arr, mptr, sptr_std = _norm_pointers(mean, std, null_f)
         while True:
             rc = self._lib.rr_next_batch_images_eval(
                 self._h, image_key.encode(), label_key.encode(),

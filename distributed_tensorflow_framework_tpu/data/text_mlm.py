@@ -48,7 +48,7 @@ def apply_mlm_mask(tokens: np.ndarray, rng: np.random.Generator,
 
 
 def pack_documents(tokens: np.ndarray, out_rows: int, seq_len: int
-                   ) -> tuple[np.ndarray, np.ndarray, int]:
+                   ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Greedy in-order first-fit packing of zero-padded token rows.
 
     ``tokens`` (n, s): one document per row, trailing-zero padded (token 0
@@ -56,15 +56,17 @@ def pack_documents(tokens: np.ndarray, out_rows: int, seq_len: int
     ``out_rows`` rows of ``seq_len``; per-row ``segment_ids`` number the
     documents 1..k (0 = padding) for block-diagonal attention. In-order
     packing keeps the stream deterministic (resume replays identically);
-    documents that do not fit the row budget are dropped and counted —
-    the caller sizes ``out_rows`` so drops are rare and logs them.
+    documents that do not fit the row budget are RETURNED as the leftover
+    suffix — the caller carries them into the next packed batch so
+    pack_factor overflow defers data instead of discarding it (ADVICE r3).
 
-    Returns (packed (out_rows, seq_len), segment_ids, dropped_docs).
+    Returns (packed (out_rows, seq_len), segment_ids,
+    leftover (m, s) — the non-empty rows that did not fit, in order).
     """
     packed = np.zeros((out_rows, seq_len), np.int32)
     segs = np.zeros((out_rows, seq_len), np.int32)
     row, col, seg = 0, 0, 0
-    dropped = 0
+    leftover = tokens[:0]
     for i, doc in enumerate(tokens):
         length = int(np.count_nonzero(doc))
         if length == 0:
@@ -74,15 +76,14 @@ def pack_documents(tokens: np.ndarray, out_rows: int, seq_len: int
             col = 0
             seg = 0
             if row >= out_rows:
-                dropped = sum(
-                    1 for d in tokens[i:] if np.count_nonzero(d)
-                )
+                rest = tokens[i:]
+                leftover = rest[np.count_nonzero(rest, axis=1) > 0]
                 break
         packed[row, col:col + length] = doc[:length]
         seg += 1
         segs[row, col:col + length] = seg
         col += length
-    return packed, segs, dropped
+    return packed, segs, leftover
 
 
 def make_mlm(config: DataConfig, process_index: int, process_count: int,
@@ -205,23 +206,46 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
         it = iter(base)
         while True:
             if pack > 1:
+                # Leftover documents from the previous pack group ride in
+                # the (JSON-serializable) state so overflow DEFERS data to
+                # the next batch instead of discarding it, and restores
+                # replay identically (ADVICE r3).
                 raws = []
-                for _ in range(pack):
+                carry = state.get("carry")
+                if carry:
+                    # Stored trimmed to each doc's nonzero prefix (token 0
+                    # is reserved padding) so snapshots stay small.
+                    arr = np.zeros((len(carry), s), np.int32)
+                    for j, doc in enumerate(carry):
+                        arr[j, :len(doc)] = doc
+                    raws.append(arr)
+                # Throttle fresh intake by the backlog (in raw-batch
+                # units) so a too-high pack_factor DRAINS the carry
+                # instead of growing it without bound: the packer only
+                # absorbs ~b rows per step, so keep (carry + fresh)
+                # around pack batches total.
+                n_fresh = max(0, pack - (len(carry) if carry else 0) // b)
+                exhausted = False
+                for _ in range(n_fresh):
                     try:
                         raws.append(next(it)["tokens"])
                     except StopIteration:
+                        exhausted = True
                         break
-                if not raws:
+                if not raws or sum(len(r) for r in raws) == 0:
                     return
-                tokens, seg_ids, dropped = pack_documents(
+                tokens, seg_ids, leftover = pack_documents(
                     np.concatenate(raws, axis=0), b, s)
-                if dropped:
-                    state["dropped_docs"] = (
-                        state.get("dropped_docs", 0) + dropped)
+                state["carry"] = [
+                    doc[:int(np.count_nonzero(doc))].tolist()
+                    for doc in leftover
+                ]
+                if n_fresh == 0 and not exhausted:
                     log.warning(
-                        "sequence packing dropped %d docs this batch "
-                        "(%d total) — lower data.pack_factor",
-                        dropped, state["dropped_docs"])
+                        "sequence packing backlog: %d carried docs — "
+                        "pack_factor=%d overflows the row budget; this "
+                        "batch packs from the carry alone (consider "
+                        "lowering data.pack_factor)", len(leftover), pack)
             else:
                 try:
                     tokens = next(it)["tokens"]
@@ -229,9 +253,15 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
                     return
                 seg_ids = None
             state["inner"] = base.state()
+            # Mask key from the EMITTED-batch counter, not the consumed
+            # raw-batch count: a packed batch that drains the carry alone
+            # consumes zero raw batches, and keying off the inner counter
+            # would replay the previous batch's mask positions verbatim.
+            emitted = state.get(
+                "emitted", state["inner"].get("batches", 0))
+            state["emitted"] = emitted + 1
             rng = prng.host_rng(
-                config.seed, prng.ROLE_MASK,
-                state["inner"].get("batches", 0), process_index,
+                config.seed, prng.ROLE_MASK, emitted, process_index,
             )
             inputs, targets = apply_mlm_mask(tokens, rng,
                                              config.mask_prob,

