@@ -171,6 +171,9 @@ class ModelConfig:
     moe_every: int = 2
     expert_topk: int = 2
     capacity_factor: float = 1.25
+    # "sorted" (argsort+gather dispatch, O(B·E·C) tables — the scalable
+    # default) or "dense" (one-hot einsum dispatch, the parity reference).
+    moe_dispatch: str = "sorted"
     # Pipeline parallelism (parallel/pipeline.py): >1 splits the encoder
     # stack into this many stages over the `pipe` mesh axis (must equal the
     # mesh's pipe size) with microbatched GPipe scheduling.

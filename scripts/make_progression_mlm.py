@@ -42,16 +42,25 @@ def _write(path: str, seqs: np.ndarray) -> None:
 
 
 def make_split(rng: np.random.Generator, n: int, seq_len: int,
-               *, parity: int) -> np.ndarray:
+               *, parity: int, min_doc: int = 0, max_doc: int = 0
+               ) -> np.ndarray:
     """Sequences whose (base mod 2) == parity. Train takes parity 0 and
     eval parity 1, so the splits are DISJOINT sequence sets: a model can
     only score on eval by generalizing the stride grammar, never by
-    memorizing training sequences."""
+    memorizing training sequences.
+
+    With ``min_doc``/``max_doc`` set, each row is a VARIABLE-LENGTH
+    document (trailing-zero padded to ``seq_len``) — the shape the
+    sequence-packing pipeline (data.pack_factor) consumes."""
     base = rng.integers(0, BAND // 2, n) * 2 + parity
     stride = rng.integers(1, 4, n)
     idx = np.arange(seq_len)
     toks = (base[:, None] + idx[None, :] * stride[:, None]) % BAND + BAND_LO
-    return toks.astype(np.int64)
+    toks = toks.astype(np.int64)
+    if max_doc:
+        lengths = rng.integers(min_doc, max_doc + 1, n)
+        toks *= (idx[None, :] < lengths[:, None])
+    return toks
 
 
 def main() -> int:
@@ -62,7 +71,18 @@ def main() -> int:
     p.add_argument("--eval-seqs", type=int, default=1024)
     p.add_argument("--shards", type=int, default=4)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--min-doc", type=int, default=0,
+                   help="variable-length docs: min real tokens per row")
+    p.add_argument("--max-doc", type=int, default=0,
+                   help="variable-length docs: max real tokens per row "
+                        "(0 = full-width rows, no padding)")
     a = p.parse_args()
+    if a.min_doc and not a.max_doc:
+        p.error("--min-doc needs --max-doc (0 disables variable-length "
+                "docs entirely, silently ignoring the floor)")
+    if a.max_doc and not 0 < a.min_doc <= a.max_doc <= a.seq_len:
+        p.error(f"need 0 < min_doc <= max_doc <= seq_len, got "
+                f"{a.min_doc}..{a.max_doc} vs {a.seq_len}")
 
     rng = np.random.default_rng(a.seed)
     for split, n, shards, parity in (
@@ -70,7 +90,8 @@ def main() -> int:
             ("eval", a.eval_seqs, max(1, a.shards // 2), 1)):
         d = os.path.join(a.out, split)
         os.makedirs(d, exist_ok=True)
-        seqs = make_split(rng, n, a.seq_len, parity=parity)
+        seqs = make_split(rng, n, a.seq_len, parity=parity,
+                          min_doc=a.min_doc, max_doc=a.max_doc)
         for s, part in enumerate(np.array_split(seqs, shards)):
             _write(os.path.join(d, f"mlm-{s:03d}.tfrecord"), part)
         print(f"wrote {n} seqs (len {a.seq_len}) into {shards} shards "
