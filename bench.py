@@ -121,6 +121,7 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
                       **(model_overrides or {})},
             "data": {
                 "name": "synthetic_images",
+                "num_classes": 1000,
                 "global_batch_size": batch_size,
                 "image_size": 224,
                 "channels": 3,

@@ -341,4 +341,23 @@ def load_config(
             raise ValueError(f"Override {item!r} must look like key.path=value")
         key, _, raw = item.partition("=")
         _set_by_path(data, key.strip(), _parse_scalar(raw.strip()))
-    return _build(ExperimentConfig, data)
+    cfg = _build(ExperimentConfig, data)
+    # Head-vs-labels cross-check for the built-in classification datasets:
+    # a label outside the head's range turns the loss metric into NaN
+    # through the integer-label CE gather (fill semantics) while grads
+    # stay finite — the NaN guard kills the run without naming the cause.
+    # Only data > model is fatal (a wider head than the label range is
+    # wasteful but valid); eval_data feeds the same head.
+    for role, dc in (("data", cfg.data), ("eval_data", cfg.eval_data)):
+        if dc is None:
+            continue
+        if (dc.name in ("mnist", "cifar10", "imagenet", "synthetic_images")
+                and dc.num_classes > cfg.model.num_classes):
+            raise ValueError(
+                f"{role}.num_classes={dc.num_classes} > "
+                f"model.num_classes={cfg.model.num_classes} for "
+                f"classification dataset {dc.name!r} — out-of-range labels "
+                f"poison the loss metric with NaN; widen the model head or "
+                f"fix {role}.num_classes"
+            )
+    return cfg

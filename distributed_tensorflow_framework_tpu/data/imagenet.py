@@ -96,7 +96,20 @@ def make_imagenet(config: DataConfig, process_index: int, process_count: int,
                 "image/class/label": tf.io.FixedLenFeature([], tf.int64),
             },
         )
-        label = tf.cast(feats["image/class/label"], tf.int32) - 1  # [1,1000]→[0,999]
+        raw_label = tf.cast(feats["image/class/label"], tf.int32)
+        # Out-of-range labels would NaN the loss metric downstream via
+        # the CE gather's fill semantics — name the record problem here
+        # (same guard as the native reader paths).
+        with tf.control_dependencies([
+            tf.debugging.assert_greater_equal(
+                raw_label, 1,
+                message="record label < 1 — records and the 1-based "
+                        "label contract disagree"),
+            tf.debugging.assert_less_equal(
+                raw_label, config.num_classes,
+                message="record label > data.num_classes"),
+        ]):
+            label = raw_label - 1                       # [1,1000]→[0,999]
         image_bytes = feats["image/encoded"]
         if train:
             # Sampled distorted bounding box crop (the Inception-style crop
@@ -289,6 +302,17 @@ def _make_imagenet_native(config: DataConfig, files: list[str],
                                        mean=mean, std=std)
             for i, (images, labels) in enumerate(it, start=skip):
                 state["batch_in_epoch"] = i + 1
+                if (labels.min() < 1
+                        or labels.max() > config.num_classes):
+                    # An out-of-range label would NaN the loss metric via
+                    # the CE gather's fill semantics — name the record
+                    # problem here instead (cheap: b ints per batch).
+                    raise ValueError(
+                        f"record label {int(labels.min())}..."
+                        f"{int(labels.max())} outside [1, "
+                        f"{config.num_classes}] — records and "
+                        f"data.num_classes disagree"
+                    )
                 yield {
                     "image": images.astype(out_dtype, copy=False),
                     "label": labels - 1,  # [1,1000] → [0,999]
@@ -376,6 +400,16 @@ def _make_imagenet_native_eval(config: DataConfig, files: list[str],
         for images, labels, k in it:
             weight = np.zeros((b,), np.float32)
             weight[:k] = 1.0
+            if k and (labels[:k].min() < 1
+                      or labels[:k].max() > config.num_classes):
+                # Same guard as the train reader: an out-of-range label
+                # silently NaNs the eval metric via the CE gather.
+                raise ValueError(
+                    f"eval record label {int(labels[:k].min())}..."
+                    f"{int(labels[:k].max())} outside [1, "
+                    f"{config.num_classes}] — records and "
+                    f"data.num_classes disagree"
+                )
             labels = labels - 1  # [1,1000] → [0,999]
             labels[k:] = 0  # padding: valid class id, weighted out
             state["batches"] += 1

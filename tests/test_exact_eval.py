@@ -143,7 +143,8 @@ def test_native_eval_parity_with_tfdata(tmp_path):
 
     def batches(native: bool):
         cfg = DataConfig(name="imagenet", data_dir=root, global_batch_size=5,
-                         image_size=56, use_native_reader=native, seed=0)
+                         image_size=56, use_native_reader=native, seed=0,
+                         num_classes=1000)  # fixture labels are 1..n ids
         ds = make_imagenet(cfg, 0, 1, train=False)
         out = list(ds)
         return ds, out
@@ -168,14 +169,16 @@ def test_native_eval_parity_with_tfdata(tmp_path):
     # replays batches 2..4 identically.
     ds2 = make_imagenet(
         DataConfig(name="imagenet", data_dir=root, global_batch_size=5,
-                   image_size=56, use_native_reader=True, seed=0),
+                   image_size=56, use_native_reader=True, seed=0,
+                   num_classes=1000),
         0, 1, train=False)
     first = next(ds2)
     np.testing.assert_array_equal(first["label"], nat_batches[0]["label"])
     snap = ds2.state()
     ds3 = make_imagenet(
         DataConfig(name="imagenet", data_dir=root, global_batch_size=5,
-                   image_size=56, use_native_reader=True, seed=0),
+                   image_size=56, use_native_reader=True, seed=0,
+                   num_classes=1000),
         0, 1, train=False)
     ds3.restore(snap)
     for want in nat_batches[1:]:

@@ -48,7 +48,8 @@ def record_dir(tmp_path_factory):
 
 def _cfg(root: str) -> DataConfig:
     return DataConfig(name="imagenet", data_dir=root, global_batch_size=8,
-                      image_size=32, shuffle_buffer=16, seed=7)
+                      image_size=32, shuffle_buffer=16, seed=7,
+                      num_classes=1000)  # fixture labels are 1..n ids
 
 
 def test_tfrecord_decode_augment_batch(record_dir):

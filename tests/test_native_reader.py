@@ -139,7 +139,8 @@ def test_native_imagenet_pipeline_and_resume(image_record_files):
 
     paths, _, _ = image_record_files
     cfg = DataConfig(name="imagenet", data_dir="", global_batch_size=4,
-                     image_size=32, use_native_reader=True, seed=3)
+                     image_size=32, use_native_reader=True, seed=3,
+                     num_classes=1000)  # fixture labels are 1..n ids
     cfg.data_dir = paths[0].rsplit("/", 1)[0]
     ds = make_imagenet(cfg, 0, 1, train=True)
     a0 = next(ds)
