@@ -196,7 +196,10 @@ class DataConfig:
     global_batch_size: int = 64
     image_size: int = 28
     channels: int = 1
-    num_classes: int = 10  # label range (synthetic data / sanity checks)
+    # Label range of the records; must not exceed the model head
+    # (load_config cross-checks, and every reader path validates per
+    # batch). load_config defaults this to 1000 for name="imagenet".
+    num_classes: int = 10
     # Dtype images are fed to the device in. "bfloat16" halves infeed HBM
     # traffic — the ResNet-50 train step is HBM-bandwidth-bound on v5e
     # (~95% of peak BW at bs 256/chip; see bench.py), so this is a real
@@ -341,6 +344,15 @@ def load_config(
             raise ValueError(f"Override {item!r} must look like key.path=value")
         key, _, raw = item.partition("=")
         _set_by_path(data, key.strip(), _parse_scalar(raw.strip()))
+    # ImageNet's label space is 1000 classes; the DataConfig-wide default
+    # of 10 predates the label-range guards and would abort real ImageNet
+    # data on the first record past label 10. Applied on the raw dict so
+    # an explicit num_classes always wins.
+    for section in ("data", "eval_data"):
+        sec = data.get(section)
+        if (isinstance(sec, dict) and sec.get("name") == "imagenet"
+                and "num_classes" not in sec):
+            sec["num_classes"] = 1000
     cfg = _build(ExperimentConfig, data)
     # Head-vs-labels cross-check for the built-in classification datasets:
     # a label outside the head's range turns the loss metric into NaN

@@ -31,3 +31,37 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+def write_imagenet_records(root, *, split="train", counts=(8, 8),
+                           size=(64, 48), label_fn=None):
+    """The ONE fabricated ImageNet-layout TFRecord writer for the suite
+    (JPEG bytes + 1-based labels; shard naming `<split>-NNNNN-of-NNNNN`).
+    ``counts`` gives records per shard file; ``label_fn`` maps the global
+    1-based record counter to a label (default: identity-ish n%1000+1).
+    Previously three near-identical writers had drifted across test
+    files — record-format changes now have a single home."""
+    import os
+
+    import numpy as np
+    import tensorflow as tf
+
+    label_fn = label_fn or (lambda n: (n % 1000) + 1)
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(0)
+    n = 0
+    files = len(counts)
+    for f, per_file in enumerate(counts):
+        path = os.path.join(str(root), f"{split}-{f:05d}-of-{files:05d}")
+        with tf.io.TFRecordWriter(path) as w:
+            for _ in range(per_file):
+                img = rng.integers(0, 255, (*size, 3), dtype=np.uint8)
+                encoded = tf.io.encode_jpeg(img).numpy()
+                n += 1
+                ex = tf.train.Example(features=tf.train.Features(feature={
+                    "image/encoded": tf.train.Feature(
+                        bytes_list=tf.train.BytesList(value=[encoded])),
+                    "image/class/label": tf.train.Feature(
+                        int64_list=tf.train.Int64List(value=[label_fn(n)])),
+                }))
+                w.write(ex.SerializeToString())

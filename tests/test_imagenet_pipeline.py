@@ -17,32 +17,14 @@ from distributed_tensorflow_framework_tpu.core.config import DataConfig  # noqa:
 from distributed_tensorflow_framework_tpu.data.imagenet import make_imagenet  # noqa: E402
 
 
-def _write_records(root: str, *, split: str = "train", files: int = 2,
-                   per_file: int = 8) -> None:
-    os.makedirs(root, exist_ok=True)
-    rng = np.random.default_rng(0)
-    n = 0
-    for f in range(files):
-        path = os.path.join(root, f"{split}-{f:05d}-of-{files:05d}")
-        with tf.io.TFRecordWriter(path) as w:
-            for _ in range(per_file):
-                img = rng.integers(0, 255, (64, 48, 3), dtype=np.uint8)
-                encoded = tf.io.encode_jpeg(img).numpy()
-                n += 1
-                ex = tf.train.Example(features=tf.train.Features(feature={
-                    "image/encoded": tf.train.Feature(
-                        bytes_list=tf.train.BytesList(value=[encoded])),
-                    "image/class/label": tf.train.Feature(
-                        int64_list=tf.train.Int64List(value=[(n % 1000) + 1])),
-                }))
-                w.write(ex.SerializeToString())
+from conftest import write_imagenet_records  # noqa: E402
 
 
 @pytest.fixture(scope="module")
 def record_dir(tmp_path_factory):
     root = str(tmp_path_factory.mktemp("imagenet"))
-    _write_records(root, split="train")
-    _write_records(root, split="validation")
+    write_imagenet_records(root, split="train")
+    write_imagenet_records(root, split="validation")
     return root
 
 

@@ -64,33 +64,6 @@ def _step_metrics(log: str, step: int) -> str:
     return " ".join(m.groups())
 
 
-def _write_imagenet_tree(root, *, files=4, per_file=16, size=(48, 40)):
-    """Fabricated multi-shard ImageNet-layout TFRecord tree (JPEG bytes +
-    1-based labels) — enough shards that every process gets its own
-    file subset (data/imagenet.py shards files per process)."""
-    import numpy as np
-    import tensorflow as tf
-
-    os.makedirs(root, exist_ok=True)
-    rng = np.random.default_rng(0)
-    n = 0
-    for f in range(files):
-        path = os.path.join(root, f"train-{f:05d}-of-{files:05d}")
-        with tf.io.TFRecordWriter(path) as w:
-            for _ in range(per_file):
-                img = rng.integers(0, 255, (*size, 3), dtype=np.uint8)
-                encoded = tf.io.encode_jpeg(img).numpy()
-                n += 1
-                ex = tf.train.Example(features=tf.train.Features(feature={
-                    "image/encoded": tf.train.Feature(
-                        bytes_list=tf.train.BytesList(value=[encoded])),
-                    "image/class/label": tf.train.Feature(
-                        int64_list=tf.train.Int64List(
-                            value=[(n % 100) + 1])),
-                }))
-                w.write(ex.SerializeToString())
-
-
 @pytest.mark.slowest
 def test_two_process_native_input_ckpt_resume(tmp_path):
     """The north-star deployment shape across PROCESS boundaries (VERDICT
@@ -99,8 +72,13 @@ def test_two_process_native_input_ckpt_resume(tmp_path):
     relaunched — the resumed run must reproduce the unbroken control's
     step-8 metrics exactly. 8 steps over a 4-batch/host epoch also rolls
     the native reader across an epoch boundary."""
+    from conftest import write_imagenet_records
+
     tree = tmp_path / "records"
-    _write_imagenet_tree(tree)
+    # 4 shards so each of the 2 processes gets its own file subset
+    # (data/imagenet.py shards files per process).
+    write_imagenet_records(tree, counts=(16,) * 4, size=(48, 40),
+                           label_fn=lambda n: (n % 100) + 1)
     data_args = (
         "--set", "data.name=imagenet",
         "--set", f"data.data_dir={tree}",

@@ -65,24 +65,15 @@ def test_two_process_exact_eval_uneven_shards(tmp_path):
     files/8 records, proc1: 1 file/4 records), agree on the padded batch
     count via process_allgather, and must report identical full-set
     metrics covering all 12 records — without deadlocking."""
-    np = pytest.importorskip("numpy")
-    tf = pytest.importorskip("tensorflow")
+    pytest.importorskip("tensorflow")
+
+    from conftest import write_imagenet_records
 
     eval_dir = str(tmp_path / "val")
-    os.makedirs(eval_dir)
-    rng = np.random.default_rng(0)
-    for f, per_file in enumerate([5, 4, 3]):  # 3 files → stride shards 2/1
-        path = os.path.join(eval_dir, f"validation-{f:05d}-of-00003")
-        with tf.io.TFRecordWriter(path) as w:
-            for r in range(per_file):
-                img = rng.integers(0, 255, (40, 40, 3), dtype=np.uint8)
-                ex = tf.train.Example(features=tf.train.Features(feature={
-                    "image/encoded": tf.train.Feature(bytes_list=tf.train.BytesList(
-                        value=[tf.io.encode_jpeg(img).numpy()])),
-                    "image/class/label": tf.train.Feature(int64_list=tf.train.Int64List(
-                        value=[(r % 10) + 1])),
-                }))
-                w.write(ex.SerializeToString())
+    write_imagenet_records(eval_dir, split="validation",
+                           counts=(5, 4, 3),  # 3 files → stride shards 2/1
+                           size=(40, 40),
+                           label_fn=lambda n: (n % 10) + 1)
 
     outs = _run_workers((eval_dir,))
     results = []
