@@ -220,7 +220,12 @@ class MoEMlp(nn.Module):
     # "sorted" (default): index/gather dispatch, O(B·E·C) tables — scales
     # in experts and capacity. "dense": the original O(B·S·E·C) one-hot
     # einsum dispatch — kept as the parity reference (tests/test_moe.py)
-    # and for shapes where XLA fuses the one-hots well.
+    # and for shapes where XLA fuses the one-hots well. Sharding note:
+    # under dp+ep SPMD the sorted path's gathers can trigger XLA
+    # "involuntary full rematerialization" on some sharding transitions
+    # (spmd_partitioner b/433785288) where the dense einsums repartition
+    # cleanly — if that binds on a small mixture, flip to "dense";
+    # at large E the O(B·S·E·C) one-hots are the bigger cost regardless.
     dispatch_impl: str = "sorted"
 
     @nn.compact

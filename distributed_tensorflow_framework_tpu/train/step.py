@@ -209,21 +209,46 @@ class StepBuilder:
         has_bn = self._has_bn(state)
         inputs = model_inputs(self.task, batch)
 
+        # Router-overflow visibility: collect the layers' sown
+        # moe_drop_frac into the step metrics so capacity starvation is
+        # observable in real training, not only via a debug apply.
+        # Skipped under remat — sown intermediates do not survive the
+        # checkpoint transform (the debug-apply path still works there).
+        want_drop = (
+            self.task == "mlm"
+            and getattr(self.config.model, "num_experts", 0) > 0
+            and not getattr(self.config.model, "remat", False)
+        )
+
         def loss_fn(params):
             variables = {"params": params}
             if has_bn:
                 variables["batch_stats"] = state.batch_stats
+            mutable = (["batch_stats"] if has_bn else []) + (
+                ["intermediates"] if want_drop else [])
             out = self.model.apply(
                 variables,
                 *inputs,
                 train=True,
-                mutable=["batch_stats"] if has_bn else False,
+                mutable=mutable if mutable else False,
                 rngs={"dropout": step_rng},
             )
-            if has_bn:
+            if mutable:
                 logits, new_model_state = out
             else:
                 logits, new_model_state = out, {}
+            drop_fracs = None
+            if want_drop:
+                new_model_state = dict(new_model_state)
+                inter = new_model_state.pop("intermediates", {})
+                # Filter by key so other sown intermediates can never
+                # leak into this metric.
+                drop_fracs = [
+                    leaf for path, leaf in
+                    jax.tree_util.tree_flatten_with_path(inter)[0]
+                    if any(getattr(k, "key", None) == "moe_drop_frac"
+                           for k in path)
+                ]
             if self.task == "mlm":
                 moe_aux = None
                 if isinstance(logits, dict):  # MoE model: logits + aux loss
@@ -234,6 +259,13 @@ class StepBuilder:
                     loss = loss + self.config.train.moe_aux_weight * moe_aux
                     metrics["moe_aux_loss"] = moe_aux
                     metrics["total_loss"] = loss
+                if drop_fracs:
+                    # Mean over the model's MoE layers. Under grad
+                    # accumulation this rides the shared masked-token
+                    # metric weighting (slightly skewed vs a plain
+                    # per-microbatch mean) — fine for a diagnostic.
+                    metrics["moe_drop_frac"] = jnp.mean(
+                        jnp.stack(drop_fracs))
             else:
                 aux_logits = None
                 if isinstance(logits, dict):  # Inception aux head

@@ -95,6 +95,9 @@ def test_moe_bert_trains_dp_ep_tp(moe_cfg, devices):
         m = jax.device_get(metrics)
         assert np.isfinite(float(m["loss"]))
         assert np.isfinite(float(m["moe_aux_loss"]))
+        # Router-overflow diagnostic rides the step metrics (mean over
+        # MoE layers, in [0, 1]).
+        assert 0.0 <= float(m["moe_drop_frac"]) <= 1.0
         losses.append(float(m["loss"]))
     # Eval path strips the aux dict and returns weighted metric sums
     # (exact-eval contract, train/step.py _eval_step).
