@@ -298,7 +298,7 @@ def _ladder_override(default: tuple, n_chips: int) -> tuple:
     return default
 
 
-def _init_backend(attempts: int = 3, probe_timeout_s: float = 90.0):
+def _init_backend(attempts: int = 3, probe_timeout_s: float = 240.0):
     """Bounded, *subprocess-probed* backend bring-up.
 
     Round 3's perf evidence was erased by a wedged TPU tunnel: a bare
@@ -310,27 +310,52 @@ def _init_backend(attempts: int = 3, probe_timeout_s: float = 90.0):
     hard timeout; only after a probe succeeds do we touch the backend
     here. Returns (n_chips, device_kind) or raises RuntimeError with the
     last failure reason.
+
+    A timed-out probe is ABANDONED, never killed: both observed tunnel
+    wedges (round 3, and round 4's BERT ladder) immediately followed a
+    SIGKILL of a client mid-backend-handshake — the remote terminal's
+    libtpu client survives the local kill and holds the chip, wedging
+    every later dial for the rest of the session. A slow-but-alive probe
+    that eventually completes exits harmlessly; an orphaned remote
+    handshake never recovers. For the same reason the timeout is long
+    (4 min): it should only ever fire on a truly dead tunnel, not on a
+    bring-up that is merely slow under host CPU load.
     """
     import subprocess
     import time
 
     last_err = "unknown"
     for attempt in range(attempts):
+        # start_new_session: the abandoned child must survive this
+        # process's exit / Ctrl-C (a group SIGINT would kill it
+        # mid-handshake — the exact wedge this code exists to avoid).
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(len(d), d[0].device_kind, sep='\\t')"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True,
+        )
         try:
-            proc = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; d = jax.devices(); "
-                 "print(len(d), d[0].device_kind, sep='\\t')"],
-                capture_output=True, text=True, timeout=probe_timeout_s,
-            )
+            _, err = proc.communicate(timeout=probe_timeout_s)
         except subprocess.TimeoutExpired:
-            last_err = f"backend probe hung >{probe_timeout_s:.0f}s"
-        else:
-            if proc.returncode == 0:
-                import jax
+            # Leave the child running (see docstring). Drop our pipe
+            # ends so it can't block on a full pipe once we're gone.
+            for p in (proc.stdout, proc.stderr):
+                if p is not None:
+                    p.close()
+            # No retry after a hang: the chip client is exclusive, so a
+            # fresh probe would just queue behind the abandoned one and
+            # burn another timeout. Retries are for fast-FAILING probes.
+            raise RuntimeError(
+                f"backend probe still hung after {probe_timeout_s:.0f}s "
+                f"(left alive, pid {proc.pid} — killing it can wedge "
+                f"the tunnel)")
+        if proc.returncode == 0:
+            import jax
 
-                return jax.device_count(), jax.devices()[0].device_kind
-            last_err = (proc.stderr.strip().splitlines() or ["no stderr"])[-1]
+            return jax.device_count(), jax.devices()[0].device_kind
+        last_err = (err.strip().splitlines() or ["no stderr"])[-1]
         print(f"bench: backend init attempt {attempt + 1}/{attempts} "
               f"failed ({last_err})", file=sys.stderr)
         if attempt + 1 < attempts:
