@@ -253,6 +253,28 @@ class MoEMlp(nn.Module):
         wo = self.param("wo", expert_kernel_init, (e, self.mlp_dim, h),
                         jnp.float32)
 
+        # Expert-tensor sharding hint: keep every (B, E, C, *) tensor —
+        # and, via propagation, its AD cotangent — sharded batch-over-data
+        # and experts-over-expert. Without it the SPMD partitioner batch-
+        # shards some backward intermediates over the WHOLE mesh and then
+        # "involuntarily fully rematerializes" (replicates) them to reach
+        # the expert-sharded weights. No-op without a mesh context (plain
+        # tests, init, the shard_map twin) — see sharding.constrain_activation.
+        from distributed_tensorflow_framework_tpu.parallel.sharding import (
+            constrain_activation,
+        )
+
+        # B stays on the data-like axes (the batch enters sharded over
+        # ("data","fsdp","expert") — core/mesh.batch_spec); E moves to the
+        # ``expert`` axis. The batch-dim expert→data reshard is exactly
+        # the dispatch/return all_to_all. The hidden dim (xe/oe) is
+        # replicated; he's mlp dim keeps the megatron "model" split that
+        # column-parallel wi produces and row-parallel wo consumes.
+        expert_hint = lambda t: constrain_activation(  # noqa: E731
+            t, ("data", "fsdp"), "expert", None, None)
+        expert_hint_mlp = lambda t: constrain_activation(  # noqa: E731
+            t, ("data", "fsdp"), "expert", None, "model")
+
         if self.dispatch_impl == "sorted":
             (token_table, table_valid, expert_a, pos_a, combine_w,
              aux_loss) = topk_dispatch_sorted(gate_logits, self.topk,
@@ -283,11 +305,14 @@ class MoEMlp(nn.Module):
             xe = jnp.einsum("bsec,bsh->bech", dispatch.astype(self.dtype),
                             x.astype(self.dtype))
 
+        xe = expert_hint(xe)
         he = nn.gelu(
             jnp.einsum("bech,ehf->becf", xe, wi.astype(self.dtype)),
             approximate=True,
         )
-        oe = jnp.einsum("becf,efh->bech", he, wo.astype(self.dtype))
+        he = expert_hint_mlp(he)
+        oe = expert_hint(
+            jnp.einsum("becf,efh->bech", he, wo.astype(self.dtype)))
 
         if self.dispatch_impl == "sorted":
             # Combine: gather each token's expert outputs back and weight

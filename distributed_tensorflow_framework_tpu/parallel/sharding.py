@@ -163,3 +163,46 @@ def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
 
 def tree_map_specs(fn: Callable[[P], P], specs: Any) -> Any:
     return jax.tree.map(fn, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+_WARNED_NO_THREAD_RESOURCES = False
+
+
+def constrain_activation(x: jax.Array, *axes: Any) -> jax.Array:
+    """Best-effort ``with_sharding_constraint`` for model-internal
+    activations (e.g. the MoE (B, E, C, H) expert tensors, whose backward
+    otherwise hits XLA SPMD "involuntary full rematerialization" — the
+    partitioner can't see that the cotangents should stay expert-sharded).
+
+    No-ops when there is no mesh context (plain CPU tests, ``init``,
+    the shard_map twin — which never enters one) or when any named axis
+    in the spec is absent from the context mesh, so callers can hint
+    unconditionally. The jit step paths enter their mesh via
+    ``with self.mesh:`` (train/step.py) to arm it.
+
+    ``None`` in the spec means REPLICATED (with_sharding_constraint has
+    no unconstrained marker for named specs) — only pin dims whose
+    layout you know; a wrong ``None`` forces an all-gather.
+    """
+    try:  # private API (jax 0.9): best-effort must stay best-effort
+        from jax._src.mesh import thread_resources
+        m = thread_resources.env.physical_mesh
+    except Exception:
+        global _WARNED_NO_THREAD_RESOURCES
+        if not _WARNED_NO_THREAD_RESOURCES:
+            _WARNED_NO_THREAD_RESOURCES = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "jax._src.mesh.thread_resources unavailable on this jax "
+                "version — activation sharding hints are disabled"
+            )
+        return x
+    if m.empty:
+        return x
+    names = set(m.axis_names)
+    for a in axes:
+        for name in (a,) if isinstance(a, str) else tuple(a or ()):
+            if name not in names:
+                return x
+    return jax.lax.with_sharding_constraint(x, P(*axes))

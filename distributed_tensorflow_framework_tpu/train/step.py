@@ -383,10 +383,16 @@ class StepBuilder:
         return new_state, metrics
 
     def _train_step_jit(self, state: TrainState, batch: Any):
-        grads, metrics, new_model_state = self._loss_and_updates(state, batch)
-        # Loss is a global-batch mean → grads already carry the
-        # cross-replica-sum; no explicit collective needed.
-        return self._apply_updates(state, grads, metrics, new_model_state)
+        # Mesh context (trace-time only) arms best-effort activation
+        # sharding hints inside the models (shd.constrain_activation);
+        # the shard_map twin deliberately never enters one.
+        with self.mesh:
+            grads, metrics, new_model_state = self._loss_and_updates(
+                state, batch)
+            # Loss is a global-batch mean → grads already carry the
+            # cross-replica-sum; no explicit collective needed.
+            return self._apply_updates(state, grads, metrics,
+                                       new_model_state)
 
     def _train_step_replica(self, state: TrainState, batch: Any):
         grads, metrics, new_model_state = self._loss_and_updates(state, batch)
@@ -464,7 +470,8 @@ class StepBuilder:
         if has_bn:
             variables["batch_stats"] = state.batch_stats
         inputs = model_inputs(self.task, batch)
-        logits = self.model.apply(variables, *inputs, train=False)
+        with self.mesh:  # arm activation sharding hints (see train step)
+            logits = self.model.apply(variables, *inputs, train=False)
         if isinstance(logits, dict):  # MoE aux loss / Inception aux head
             logits = logits["logits"]
         if self.task == "mlm":
