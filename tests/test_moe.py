@@ -244,3 +244,30 @@ def test_drop_frac_diagnostic(devices):
 
     assert drop_frac(4.0) == 0.0          # room for every token
     assert drop_frac(0.25) > 0.2          # starved capacity drops plenty
+
+
+def test_constrain_activation_nop_and_armed(devices):
+    """parallel/sharding.constrain_activation: identity without a mesh
+    context or when an axis is missing; a real constraint inside one."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_framework_tpu.parallel.sharding import (
+        constrain_activation,
+    )
+
+    x = jnp.ones((8, 4))
+    # No mesh context → the very same object comes back (not a copy).
+    assert constrain_activation(x, "data", None) is x
+
+    mesh = Mesh(np.asarray(devices).reshape(2, 4), ("data", "expert"))
+    with mesh:
+        # Axis named in the spec but absent from the mesh → no-op.
+        assert constrain_activation(x, "model", None) is x
+
+    @jax.jit
+    def f(x):
+        with mesh:
+            return constrain_activation(x * 2, "data", "expert")
+
+    out = f(jax.device_put(x, NamedSharding(mesh, P("data", None))))
+    assert out.sharding == NamedSharding(mesh, P("data", "expert"))
