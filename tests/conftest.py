@@ -12,9 +12,17 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# This box has ONE core: 8 device threads time-share it, and XLA:CPU's
+# default collective rendezvous abort (~40 s of one participant not
+# being scheduled) turns scheduling stalls into fatal `rendezvous.cc`
+# crashes (observed twice on MoE training runs). Generous timeouts make
+# starvation a slowdown, not an abort.
+if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in _flags:
+    _flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+if "xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
+    _flags += " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
+os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
