@@ -38,6 +38,15 @@ def _honor_platform_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+    if plat and plat.split(",")[0] == "cpu":
+        # Rendezvous-timeout defaults for virtual-device CPU runs — see
+        # core/platform.py (tests/conftest.py applies the same policy).
+        from distributed_tensorflow_framework_tpu.core.platform import (
+            with_cpu_collective_timeouts,
+        )
+
+        os.environ["XLA_FLAGS"] = with_cpu_collective_timeouts(
+            os.environ.get("XLA_FLAGS", ""))
 
 
 def parse_args(argv=None):

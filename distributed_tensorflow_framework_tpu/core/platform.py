@@ -1,0 +1,28 @@
+"""Host-platform environment policy shared by the CLI and the test harness.
+
+Pure string helpers only — this module must stay importable before JAX
+initializes a backend (XLA_FLAGS is consumed at first backend init, so
+callers mutate os.environ with these helpers first).
+"""
+
+from __future__ import annotations
+
+# XLA:CPU aborts a collective whose participants don't all reach the
+# rendezvous within ~40 s (`rendezvous.cc` termination timeout). On small
+# hosts running N virtual devices (N threads time-sharing few cores) a
+# scheduling stall trips it mid-training — observed twice on 8-device MoE
+# runs on a 1-core VM. These defaults make starvation a slowdown instead
+# of a crash; anything the user already put in XLA_FLAGS wins.
+CPU_COLLECTIVE_TIMEOUT_FLAGS: tuple[tuple[str, int], ...] = (
+    ("xla_cpu_collective_call_warn_stuck_timeout_seconds", 120),
+    ("xla_cpu_collective_call_terminate_timeout_seconds", 1200),
+)
+
+
+def with_cpu_collective_timeouts(flags: str) -> str:
+    """Append the rendezvous-timeout defaults to an XLA_FLAGS string,
+    skipping any flag the caller already set."""
+    for name, value in CPU_COLLECTIVE_TIMEOUT_FLAGS:
+        if name not in flags:
+            flags += f" --{name}={value}"
+    return flags.strip()

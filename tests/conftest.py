@@ -13,16 +13,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
-# This box has ONE core: 8 device threads time-share it, and XLA:CPU's
-# default collective rendezvous abort (~40 s of one participant not
-# being scheduled) turns scheduling stalls into fatal `rendezvous.cc`
-# crashes (observed twice on MoE training runs). Generous timeouts make
-# starvation a slowdown, not an abort.
-if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in _flags:
-    _flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-if "xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
-    _flags += " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
-os.environ["XLA_FLAGS"] = _flags
+# Rendezvous-timeout defaults: on a 1-core box a scheduling stall would
+# otherwise abort multi-device collectives — see core/platform.py.
+from distributed_tensorflow_framework_tpu.core.platform import (  # noqa: E402
+    with_cpu_collective_timeouts,
+)
+
+os.environ["XLA_FLAGS"] = with_cpu_collective_timeouts(_flags)
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
