@@ -114,10 +114,18 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
                           os.environ.get("BENCH_NO_S2D", "0")
                           in ("", "0"),
                       # Per-block remat: trades idle MXU headroom for HBM
-                      # bytes on the BW-bound step (PERF_NOTES.md).
+                      # bytes on the BW-bound step. BENCH_REMAT=1 → full
+                      # replay (measured -13% img/s); BENCH_REMAT=light →
+                      # the conv_saved policy (keep conv outputs, replay
+                      # only BN/ReLU — the cheap-tail variant). See
+                      # PERF_NOTES.md.
                       "remat":
                           os.environ.get("BENCH_REMAT", "0")
                           not in ("", "0"),
+                      "remat_policy":
+                          "conv_saved"
+                          if os.environ.get("BENCH_REMAT") in
+                          ("light", "conv", "conv_saved") else "full",
                       **(model_overrides or {})},
             "data": {
                 "name": "synthetic_images",

@@ -185,6 +185,15 @@ class ModelConfig:
     # fits. Supported for the bert models (numerics parity tested); other
     # model families reject it rather than silently ignore it.
     remat: bool = False
+    # What the remat blocks may keep from the forward pass:
+    #   "full"       — save nothing; replay the whole block (max memory
+    #                  savings, full recompute cost — measured -13% img/s
+    #                  on the HBM-bound ResNet-50 step, PERF_NOTES.md).
+    #   "conv_saved" — save conv outputs (jax.ad_checkpoint name tag in
+    #                  layers.ConvBN), replay only the BN/ReLU/residual
+    #                  tail — near-zero recompute flops for roughly half
+    #                  the activation bytes. ResNet only.
+    remat_policy: str = "full"
 
 
 @config_dataclass

@@ -110,6 +110,13 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             f"model.remat is only supported for the transformer (bert), "
             f"resnet and inception models, not {config.name!r}"
         )
+    if config.remat_policy != "full" and not (
+            config.remat and name.startswith("resnet")):
+        raise ValueError(
+            f"model.remat_policy={config.remat_policy!r} requires "
+            f"model.remat=true on a resnet model (the conv_saved policy "
+            f"keys on the ConvBN conv_out tag; models/resnet.py)"
+        )
     if config.remat and config.pipeline_stages > 1:
         raise ValueError(
             "model.remat inside the pipelined stack is unsupported — the "
@@ -138,6 +145,7 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             cifar_stem=m.group(2) is not None,
             space_to_depth_stem=config.space_to_depth_stem,
             remat=config.remat,
+            remat_policy=config.remat_policy,
         )
     if name in ("inception_v3", "inception-v3", "inceptionv3"):
         from distributed_tensorflow_framework_tpu.models.inception import InceptionV3

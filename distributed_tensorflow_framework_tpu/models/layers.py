@@ -24,6 +24,7 @@ from typing import Any, Callable, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 Dtype = Any
 
@@ -108,6 +109,11 @@ class ConvBN(nn.Module):
             kernel_init=conv_kernel_init,
             name="conv",
         )(x)
+        # Identity marker for the "conv_saved" remat policy (resnet.py):
+        # jax.checkpoint(policy=save_only_these_names("conv_out")) keeps
+        # this tensor and replays only the BN/ReLU tail. A no-op outside
+        # such a checkpoint.
+        x = checkpoint_name(x, "conv_out")
         x = BatchNorm(
             use_running_average=not self.train,
             dtype=self.dtype,

@@ -59,6 +59,20 @@ def test_remat_rejected_for_unwired_models():
         get_model(ModelConfig(name="lenet5", remat=True))
 
 
+def test_remat_policy_rejected_off_resnet():
+    # conv_saved keys on the ConvBN tag inside the resnet blocks; other
+    # models (and remat=false) must reject it, not silently ignore it.
+    with pytest.raises(ValueError, match="remat_policy"):
+        get_model(ModelConfig(name="bert", remat=True,
+                              remat_policy="conv_saved"))
+    with pytest.raises(ValueError, match="remat_policy"):
+        get_model(ModelConfig(name="resnet50", remat=False,
+                              remat_policy="conv_saved"))
+    with pytest.raises(ValueError, match="conv_saved"):
+        get_model(ModelConfig(name="resnet50", remat=True,
+                              remat_policy="typo"))
+
+
 @pytest.mark.slow
 def test_inception_remat_block_parity_and_trains(devices):
     """Per-block remat on the Inception mixed/reduction blocks.
@@ -132,18 +146,17 @@ def test_inception_remat_block_parity_and_trains(devices):
 def test_resnet_remat_exact_logits_grads_and_bn_stats(devices):
     """Per-block remat on the ResNet stack (the byte lever for the
     HBM-bound ImageNet step): identical logits, gradients AND BatchNorm
-    running-stat updates — jax.checkpoint replays, never diverges."""
+    running-stat updates — jax.checkpoint replays, never diverges.
+    Covers both replay policies — "full" (save nothing) and "conv_saved"
+    (keep conv outputs, replay only the BN/ReLU tail) — against ONE
+    shared non-remat baseline."""
     x = jnp.asarray(
         np.random.default_rng(1).standard_normal((2, 32, 32, 3)), jnp.float32)
 
-    models = [
-        get_model(ModelConfig(name="resnet18_cifar", num_classes=10,
-                              dtype="float32", remat=r))
-        for r in (False, True)
-    ]
-    vs = models[0].init(jax.random.key(0), x, train=False)
-    outs, grads, stats = [], [], []
-    for m in models:
+    def run(remat, policy):
+        m = get_model(ModelConfig(name="resnet18_cifar", num_classes=10,
+                                  dtype="float32", remat=remat,
+                                  remat_policy=policy))
         def loss_fn(params):
             logits, new_state = m.apply(
                 {"params": params, "batch_stats": vs["batch_stats"]},
@@ -151,17 +164,23 @@ def test_resnet_remat_exact_logits_grads_and_bn_stats(devices):
             return (logits.astype(jnp.float32) ** 2).mean(), new_state
 
         out = m.apply(vs, x, train=False)
-        (l, new_state), g = jax.value_and_grad(loss_fn, has_aux=True)(
+        (_, new_state), g = jax.value_and_grad(loss_fn, has_aux=True)(
             vs["params"])
-        outs.append(np.asarray(out))
-        grads.append(jax.device_get(g))
-        stats.append(jax.device_get(new_state["batch_stats"]))
+        return (np.asarray(out), jax.device_get(g),
+                jax.device_get(new_state["batch_stats"]))
 
-    np.testing.assert_array_equal(outs[0], outs[1])
-    for a, b in zip(jax.tree.leaves(grads[0]), jax.tree.leaves(grads[1])):
-        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
-    for a, b in zip(jax.tree.leaves(stats[0]), jax.tree.leaves(stats[1])):
-        np.testing.assert_array_equal(a, b)
+    vs = get_model(ModelConfig(name="resnet18_cifar", num_classes=10,
+                               dtype="float32")).init(
+        jax.random.key(0), x, train=False)
+    base_out, base_grads, base_stats = run(False, "full")
+    for policy in ("full", "conv_saved"):
+        out, grads, stats = run(True, policy)
+        np.testing.assert_array_equal(base_out, out, err_msg=policy)
+        for a, b in zip(jax.tree.leaves(base_grads), jax.tree.leaves(grads)):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7,
+                                       err_msg=policy)
+        for a, b in zip(jax.tree.leaves(base_stats), jax.tree.leaves(stats)):
+            np.testing.assert_array_equal(a, b, err_msg=policy)
 
 
 def test_remat_rejected_with_pipeline():
