@@ -95,6 +95,13 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
     if not files:
         log.warning("MLM TFRecords not found under %r — synthetic fallback",
                     config.data_dir)
+        if train and config.pack_factor > 1:
+            log.warning(
+                "data.pack_factor=%d is IGNORED on the synthetic fallback: "
+                "synthetic rows are full-density with no segment ids, so "
+                "training runs unpacked. Packing engages only on the "
+                "tf.data TFRecord path (set data.data_dir).",
+                config.pack_factor)
         return synthetic.synthetic_mlm(config, process_index, process_count)
 
     if len(files) < process_count:
