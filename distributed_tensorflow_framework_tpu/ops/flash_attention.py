@@ -26,6 +26,7 @@ the plain-XLA reference.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -34,18 +35,37 @@ from jax.experimental import pallas as pl
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 BLOCK_Q = 128
 BLOCK_K = 128
+# Streaming-kernel tile sizes (the s_k > MAX_SEQ_VMEM regime only). The
+# 128×128 tiles the whole-K path uses are far too fine here: at S=8192
+# they make a (B,H,64,64) grid of ~200k programs whose per-program
+# overhead swamps the 128×64×128 matmuls (measured 3% MFU on v5e,
+# PERF_NOTES.md round 4). Fatter tiles amortize the grid: 8 sequential
+# k-steps instead of 64, and each dot is MXU-sized. Measured ladder at
+# seq 8192 (PERF_NOTES round 4): 128/128 → 7.9k tok/s, 256/1024 → 30k,
+# 512/1024 → 35.2k, 1024/1024 → 35.4k, 512/2048 → 31.9k (VMEM pressure).
+# 512/1024 ships: within noise of the peak at half the q-tile VMEM.
+# Env-tunable for A/Bs, same spirit as the BENCH_* knobs.
+BLOCK_Q_KB = int(os.environ.get("FLASH_BLOCK_Q_KB", "512"))
+BLOCK_K_KB = int(os.environ.get("FLASH_BLOCK_K_KB", "1024"))
 # VMEM dispatch policy (VERDICT r3 weak #2 — no silent fallback above this):
 #   s_k ≤ MAX_SEQ_VMEM → whole-K kernels: each program holds the full
-#     opposing sequence (S*D*4B*2 for K and V f32-upcast, + BLOCK*S*4B
-#     scores) in VMEM — fits ~16MB with double buffering, and is the
-#     variant whose perf was measured on real TPU (PERF_NOTES.md).
+#     opposing sequence in VMEM at INPUT dtype (S*D*2B*2 for bf16 K and
+#     V — the round-4 kernels dot in input dtype, no f32 upcast — plus
+#     the BLOCK_Q*S*4B f32 score block) — fits ~16MB with double
+#     buffering, and is the variant whose perf was measured on real TPU
+#     (PERF_NOTES.md).
 #   s_k > MAX_SEQ_VMEM → K-blocked streaming kernels: the grid gains a
 #     sequential k-axis; running (m, l, acc) softmax state lives in VMEM
-#     scratch and K/V stream through in BLOCK_K tiles, so VMEM use is
-#     O(BLOCK_Q·BLOCK_K) regardless of sequence length. No fallback to
-#     the O(S²)-materializing XLA chain exists above the threshold —
-#     long chunks stay fused (tests/test_attention.py pins 8192).
-MAX_SEQ_VMEM = 4096
+#     scratch and K/V stream through in BLOCK_K_KB tiles, so VMEM use is
+#     O(BLOCK_Q_KB·BLOCK_K_KB) regardless of sequence length. No
+#     fallback to the O(S²)-materializing XLA chain exists above the
+#     threshold — long chunks stay fused (tests/test_attention.py pins
+#     8192), and the chain is not even COMPILABLE there: at seq 8192 the
+#     XLA impl fails remote compilation outright (PERF_NOTES.md round 4).
+# Env-tunable so the whole-K vs K-blocked crossover can be re-measured
+# without an edit (FLASH_MAX_SEQ_VMEM=0 forces the streaming kernels
+# everywhere).
+MAX_SEQ_VMEM = int(os.environ.get("FLASH_MAX_SEQ_VMEM", "4096"))
 
 
 def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
@@ -56,13 +76,17 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
         qseg_ref, kseg_ref, o_ref, lse_ref = rest
     else:
         o_ref, lse_ref = rest
-    q = q_ref[0, 0].astype(jnp.float32)          # (BQ, D)
-    k = k_ref[0, 0].astype(jnp.float32)          # (S, D)
-    v = v_ref[0, 0].astype(jnp.float32)          # (S, D)
+    # Dots take the INPUT dtype (bf16 in production) with f32 accumulation:
+    # bf16 products are exact in the f32 MXU accumulator, so this matches
+    # an upcast-then-f32-dot bitwise up to summation order while running
+    # at the 2x bf16 MXU rate. Only the p/ds downcasts below round.
+    q = q_ref[0, 0]                               # (BQ, D)
+    k = k_ref[0, 0]                               # (S, D)
+    v = v_ref[0, 0]                               # (S, D)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * scale                                     # (BQ, S)
+    ) * scale                                     # (BQ, S) f32
     s = s + bias_ref[0]                           # additive mask bias, (1,S)
     if segmented:
         # Packed-sequence block-diagonal mask: token i may attend token j
@@ -75,7 +99,7 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
     o = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) / l                                         # (BQ, D)
     o_ref[0, 0] = o.astype(o_ref.dtype)
@@ -90,10 +114,10 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
         qseg_ref, kseg_ref, do_ref, lse_ref, delta_ref, dq_ref = rest
     else:
         do_ref, lse_ref, delta_ref, dq_ref = rest
-    q = q_ref[0, 0].astype(jnp.float32)           # (BQ, D)
-    k = k_ref[0, 0].astype(jnp.float32)           # (S, D)
-    v = v_ref[0, 0].astype(jnp.float32)           # (S, D)
-    do = do_ref[0, 0].astype(jnp.float32)         # (BQ, D)
+    q = q_ref[0, 0]                               # (BQ, D) input dtype
+    k = k_ref[0, 0]                               # (S, D)
+    v = v_ref[0, 0]                               # (S, D)
+    do = do_ref[0, 0]                             # (BQ, D)
     lse = lse_ref[0, 0]                           # (BQ, 1)
     delta = delta_ref[0, 0]                       # (BQ, 1)
     s = jax.lax.dot_general(
@@ -109,9 +133,9 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                             # (BQ, S)
-    ds = p * (dp - delta)                         # (BQ, S)
+    ds = p * (dp - delta)                         # (BQ, S) f32
     dq = jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())),
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
@@ -125,10 +149,10 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
          dk_ref, dv_ref, dbias_ref) = rest
     else:
         do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dbias_ref = rest
-    q = q_ref[0, 0].astype(jnp.float32)           # (S, D)
-    k = k_ref[0, 0].astype(jnp.float32)           # (BK, D)
-    v = v_ref[0, 0].astype(jnp.float32)           # (BK, D)
-    do = do_ref[0, 0].astype(jnp.float32)         # (S, D)
+    q = q_ref[0, 0]                               # (S, D) input dtype
+    k = k_ref[0, 0]                               # (BK, D)
+    v = v_ref[0, 0]                               # (BK, D)
+    do = do_ref[0, 0]                             # (S, D)
     lse = lse_ref[0, 0]                           # (S, 1)
     delta = delta_ref[0, 0]                       # (S, 1)
     s = jax.lax.dot_general(
@@ -141,16 +165,16 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
         s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
     p = jnp.exp(s - lse)
     dv = jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())),
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                             # (BK, D)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                             # (S, BK)
-    ds = p * (dp - delta)                         # (S, BK)
+    ds = p * (dp - delta)                         # (S, BK) f32
     dk = jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())),
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale                                     # (BK, D)
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
@@ -180,13 +204,13 @@ def _attn_fwd_kernel_kb(q_ref, k_ref, v_ref, bias_ref, *rest,
         m_ref[...] = jnp.full(m_ref.shape, NEG_INF, m_ref.dtype)
         l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
 
-    q = q_ref[0, 0].astype(jnp.float32)           # (BQ, D)
-    k = k_ref[0, 0].astype(jnp.float32)           # (BK, D)
-    v = v_ref[0, 0].astype(jnp.float32)           # (BK, D)
+    q = q_ref[0, 0]                               # (BQ, D) input dtype
+    k = k_ref[0, 0]                               # (BK, D)
+    v = v_ref[0, 0]                               # (BK, D)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * scale + bias_ref[0]                       # (BQ, BK)
+    ) * scale + bias_ref[0]                       # (BQ, BK) f32
     if segmented:
         qs = qseg_ref[0, 0]                       # (BQ,)
         ks = kseg_ref[0, 0]                       # (BK,)
@@ -197,7 +221,7 @@ def _attn_fwd_kernel_kb(q_ref, k_ref, v_ref, bias_ref, *rest,
     p = jnp.exp(s - m_new)
     l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
     acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     m_ref[...] = m_new
@@ -221,16 +245,16 @@ def _attn_bwd_dq_kernel_kb(q_ref, k_ref, v_ref, bias_ref, *rest,
     def _init():
         acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
 
-    q = q_ref[0, 0].astype(jnp.float32)           # (BQ, D)
-    k = k_ref[0, 0].astype(jnp.float32)           # (BK, D)
-    v = v_ref[0, 0].astype(jnp.float32)           # (BK, D)
-    do = do_ref[0, 0].astype(jnp.float32)         # (BQ, D)
+    q = q_ref[0, 0]                               # (BQ, D) input dtype
+    k = k_ref[0, 0]                               # (BK, D)
+    v = v_ref[0, 0]                               # (BK, D)
+    do = do_ref[0, 0]                             # (BQ, D)
     lse = lse_ref[0, 0]                           # (BQ, 1)
     delta = delta_ref[0, 0]                       # (BQ, 1)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * scale + bias_ref[0]                       # (BQ, BK)
+    ) * scale + bias_ref[0]                       # (BQ, BK) f32
     if segmented:
         qs = qseg_ref[0, 0]
         ks = kseg_ref[0, 0]
@@ -240,9 +264,9 @@ def _attn_bwd_dq_kernel_kb(q_ref, k_ref, v_ref, bias_ref, *rest,
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                             # (BQ, BK)
-    ds = p * (dp - delta)
+    ds = p * (dp - delta)                         # f32
     acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())),
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale
 
@@ -270,32 +294,32 @@ def _attn_bwd_dkv_kernel_kb(q_ref, k_ref, v_ref, bias_ref, *rest,
         dv_acc[...] = jnp.zeros(dv_acc.shape, dv_acc.dtype)
         db_acc[...] = jnp.zeros(db_acc.shape, db_acc.dtype)
 
-    q = q_ref[0, 0].astype(jnp.float32)           # (BQ, D)
-    k = k_ref[0, 0].astype(jnp.float32)           # (BK, D)
-    v = v_ref[0, 0].astype(jnp.float32)           # (BK, D)
-    do = do_ref[0, 0].astype(jnp.float32)         # (BQ, D)
+    q = q_ref[0, 0]                               # (BQ, D) input dtype
+    k = k_ref[0, 0]                               # (BK, D)
+    v = v_ref[0, 0]                               # (BK, D)
+    do = do_ref[0, 0]                             # (BQ, D)
     lse = lse_ref[0, 0]                           # (BQ, 1)
     delta = delta_ref[0, 0]                       # (BQ, 1)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * scale + bias_ref[0]                       # (BQ, BK)
+    ) * scale + bias_ref[0]                       # (BQ, BK) f32
     if segmented:
         qs = qseg_ref[0, 0]
         ks = kseg_ref[0, 0]
         s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
     p = jnp.exp(s - lse)
     dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())),
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                             # (BK, D)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                             # (BQ, BK)
-    ds = p * (dp - delta)
+    ds = p * (dp - delta)                         # f32
     dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())),
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale                                     # (BK, D)
     db_acc[...] = db_acc[...] + jnp.sum(ds, axis=0, keepdims=True)
@@ -489,6 +513,34 @@ def _vmem_scratch(*shapes_dtypes):
     return [pltpu.VMEM(shape, dtype) for shape, dtype in shapes_dtypes]
 
 
+def _pick_block(s: int, target: int) -> int:
+    """Largest BLOCK_Q-multiple ≤ ``target`` that divides ``s`` (clamped
+    to at least BLOCK_Q, so an env target below the hardware tile floor
+    degrades to BLOCK_Q instead of dividing by zero). The dispatch
+    guards already force s to be a BLOCK_Q-multiple (or < BLOCK_Q), so
+    BLOCK_Q always divides and the loop terminates; non-power-of-two
+    lengths like 4224 = 33·128 simply land on a smaller tile."""
+    if s <= BLOCK_Q:
+        return s
+    b = max(BLOCK_Q, min(target - target % BLOCK_Q, s))
+    while s % b:
+        b -= BLOCK_Q
+    return b
+
+
+def _kb_params(interpret: bool):
+    """Mosaic grid semantics for the streaming kernels: (b, h, outer) are
+    parallel, the innermost accumulation axis is sequential. Interpret
+    mode (CPU tests) takes no TPU compiler params."""
+    if interpret:
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+
+    return {"compiler_params": pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel",
+                             "arbitrary"))}
+
+
 def _flash_fwd_kb(q, k, v, bias, qseg, kseg, *, segmented: bool,
                   interpret: bool):
     """Streaming forward for s_k > MAX_SEQ_VMEM: sequential k-axis grid +
@@ -496,8 +548,8 @@ def _flash_fwd_kb(q, k, v, bias, qseg, kseg, *, segmented: bool,
     b, h, s, d = q.shape
     s_k = k.shape[2]
     scale = 1.0 / (d ** 0.5)
-    block_q = min(BLOCK_Q, s)
-    block_k = min(BLOCK_K, s_k)
+    block_q = _pick_block(s, BLOCK_Q_KB)
+    block_k = _pick_block(s_k, BLOCK_K_KB)
     grid = (b, h, s // block_q, s_k // block_k)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d),
@@ -536,6 +588,7 @@ def _flash_fwd_kb(q, k, v, bias, qseg, kseg, *, segmented: bool,
             ((block_q, 1), jnp.float32),
         ),
         interpret=interpret,
+        **_kb_params(interpret),
     )(*operands)
 
 
@@ -635,8 +688,8 @@ def _flash_bwd_kb(q, k, v, bias, qseg, kseg, lse, do, delta, *,
     b, h, s, d = q.shape
     s_k = k.shape[2]
     scale = 1.0 / (d ** 0.5)
-    block_q = min(BLOCK_Q, s)
-    block_k = min(BLOCK_K, s_k)
+    block_q = _pick_block(s, BLOCK_Q_KB)
+    block_k = _pick_block(s_k, BLOCK_K_KB)
 
     seg_operands = [qseg, kseg] if segmented else []
     dq_seg_specs = [
@@ -669,6 +722,7 @@ def _flash_bwd_kb(q, k, v, bias, qseg, kseg, lse, do, delta, *,
         ),
         scratch_shapes=_vmem_scratch(((block_q, d), jnp.float32)),
         interpret=interpret,
+        **_kb_params(interpret),
     )(q, k, v, bias, *seg_operands, do, lse, delta)
 
     dkv_seg_specs = [
@@ -714,6 +768,7 @@ def _flash_bwd_kb(q, k, v, bias, qseg, kseg, lse, do, delta, *,
             ((1, block_k), jnp.float32),
         ),
         interpret=interpret,
+        **_kb_params(interpret),
     )(q, k, v, bias, *seg_operands, do, lse, delta)
     dbias = jnp.sum(dbias_h, axis=1)               # (B, 1, S): Σ over heads
     return dq, dk, dv, dbias

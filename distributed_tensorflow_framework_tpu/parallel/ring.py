@@ -27,8 +27,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+# Module import (not by-value) so the env/monkeypatch-tunable dispatch
+# constants (MAX_SEQ_VMEM) stay coherent between the two modules.
+from distributed_tensorflow_framework_tpu.ops import flash_attention as _fa
 from distributed_tensorflow_framework_tpu.ops.flash_attention import (
-    MAX_SEQ_VMEM,
     chunk_supported,
     flash_attention_chunk,
 )
@@ -57,14 +59,19 @@ def _chunk_attention(q, k, v, bias, q_seg=None, kv_seg=None):
     silently allocating O(chunk²) HBM (VERDICT r3 weak #2).
     """
     c = q.shape[1]
-    if c >= FLASH_CHUNK_MIN and chunk_supported(c):
+    # Flash kernels take any supported chunk at/above the crossover AND
+    # any chunk above the VMEM threshold (the latter matters when
+    # MAX_SEQ_VMEM is tuned below FLASH_CHUNK_MIN, e.g. the
+    # FLASH_MAX_SEQ_VMEM=0 force-streaming knob — without it those
+    # chunks would fall through to the misleading raise below).
+    if (c >= FLASH_CHUNK_MIN or c > _fa.MAX_SEQ_VMEM) and chunk_supported(c):
         o, lse = flash_attention_chunk(q, k, v, bias, q_seg, kv_seg)
         return o.astype(jnp.float32), lse
-    if c > MAX_SEQ_VMEM:
+    if c > _fa.MAX_SEQ_VMEM:
         raise ValueError(
-            f"ring chunk {c} exceeds MAX_SEQ_VMEM={MAX_SEQ_VMEM} but is "
-            f"not a BLOCK_Q multiple, so the flash kernels can't take it "
-            f"and the XLA fallback would materialize a {c}x{c} score "
+            f"ring chunk {c} exceeds MAX_SEQ_VMEM={_fa.MAX_SEQ_VMEM} but "
+            f"is not a BLOCK_Q multiple, so the flash kernels can't take "
+            f"it and the XLA fallback would materialize a {c}x{c} score "
             f"block per shard. Pick mesh.seq so seq/ring_shards is a "
             f"128-multiple."
         )

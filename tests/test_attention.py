@@ -247,6 +247,12 @@ def test_kblocked_kernels_match_whole_k(devices, monkeypatch):
     from distributed_tensorflow_framework_tpu.ops import flash_attention as fa
 
     monkeypatch.setattr(fa, "MAX_SEQ_VMEM", 128)
+    # Pin the streaming tiles to 128 so s=384 gives a REAL 3-step
+    # k-grid; the production 512/1024 targets would degenerate this
+    # shape to one block and never exercise the running-softmax
+    # cross-block math (init / corr rescale / finalize).
+    monkeypatch.setattr(fa, "BLOCK_Q_KB", 128)
+    monkeypatch.setattr(fa, "BLOCK_K_KB", 128)
     q, k, v = _rand_qkv(jax.random.key(7), b=2, s=384, h=2, d=32)
     mask = jnp.ones((2, 1, 1, 384), bool).at[:, :, :, 300:].set(False)
 
@@ -269,6 +275,50 @@ def test_kblocked_kernels_match_whole_k(devices, monkeypatch):
                                    rtol=2e-4, atol=2e-4, err_msg=f"d{name}")
 
 
+def test_bf16_inputs_match_f32_reference(devices, monkeypatch):
+    """Production dtype through BOTH kernel regimes: the round-4 kernels
+    dot in the INPUT dtype (bf16 on TPU) and downcast the p/ds softmax
+    intermediates — paths every f32 test reduces to a no-op. Pin bf16
+    fwd+grads against the f32 reference of the same bf16 values at
+    bf16-resolution tolerance."""
+    from distributed_tensorflow_framework_tpu.ops import flash_attention as fa
+
+    # Distinct seq per regime: identical shapes would let the second
+    # regime hit the first's jit cache and silently re-test whole-K.
+    # s=384 under MAX_SEQ_VMEM=128 also makes the k-blocked arm a real
+    # 3-step streaming grid.
+    for regime, seq_vmem, s in (("whole-K", 4096, 256),
+                                ("k-blocked", 128, 384)):
+        q, k, v = _rand_qkv(jax.random.key(11), b=2, s=s, h=2, d=32,
+                            dtype=jnp.bfloat16)
+        mask = jnp.ones((2, 1, 1, s), bool).at[:, :, :, s - 56:].set(False)
+        qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
+
+        def loss_flash(q, k, v):
+            out = fa.flash_attention(q, k, v, mask=mask)
+            return jnp.sum(jnp.sin(out.astype(jnp.float32)))
+
+        def loss_ref(q, k, v):
+            out = dot_product_attention(q, k, v, mask=mask)
+            return jnp.sum(jnp.sin(out.astype(jnp.float32)))
+
+        ref = dot_product_attention(qf, kf, vf, mask=mask)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(qf, kf, vf)
+        with monkeypatch.context() as mp:
+            mp.setattr(fa, "MAX_SEQ_VMEM", seq_vmem)
+            mp.setattr(fa, "BLOCK_Q_KB", 128)
+            mp.setattr(fa, "BLOCK_K_KB", 128)
+            out = fa.flash_attention(q, k, v, mask=mask)
+            np.testing.assert_allclose(
+                np.asarray(out, np.float32), np.asarray(ref),
+                rtol=2e-2, atol=2e-2, err_msg=regime)
+            g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+            for name, a, b in zip("qkv", g_fl, g_ref):
+                np.testing.assert_allclose(
+                    np.asarray(a, np.float32), np.asarray(b),
+                    rtol=6e-2, atol=6e-2, err_msg=f"{regime} d{name}")
+
+
 def test_kblocked_segmented_ring_matches_reference(devices, monkeypatch):
     """Packed segments + ring + K-blocked chunk kernels: force every ring
     chunk through the streaming kernels (MAX_SEQ_VMEM→64, FLASH_CHUNK_MIN
@@ -283,6 +333,8 @@ def test_kblocked_segmented_ring_matches_reference(devices, monkeypatch):
     monkeypatch.setattr(fa, "MAX_SEQ_VMEM", 32)
     monkeypatch.setattr(fa, "BLOCK_Q", 16)
     monkeypatch.setattr(fa, "BLOCK_K", 16)
+    monkeypatch.setattr(fa, "BLOCK_Q_KB", 16)
+    monkeypatch.setattr(fa, "BLOCK_K_KB", 16)
     monkeypatch.setattr(ring, "FLASH_CHUNK_MIN", 0)
     mesh = create_mesh(MeshConfig(data=2, seq=4))
     b, s = 2, 256
