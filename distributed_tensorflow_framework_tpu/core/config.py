@@ -220,7 +220,11 @@ class DataConfig:
     # decode/augment work overlaps device steps (data/infeed.py). The
     # batch/snapshot pairing and order are identical to the synchronous
     # prefetcher; disable when debugging host-side pipeline errors (they
-    # surface with a cleaner stack synchronously).
+    # surface with a cleaner stack synchronously). NOTE: not implicated
+    # in the XLA:CPU rendezvous freezes on oversubscribed virtual-device
+    # hosts — an 8-device MoE run froze with async_infeed=false too; see
+    # core/platform.py for that failure class and the bounded-terminate +
+    # checkpoint-restart mitigation.
     async_infeed: bool = True
     seed: int = 0
     # text / MLM
@@ -255,6 +259,16 @@ class CheckpointConfig:
 class TrainConfig:
     total_steps: int = 100
     log_interval: int = 10
+    # Backpressure on async step dispatch: at most this many steps may be
+    # in flight on the device queue; the host then syncs on the OLDEST
+    # pending step (a scalar device_get — the axon-safe sync) before
+    # dispatching the next. Without a bound the host runs ahead by a full
+    # log_interval (observed: 250 queued multi-device programs, 35 s
+    # metric drains, and amplified XLA:CPU collective-rendezvous freezes
+    # on oversubscribed virtual-device hosts). 64 never binds on real
+    # TPU steps; set ~8 for long CPU-mesh runs. 0 = unbounded (the old
+    # behavior).
+    dispatch_ahead: int = 64
     eval_interval: int = 0        # 0 disables mid-training eval
     # Batches per MID-TRAINING eval firing, and the fallback length for
     # infinite (synthetic) eval streams. The final eval and --eval-only

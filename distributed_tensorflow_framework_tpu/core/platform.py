@@ -9,10 +9,17 @@ from __future__ import annotations
 
 # XLA:CPU aborts a collective whose participants don't all reach the
 # rendezvous within ~40 s (`rendezvous.cc` termination timeout). On small
-# hosts running N virtual devices (N threads time-sharing few cores) a
-# scheduling stall trips it mid-training — observed twice on 8-device MoE
-# runs on a 1-core VM. These defaults make starvation a slowdown instead
-# of a crash; anything the user already put in XLA_FLAGS wins.
+# hosts running N virtual devices (N threads time-sharing few cores) the
+# default trips mid-training — observed repeatedly on 8-device MoE
+# runs on a 1-core VM. These defaults keep transient scheduling stalls
+# from aborting short runs; anything the user already put in XLA_FLAGS
+# wins. KNOWN LIMIT: some long-run freezes are NOT transient — a
+# participant blocks permanently at an all-reduce with zero CPU load
+# (intermittent; reproduced with async AND sync infeed). For those, the
+# working recipe is the opposite tuning: a LOW terminate timeout (e.g.
+# 240 s) plus frequent checkpoints and a relaunch loop, so the
+# framework's auto-restore turns each freeze into a bounded restart —
+# fault recovery doing its job rather than a hang.
 CPU_COLLECTIVE_TIMEOUT_FLAGS: tuple[tuple[str, int], ...] = (
     ("xla_cpu_collective_call_warn_stuck_timeout_seconds", 120),
     ("xla_cpu_collective_call_terminate_timeout_seconds", 1200),

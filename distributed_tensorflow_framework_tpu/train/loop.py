@@ -15,6 +15,7 @@ two differences that matter on TPU:
 
 from __future__ import annotations
 
+import collections
 import logging
 from typing import Any
 
@@ -191,12 +192,24 @@ class Trainer:
         # cheap always-on signal for "is the input pipeline the wall?"
         # (SURVEY.md §7 hard part 1) without capturing a trace.
         timer = profiling.StepTimer()
+        # Bounded dispatch-ahead (train.dispatch_ahead): a deque of each
+        # in-flight step's metrics; once full, sync on the OLDEST entry
+        # before dispatching another step. The sync is a scalar
+        # device_get, never block_until_ready (the axon tunnel returns
+        # early from the latter — bench.py documents the same rule).
+        pending: collections.deque = collections.deque()
         try:
             while self.host_step < cfg.total_steps:
                 with timer.phase("infeed"):
                     batch, self.data_ckpt_state = next(infeed)
+                if cfg.dispatch_ahead > 0 and len(pending) >= cfg.dispatch_ahead:
+                    with timer.phase("backpressure"):
+                        float(jax.device_get(
+                            next(iter(pending.popleft().values()))))
                 with timer.phase("dispatch"), profiling.annotate("train_step"):
                     self.state, metrics = self.train_step(self.state, batch)
+                if cfg.dispatch_ahead > 0:
+                    pending.append(metrics)
                 self.host_step += 1
                 fetch = (
                     self.host_step % cfg.log_interval == 0
@@ -204,8 +217,9 @@ class Trainer:
                 )
                 host_metrics = None
                 if fetch:
-                    # Only here does the host sync with the device;
-                    # off-interval steps dispatch asynchronously.
+                    # Only here does the host fully sync with the device;
+                    # off-interval steps dispatch asynchronously (at most
+                    # dispatch_ahead deep).
                     with timer.phase("metrics_fetch"):
                         host_metrics = {
                             k: float(v)
@@ -214,6 +228,7 @@ class Trainer:
                     host_metrics.update(timer.means())
                     timer.reset()
                     last_metrics = host_metrics
+                    pending.clear()
                 for h in hooks:
                     h.after_step(self, self.host_step, host_metrics)
         finally:

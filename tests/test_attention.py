@@ -275,6 +275,22 @@ def test_kblocked_kernels_match_whole_k(devices, monkeypatch):
                                    rtol=2e-4, atol=2e-4, err_msg=f"d{name}")
 
 
+def test_pick_block_divisor_policy():
+    """Streaming-tile picker: largest 128-multiple ≤ target dividing s;
+    sub-128 env targets clamp to 128 instead of dividing by zero; short
+    sequences pass through whole."""
+    from distributed_tensorflow_framework_tpu.ops.flash_attention import (
+        _pick_block,
+    )
+
+    assert _pick_block(8192, 1024) == 1024
+    assert _pick_block(8192, 512) == 512
+    assert _pick_block(4224, 1024) == 384      # 33·128: divisor fallback
+    assert _pick_block(4352, 1024) == 256      # 34·128: 2·128 divides
+    assert _pick_block(256, 64) == 128         # sub-128 target clamps
+    assert _pick_block(96, 1024) == 96         # short chunk passes through
+
+
 def test_bf16_inputs_match_f32_reference(devices, monkeypatch):
     """Production dtype through BOTH kernel regimes: the round-4 kernels
     dot in the INPUT dtype (bf16 on TPU) and downcast the p/ds softmax

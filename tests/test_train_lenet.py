@@ -50,6 +50,23 @@ def test_lenet_loss_decreases(devices, spmd_mode, tmp_path):
     )
 
 
+def test_dispatch_ahead_backpressure_identical(devices):
+    """train.dispatch_ahead bounds the async dispatch queue (the host
+    syncs on the oldest in-flight step's metrics) without changing any
+    math: a tightly-bounded run must reproduce the unbounded run's final
+    loss bit-for-bit, and the backpressure phase must appear in the
+    timing metrics."""
+    results = {}
+    for ahead in (0, 2):
+        cfg = lenet_config(**{"train.total_steps": 12,
+                              "train.log_interval": 6,
+                              "train.dispatch_ahead": ahead})
+        trainer = Trainer(cfg)
+        results[ahead] = trainer.train()
+    assert results[0]["loss"] == results[2]["loss"]
+    assert "time_backpressure_ms" in results[2]
+
+
 def test_bfloat16_infeed(devices):
     """data.image_dtype=bfloat16 (the HBM-bandwidth lever, bench.py) must
     flow through pipeline → infeed → step."""
