@@ -205,13 +205,14 @@ def _ragged_mlm_batch(batch_size: int, seq_len: int, pack: int) -> dict:
 
 def bench_bert(batch_size: int, steps: int = 20, warmup: int = 3,
                *, seq_len: int = 512, attention_impl: str = "pallas",
-               remat: bool = False, pack: int = 0) -> dict:
+               remat: bool = False, pack: int = 0,
+               fused_qkv: bool = False) -> dict:
     """BERT-base MLM train-step throughput — the transformer side of the
     perf story. Measured on v5e it saturates NEITHER roofline (MFU ~27%,
     HBM ~41%): the step is fragmented across medium GEMMs, so the lever
     is fatter per-matmul work, not bandwidth (PERF_NOTES.md round 3).
     Knobs via env in main(): BENCH_ATTN (pallas|xla|ring), BENCH_REMAT=1,
-    BENCH_SEQ=<len>, BENCH_BS=<per-chip batch>, BENCH_PACK
+    BENCH_SEQ=<len>, BENCH_BS=<per-chip batch>, BENCH_FUSED_QKV=1, BENCH_PACK
     (0 = dense synthetic rows; 1 = ragged docs unpacked — the padding
     baseline; n>1 = same doc distribution packed n-to-1)."""
     from distributed_tensorflow_framework_tpu.core.config import load_config
@@ -228,7 +229,7 @@ def bench_bert(batch_size: int, steps: int = 20, warmup: int = 3,
                       "hidden_size": 768, "num_layers": 12, "num_heads": 12,
                       "mlp_dim": 3072, "max_seq_len": seq_len,
                       "dtype": "bfloat16", "attention_impl": attention_impl,
-                      "remat": remat},
+                      "remat": remat, "fused_qkv": fused_qkv},
             "data": {"name": "synthetic_mlm", "global_batch_size": batch_size,
                      "seq_len": seq_len},
             "optimizer": {"name": "adamw", "learning_rate": 1e-4,
@@ -388,16 +389,21 @@ def main() -> int:
     if workload == "bert":
         # The transformer workload (kept OFF the driver's default path —
         # the ONE default JSON line stays ResNet, the tracked BASELINE
-        # metric). Knobs: BENCH_ATTN, BENCH_REMAT, BENCH_SEQ, BENCH_BS.
+        # metric). Knobs: BENCH_ATTN, BENCH_REMAT, BENCH_SEQ, BENCH_BS,
+        # BENCH_FUSED_QKV, BENCH_PACK.
         seq = int(os.environ.get("BENCH_SEQ", "512"))
         attn = os.environ.get("BENCH_ATTN", "pallas")
         remat = os.environ.get("BENCH_REMAT", "0") not in ("", "0")
         pack = int(os.environ.get("BENCH_PACK", "0"))
+        # One (H,3H) projection GEMM per layer instead of three (H,H) —
+        # the fragmentation-lever candidate (models/bert.py).
+        fused_qkv = os.environ.get("BENCH_FUSED_QKV", "0") not in ("", "0")
         ladder = _ladder_override(
             (64 * n_chips, 32 * n_chips, 16 * n_chips), n_chips)
         result = _run_ladder(
             lambda bs: bench_bert(bs, seq_len=seq, attention_impl=attn,
-                                  remat=remat, pack=pack),
+                                  remat=remat, pack=pack,
+                                  fused_qkv=fused_qkv),
             ladder, metric, unit, chip)
         if result is None:
             return 1

@@ -164,6 +164,12 @@ class ModelConfig:
     # "pallas" (fused flash-attention kernel, ops/flash_attention.py) or
     # "ring" (sequence-parallel ring attention over the seq mesh axis).
     attention_impl: str = "xla"
+    # Fuse the q/k/v projections into one (H, 3H) GEMM (bert models):
+    # fewer, fatter MXU calls on a GEMM-fragmentation-bound step;
+    # column-block-exact vs the separate projections (parity-tested).
+    # Changes the parameter tree (qkv/kernel replaces query|key|value), so
+    # checkpoints are not interchangeable across this flag.
+    fused_qkv: bool = False
     # Mixture-of-Experts (models/moe.py): 0 = dense FFN everywhere; >0 =
     # every `moe_every`-th encoder layer uses an expert-parallel MoE FFN
     # routed top-`expert_topk` with per-group capacity `capacity_factor`.

@@ -181,6 +181,7 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
                 num_stages=config.pipeline_stages,
                 num_microbatches=config.pipeline_microbatches,
                 attention_impl=config.attention_impl,
+                fused_qkv=config.fused_qkv,
             )
         from distributed_tensorflow_framework_tpu.models.bert import BertForMLM
 
@@ -195,6 +196,7 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             dtype=dtype,
             attention_impl=config.attention_impl,
             mesh=mesh,
+            fused_qkv=config.fused_qkv,
             num_experts=config.num_experts,
             moe_every=config.moe_every,
             expert_topk=config.expert_topk,

@@ -56,6 +56,17 @@ class MultiHeadAttention(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_impl: str = "xla"
     mesh: Any = None  # required for attention_impl="ring"
+    # One (H, 3·H) projection GEMM instead of three (H, H) — fewer,
+    # fatter MXU calls on a step whose measured limit is GEMM
+    # fragmentation, not a roofline (PERF_NOTES.md BERT analysis).
+    # Column-block-exact: the fused output's q/k/v slices equal the
+    # separate projections (parity-tested by weight transplant in
+    # tests/test_models.py). The kernel is laid out (H, 3, H) so the TP
+    # rule shards the LAST axis: every model-axis shard holds its own
+    # q/k/v column slice and the split below stays shard-local — a flat
+    # (H, 3H) layout would put whole projections on single shards and
+    # force per-layer resharding under TP.
+    fused_qkv: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, segment_ids=None):
@@ -65,9 +76,20 @@ class MultiHeadAttention(nn.Module):
             h, dtype=self.dtype, param_dtype=jnp.float32,
             kernel_init=dense_kernel_init, name=name,
         )
-        q = dense("query")(x).reshape(b, s, self.num_heads, head_dim)
-        k = dense("key")(x).reshape(b, s, self.num_heads, head_dim)
-        v = dense("value")(x).reshape(b, s, self.num_heads, head_dim)
+        if self.fused_qkv:
+            # DenseGeneral flattens the kernel to (H, 3*H) before calling
+            # kernel_init, so fan_in is H — identical init statistics to
+            # the three separate projections.
+            qkv = nn.DenseGeneral(
+                features=(3, h), dtype=self.dtype, param_dtype=jnp.float32,
+                kernel_init=dense_kernel_init, name="qkv",
+            )(x)                                   # (B, S, 3, H)
+            q, k, v = (qkv[..., i, :].reshape(b, s, self.num_heads, head_dim)
+                       for i in range(3))
+        else:
+            q = dense("query")(x).reshape(b, s, self.num_heads, head_dim)
+            k = dense("key")(x).reshape(b, s, self.num_heads, head_dim)
+            v = dense("value")(x).reshape(b, s, self.num_heads, head_dim)
 
         if self.attention_impl == "pallas":
             from distributed_tensorflow_framework_tpu.ops.flash_attention import (
@@ -99,6 +121,7 @@ class EncoderLayer(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_impl: str = "xla"
     mesh: Any = None
+    fused_qkv: bool = False
     # MoE FFN (models/moe.py): 0 = dense MLP; >0 = expert-parallel MoE.
     num_experts: int = 0
     expert_topk: int = 2
@@ -111,7 +134,8 @@ class EncoderLayer(nn.Module):
         # can mark it static by argnum (BertForMLM.remat).
         attn = MultiHeadAttention(
             self.num_heads, dtype=self.dtype,
-            attention_impl=self.attention_impl, mesh=self.mesh, name="attn",
+            attention_impl=self.attention_impl, mesh=self.mesh,
+            fused_qkv=self.fused_qkv, name="attn",
         )(x, mask, segment_ids)
         attn = nn.Dropout(self.dropout_rate, deterministic=not train)(attn)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x + attn)
@@ -205,6 +229,7 @@ class BertForMLM(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_impl: str = "xla"
     mesh: Any = None
+    fused_qkv: bool = False
     # MoE: with num_experts>0, every `moe_every`-th layer (from the top of
     # each group) uses an expert-parallel FFN; returns a dict with the
     # load-balancing aux loss alongside the logits.
@@ -262,7 +287,7 @@ class BertForMLM(nn.Module):
             x, aux = layer_cls(
                 self.num_heads, self.mlp_dim, self.dropout_rate,
                 dtype=self.dtype, attention_impl=self.attention_impl,
-                mesh=self.mesh,
+                mesh=self.mesh, fused_qkv=self.fused_qkv,
                 num_experts=self.num_experts if use_moe else 0,
                 expert_topk=self.expert_topk,
                 capacity_factor=self.capacity_factor,

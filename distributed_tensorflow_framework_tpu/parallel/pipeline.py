@@ -172,7 +172,7 @@ class PipelinedBert:
                  num_heads: int, mlp_dim: int, max_seq_len: int,
                  dropout_rate: float, dtype: Any, mesh,
                  num_stages: int, num_microbatches: int,
-                 attention_impl: str = "xla"):
+                 attention_impl: str = "xla", fused_qkv: bool = False):
         if mesh is None:
             raise ValueError("PipelinedBert needs the physical mesh")
         if num_layers % num_stages:
@@ -198,7 +198,8 @@ class PipelinedBert:
         self.embed = BertEmbed(vocab_size, hidden_size, max_seq_len,
                                dropout_rate, dtype)
         self.layer = EncoderLayer(num_heads, mlp_dim, dropout_rate,
-                                  dtype=dtype, attention_impl=attention_impl)
+                                  dtype=dtype, attention_impl=attention_impl,
+                                  fused_qkv=fused_qkv)
         self.head = MLMHead(vocab_size, hidden_size, dtype)
 
     # ---------------------------------------------------- flax-like API --

@@ -31,6 +31,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # MLP-in (shard output features), row-parallel attn-out and MLP-out (shard
 # input features). Patterns are matched against "/".join(param path).
 TP_RULES: list[tuple[str, tuple[str | None, ...]]] = [
+    # Fused projection (models/bert.py fused_qkv): kernel is (H, 3, H)
+    # with q/k/v interleaved on the middle axis precisely so TP shards
+    # the LAST axis — each model shard then holds its own q/k/v column
+    # slice and the in-layer split is shard-local (no resharding). Rules
+    # apply only at matching rank (_match_rules), so a flat (H, 3H) qkv
+    # from an external model still takes the rank-2 rule below.
+    (r".*qkv/kernel$", (None, None, "model")),
     (r".*(query|key|value|qkv)/kernel$", (None, "model")),
     (r".*attn_out/kernel$", ("model", None)),
     (r".*mlp_in/kernel$", (None, "model")),
@@ -68,6 +75,11 @@ def _match_rules(
 ) -> P | None:
     for pattern, spec in rules:
         if re.match(pattern, path):
+            if len(spec) != len(shape):
+                # Rank-mismatched rule: keep looking (e.g. the rank-3
+                # fused-qkv rule must not half-apply to a rank-2 kernel
+                # via zip truncation — silent TP loss).
+                continue
             # Drop axes that are absent/trivial in the mesh or don't divide
             # evenly (falls back to replication on that dim, not failure).
             fixed = []

@@ -63,3 +63,69 @@ def test_resnet50_forward_shape_dtype(devices):
     assert "batch_stats" in variables  # BN present
     # bf16 compute path: stem conv kernel stays fp32 (param_dtype)
     assert variables["params"]["stem"]["conv"]["kernel"].dtype == jnp.float32
+
+
+def test_fused_qkv_transplant_parity():
+    """model.fused_qkv packs the q/k/v projections into one (H, 3H) GEMM.
+    Column-block exactness: transplanting an unfused model's weights into
+    the fused layout (kernels/biases concatenated along the output axis)
+    must reproduce its logits — same math, fewer GEMMs."""
+    import numpy as np
+
+    common = dict(name="bert", vocab_size=128, hidden_size=32, num_layers=2,
+                  num_heads=2, mlp_dim=64, max_seq_len=16, dtype="float32")
+    cfg_sep = ModelConfig(**common)
+    cfg_fused = ModelConfig(**common, fused_qkv=True)
+    m_sep = get_model(cfg_sep)
+    m_fused = get_model(cfg_fused)
+    rng = jax.random.key(3)
+    ids = jax.random.randint(rng, (2, 16), 0, 128)
+    vars_sep = m_sep.init({"params": rng, "dropout": rng}, ids, train=False)
+    params = jax.device_get(vars_sep["params"])
+    fused_params = {}
+    for k, v in params.items():
+        if not k.startswith("layer"):
+            fused_params[k] = v
+            continue
+        attn = dict(v["attn"])
+        # Fused layout is (H, 3, H) — q/k/v interleaved on the middle axis
+        # so TP shards the last axis (parallel/sharding.py qkv rule).
+        qkv = {
+            "kernel": np.stack(
+                [attn["query"]["kernel"], attn["key"]["kernel"],
+                 attn["value"]["kernel"]], axis=1),
+            "bias": np.stack(
+                [attn["query"]["bias"], attn["key"]["bias"],
+                 attn["value"]["bias"]], axis=0),
+        }
+        new_attn = {kk: vv for kk, vv in attn.items()
+                    if kk not in ("query", "key", "value")}
+        new_attn["qkv"] = qkv
+        fused_params[k] = {**v, "attn": new_attn}
+    out_sep = m_sep.apply(vars_sep, ids, train=False)
+    out_fused = m_fused.apply({"params": fused_params}, ids, train=False)
+    np.testing.assert_allclose(np.asarray(out_fused), np.asarray(out_sep),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_fused_qkv_tp_sharding_rule():
+    """The qkv kernel's TP spec must shard the LAST axis (q/k/v stay
+    shard-local under tensor parallelism), not the middle stacking axis a
+    rank-2 rule would hit."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_framework_tpu.parallel.sharding import (
+        TP_RULES, _match_rules,
+    )
+    from distributed_tensorflow_framework_tpu.core.config import MeshConfig
+    from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+
+    mesh = create_mesh(MeshConfig(data=4, model=2))
+    m = mesh.mesh if hasattr(mesh, "mesh") else mesh
+    spec = _match_rules("layer0/attn/qkv/kernel", (32, 3, 32), m, TP_RULES)
+    assert spec == P(None, None, "model"), spec
+    # A flat rank-2 qkv (external models) must fall through to the
+    # rank-2 column-parallel rule, not half-apply the rank-3 one.
+    spec2 = _match_rules("layer0/attn/qkv/kernel", (32, 96), m, TP_RULES)
+    assert spec2 == P(None, "model"), spec2
