@@ -9,7 +9,7 @@ host with per-replica infeed").
 
 from __future__ import annotations
 
-from typing import Any, Mapping
+from typing import Mapping
 
 import jax
 import numpy as np

@@ -19,7 +19,6 @@ models (tested in tests/test_train_lenet.py::test_jit_and_shard_map_agree).
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable
 
 import jax
