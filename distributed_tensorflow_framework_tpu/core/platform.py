@@ -26,10 +26,20 @@ CPU_COLLECTIVE_TIMEOUT_FLAGS: tuple[tuple[str, int], ...] = (
 )
 
 
-def with_cpu_collective_timeouts(flags: str) -> str:
-    """Append the rendezvous-timeout defaults to an XLA_FLAGS string,
-    skipping any flag the caller already set."""
-    for name, value in CPU_COLLECTIVE_TIMEOUT_FLAGS:
+FAST_FAIL_COLLECTIVE_FLAGS: tuple[tuple[str, int], ...] = (
+    # The retry-loop tuning (scripts/train_resilient.py): fast death +
+    # relaunch beats a 20-minute hang when auto-restore is standing by.
+    ("xla_cpu_collective_call_warn_stuck_timeout_seconds", 60),
+    ("xla_cpu_collective_call_terminate_timeout_seconds", 240),
+)
+
+
+def with_cpu_collective_timeouts(flags: str, table=None) -> str:
+    """Append rendezvous-timeout flags to an XLA_FLAGS string, skipping
+    any flag the caller already set. ``table`` defaults to the
+    long-run-tolerant values; pass FAST_FAIL_COLLECTIVE_FLAGS for the
+    relaunch-loop tuning."""
+    for name, value in (table or CPU_COLLECTIVE_TIMEOUT_FLAGS):
         if name not in flags:
             flags += f" --{name}={value}"
     return flags.strip()
