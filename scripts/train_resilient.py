@@ -72,8 +72,15 @@ def main(argv=None) -> int:
         parser.error("no command given (put it after `--`)")
     if args.max_attempts < 1:
         parser.error("--max-attempts must be >= 1")
-    if not any("checkpoint.directory=" in a and
-               not a.rstrip().endswith("checkpoint.directory=") for a in cmd):
+    explicit_off = any(a.rstrip().endswith("checkpoint.directory=")
+                       for a in cmd)
+    has_dir = any("checkpoint.directory=" in a
+                  and not a.rstrip().endswith("checkpoint.directory=")
+                  for a in cmd)
+    # A --config YAML may enable checkpointing itself (all shipped
+    # configs do), so only warn when checkpointing is explicitly off or
+    # visibly absent with no config to supply it.
+    if explicit_off or (not has_dir and "--config" not in cmd):
         print("train_resilient: WARNING — no checkpoint.directory in the "
               "command; every relaunch will restart from step 0",
               file=sys.stderr)
@@ -88,6 +95,12 @@ def main(argv=None) -> int:
             # SIGABRT → -6): report the shell's 128+signal convention so
             # outer automation can classify the failure (134 = SIGABRT).
             rc = 128 - rc
+        if rc in (130, 143):
+            # SIGINT/SIGTERM are CANCELLATION, not infrastructure
+            # failure — honor the operator instead of relaunching.
+            print(f"train_resilient: child cancelled (rc={rc}) — "
+                  "not retrying", file=sys.stderr)
+            return rc
         if rc == 0:
             print(f"train_resilient: done (attempt {attempt})",
                   file=sys.stderr)

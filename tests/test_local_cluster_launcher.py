@@ -175,3 +175,40 @@ def test_four_process_zero1_ckpt_resume(tmp_path):
     got = _step_metrics(
         (tmp_path / "w-leg2" / "worker-0.log").read_text(), 8)
     assert got == want  # bit-exact resume across 4 process boundaries
+
+
+@pytest.mark.slowest
+def test_two_process_ring_attention(tmp_path):
+    """Long-context over the PROCESS boundary: 2 processes x 1 device
+    with mesh.seq=2 puts the two sequence shards in different processes,
+    so every ring ppermute (K/V and mask rotation) and the final merge
+    cross the jax.distributed transport — the DCN shape of the
+    long-context story, which the single-process 8-device ring tests
+    cannot exercise. Both workers must finish 4 steps with finite loss."""
+    r = _run(tmp_path,
+             "--set", "model.name=bert",
+             "--set", "model.vocab_size=256",
+             "--set", "model.hidden_size=32", "--set", "model.num_layers=2",
+             "--set", "model.num_heads=2", "--set", "model.mlp_dim=64",
+             "--set", "model.max_seq_len=256", "--set", "model.dtype=float32",
+             "--set", "model.attention_impl=ring",
+             "--set", "data.name=synthetic_mlm",
+             "--set", "data.vocab_size=256", "--set", "data.seq_len=256",
+             "--set", "data.global_batch_size=4",
+             "--set", "train.total_steps=4",
+             "--set", "train.log_interval=2",
+             "--set", "train.eval_steps=0", "--set", "train.eval_interval=0",
+             "--set", "checkpoint.directory=",
+             "--set", "mesh.data=1", "--set", "mesh.seq=2",
+             procs=2, devices_per_proc=1, timeout=420)
+    assert r.returncode == 0, r.stderr[-3000:]
+    chief = (tmp_path / "worker-0.log").read_text()
+    assert "1 local / 2 global devices" in chief, chief[-2000:]
+    m = re.search(r"step 4: .*loss=(\S+)", chief)
+    assert m, chief[-2000:]
+    import math
+
+    assert math.isfinite(float(m.group(1))), f"loss={m.group(1)}"
+    for i in (0, 1):
+        log = (tmp_path / f"worker-{i}.log").read_text()
+        assert "final train metrics" in log, log[-2000:]

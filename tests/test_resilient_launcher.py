@@ -96,3 +96,20 @@ def test_signal_death_maps_to_shell_convention():
              "import os, signal; os.kill(os.getpid(), signal.SIGABRT)"])
     assert r.returncode == 134, r.returncode
     assert "exited rc=134" in r.stderr
+
+
+def test_config_yaml_suppresses_checkpoint_warning():
+    # A --config may set checkpoint.directory in YAML — don't cry wolf.
+    r = run(["--max-attempts", "1", "--",
+             sys.executable, "-c", "print('x')",
+             "--config", "configs/bert_base_mlm.yaml"])
+    assert "no checkpoint.directory" not in r.stderr
+
+
+def test_cancellation_not_retried():
+    r = run(["--max-attempts", "5", "--retry-sleep", "0.1", "--",
+             sys.executable, "-c",
+             "import os, signal; os.kill(os.getpid(), signal.SIGTERM)"])
+    assert r.returncode == 143
+    assert "cancelled" in r.stderr
+    assert "attempt 2" not in r.stderr
