@@ -201,9 +201,16 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
     # end-to-end into b rows and emits per-row segment ids for
     # block-diagonal attention — fewer pad positions per step means more
     # useful tokens through the same GEMMs (PERF_NOTES.md BERT findings).
-    # Eval streams stay unpacked: the exact-eval contract counts real
-    # masked tokens either way, and unpacked rows keep per-document
-    # metrics comparable across configs.
+    # Eval streams stay unpacked — a deliberate non-feature, not an
+    # omission: (a) the exact-eval contract counts real masked tokens
+    # either way, and unpacked rows keep per-document metrics comparable
+    # across configs; (b) packing would make the eval batch count
+    # DATA-DEPENDENT per host, but the multi-host exact-eval machinery
+    # requires a fixed cardinality every host agrees on up front
+    # (eval_batches_all_hosts) — hosts running different step counts
+    # desync collectives. A packed eval would need a pre-pass packing
+    # plan plus a cross-host max; the ~3x eval-throughput win does not
+    # justify that risk to the exactness story.
     pack = config.pack_factor if train else 1
 
     # Wrap with host-side dynamic masking (rng keyed off batch counter so
