@@ -414,6 +414,7 @@ def main() -> int:
             # No reference-published BERT number exists (BASELINE.md);
             # report the absolute rates and roofline position instead.
             "vs_baseline": 0.0,
+            "baseline_kind": "none",
             "chip": chip,
             "num_chips": n_chips,
             "seq_len": seq,
@@ -446,6 +447,12 @@ def main() -> int:
         "value": round(per_chip, 2),
         "unit": unit,
         "vs_baseline": round(per_chip / TARGET_PER_CHIP, 4),
+        # vs_baseline comparator: BASELINE.json publishes no measured
+        # reference number (published: {}), so the denominator is the
+        # north-star TARGET slice (10k img/s aggregate on v5e-64 →
+        # 156.25/chip), NOT a measured reference (VERDICT r4 weak #5).
+        "baseline_kind": "north-star-target",
+        "baseline_value": TARGET_PER_CHIP,
         "chip": chip,
         "num_chips": n_chips,
     }

@@ -22,6 +22,7 @@ import time
 import jax
 import jax.numpy as jnp
 
+from distributed_tensorflow_framework_tpu.ops import flash_attention as _fa
 from distributed_tensorflow_framework_tpu.parallel import ring
 
 B, H, D = 4, 12, 64
@@ -45,9 +46,10 @@ def time_impl(c: int, use_flash: bool) -> float:
         saved = ring.FLASH_CHUNK_MIN
         ring.FLASH_CHUNK_MIN = 0 if use_flash else 10**9
         try:
-            return ring._chunk_attention(q, k, v, bias)
+            out = ring._chunk_attention(q, k, v, bias)
         finally:
             ring.FLASH_CHUNK_MIN = saved
+        return out
 
     @jax.jit
     def fwd_bwd(q, k, v, bias):
@@ -73,6 +75,24 @@ def main() -> None:
     print(f"chunk fwd+bwd median ms (B={B} H={H} D={D}, reps={REPS}), "
           f"dispatch FLASH_CHUNK_MIN={ring.FLASH_CHUNK_MIN}")
     for c in chunks:
+        # Above MAX_SEQ_VMEM the dispatch routes to the flash kernels even
+        # with FLASH_CHUNK_MIN pinned high (ring._chunk_attention's
+        # `c > MAX_SEQ_VMEM` clause), so an "xla" timing there would
+        # silently be a flash timing — and honestly forcing the XLA chain
+        # would materialize a c x c f32 score block (12.9 GB at 8192).
+        # Refuse instead (ADVICE r4).
+        if c > _fa.MAX_SEQ_VMEM:
+            if not _fa.chunk_supported(c):
+                print(f"chunk {c:5d}: skipped — exceeds "
+                      f"MAX_SEQ_VMEM={_fa.MAX_SEQ_VMEM} but is not a "
+                      f"BLOCK_Q multiple, so neither arm can take it")
+                continue
+            flash_ms = time_impl(c, use_flash=True)
+            print(f"chunk {c:5d}: xla      n/a ms   flash {flash_ms:8.2f} ms"
+                  f"   -> flash (xla arm refused: chunk > "
+                  f"MAX_SEQ_VMEM={_fa.MAX_SEQ_VMEM} would materialize a "
+                  f"{c}x{c} score block)")
+            continue
         xla_ms = time_impl(c, use_flash=False)
         flash_ms = time_impl(c, use_flash=True)
         winner = "flash" if flash_ms < xla_ms else "xla"

@@ -78,9 +78,27 @@ def main(argv=None) -> int:
                   and not a.rstrip().endswith("checkpoint.directory=")
                   for a in cmd)
     # A --config YAML may enable checkpointing itself (all shipped
-    # configs do), so only warn when checkpointing is explicitly off or
-    # visibly absent with no config to supply it.
-    if explicit_off or (not has_dir and "--config" not in cmd):
+    # configs do) — but a user YAML may also leave it disabled, so parse
+    # the YAML instead of assuming (ADVICE r4). Unreadable/odd YAMLs get
+    # the benefit of the doubt (the trainer will fail loudly on them).
+    config_path = None
+    for i, a in enumerate(cmd):
+        if a == "--config" and i + 1 < len(cmd):
+            config_path = cmd[i + 1]
+        elif a.startswith("--config="):
+            config_path = a.split("=", 1)[1]
+    config_has_dir = False
+    if config_path is not None:
+        config_has_dir = True  # assume-on unless we can prove otherwise
+        try:
+            import yaml
+            with open(config_path) as f:
+                doc = yaml.safe_load(f) or {}
+            config_has_dir = bool(
+                (doc.get("checkpoint") or {}).get("directory"))
+        except Exception:
+            pass
+    if explicit_off or (not has_dir and not config_has_dir):
         print("train_resilient: WARNING — no checkpoint.directory in the "
               "command; every relaunch will restart from step 0",
               file=sys.stderr)

@@ -106,6 +106,24 @@ def test_config_yaml_suppresses_checkpoint_warning():
     assert "no checkpoint.directory" not in r.stderr
 
 
+def test_config_yaml_without_checkpoint_dir_warns(tmp_path):
+    # A user YAML with checkpointing disabled must NOT suppress the
+    # warning — the launcher parses the YAML instead of assuming any
+    # --config enables checkpointing (ADVICE r4).
+    cfg = tmp_path / "no_ckpt.yaml"
+    cfg.write_text("model:\n  name: lenet5\ncheckpoint:\n  directory: ''\n")
+    r = run(["--max-attempts", "1", "--",
+             sys.executable, "-c", "print('x')",
+             "--config", str(cfg)])
+    assert "no checkpoint.directory" in r.stderr
+    # An unreadable --config keeps the benefit of the doubt (the trainer
+    # itself fails loudly on it).
+    r2 = run(["--max-attempts", "1", "--",
+              sys.executable, "-c", "print('x')",
+              "--config", str(tmp_path / "missing.yaml")])
+    assert "no checkpoint.directory" not in r2.stderr
+
+
 def test_cancellation_not_retried():
     r = run(["--max-attempts", "5", "--retry-sleep", "0.1", "--",
              sys.executable, "-c",
