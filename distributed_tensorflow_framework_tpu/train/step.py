@@ -360,6 +360,15 @@ class StepBuilder:
         metrics = dict(metrics)
         metrics["grad_norm"] = coll.global_norm(grads)
         metrics["learning_rate"] = self.schedule(state.step)
+        stages = self.config.model.pipeline_stages
+        if stages > 1:
+            # GPipe schedule bubble: (S-1) of the (M+S-1) scan steps per
+            # direction run with at least one idle stage. Static for a
+            # static schedule — logged per step so PP runs carry their
+            # fill-drain overhead in the metric stream (VERDICT r4 #6).
+            micro = self.config.model.pipeline_microbatches or stages
+            metrics["pipe_bubble_frac"] = jnp.float32(
+                (stages - 1) / (micro + stages - 1))
         ema_decay = self.config.optimizer.ema_decay
         if ema_decay > 0:
             # tf.train.ExponentialMovingAverage(num_updates=step) schedule:

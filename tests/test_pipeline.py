@@ -120,6 +120,9 @@ def test_pipeline_trains_dp_pp(pp_mesh):
         assert np.isfinite(float(m["loss"]))
         prev = float(m["loss"])
     assert prev is not None
+    # GPipe schedule bubble is logged per step: S=4 stages, M=4
+    # microbatches (defaulted from stages) → (S-1)/(M+S-1) = 3/7.
+    assert abs(float(m["pipe_bubble_frac"]) - 3.0 / 7.0) < 1e-6
     eval_step = builder.make_eval_step(batch)
     em = jax.device_get(eval_step(state, batch))
     assert float(em["weight_sum"]) > 0
