@@ -271,10 +271,14 @@ class TrainConfig:
     # dispatching the next. Without a bound the host runs ahead by a full
     # log_interval (observed: 250 queued multi-device programs, 35 s
     # metric drains, and amplified XLA:CPU collective-rendezvous freezes
-    # on oversubscribed virtual-device hosts). 64 never binds on real
-    # TPU steps; set ~8 for long CPU-mesh runs. 0 = unbounded (the old
-    # behavior).
-    dispatch_ahead: int = 64
+    # on oversubscribed virtual-device hosts). Default 8: measured safe
+    # over 7000 MoE-mesh steps, while depth 64 froze the dp+pp CPU mesh
+    # at its first cross-data all-reduce 3/3 times (64 queued pipelined
+    # programs starve the 1-thread XLA:CPU pool's rendezvous — round-5
+    # RESULTS.md). Deep queues buy nothing on real TPU either (the
+    # device runs one program at a time; ~2 in flight already hides
+    # host latency). 0 = unbounded.
+    dispatch_ahead: int = 8
     eval_interval: int = 0        # 0 disables mid-training eval
     # Batches per MID-TRAINING eval firing, and the fallback length for
     # infinite (synthetic) eval streams. The final eval and --eval-only

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-5 chip-window measurement queue (PERF_NOTES.md round-4 closeout).
+# Run DETACHED the moment a tunnel probe succeeds:
+#
+#   setsid nohup bash scripts/chip_window_queue.sh > /tmp/chipq.log 2>&1 &
+#
+# Rules baked in (verify skill): serial runs, nothing else on the host,
+# never killed mid-run; each run's JSON line + stderr tail go to the log.
+# Priority order = VERDICT r4 "Next round" items 1-2, 5.
+set -u
+cd "$(dirname "$0")/.."
+echo "=== chip queue start $(date -u +%FT%TZ) ==="
+
+run() {
+  local label="$1"; shift
+  echo "--- [$label] $* $(date -u +%H:%M:%S)"
+  "$@" 2>/tmp/chipq_err.log
+  local rc=$?
+  echo "--- [$label] rc=$rc $(date -u +%H:%M:%S)"
+  [ $rc -ne 0 ] && tail -5 /tmp/chipq_err.log
+  return $rc
+}
+
+# 1. The headline number: driver-format ResNet-50 bench (expect ~2512).
+run resnet python bench.py || exit 1   # if the probe fails, stop — tunnel is down
+
+# 2. Dense-BERT MFU lever: fused-qkv A/B at the production shape.
+run bert-base    env BENCH_WORKLOAD=bert python bench.py
+run bert-fqkv    env BENCH_WORKLOAD=bert BENCH_FUSED_QKV=1 python bench.py
+
+# 3. Post-dtype tile confirms at seq 8192 (streaming regime).
+run tile-512-1024  env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=8192 BENCH_BS=4 FLASH_BLOCK_Q_KB=512 FLASH_BLOCK_K_KB=1024 python bench.py
+run tile-1024-1024 env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=8192 BENCH_BS=4 FLASH_BLOCK_Q_KB=1024 FLASH_BLOCK_K_KB=1024 python bench.py
+
+# 4. FLASH_CHUNK_MIN re-derive against the 2x-faster round-4 kernels.
+run crossover python scripts/bench_chunk_crossover.py 256 512 1024 2048 4096
+
+# 5. Roofline close-out trace for the 2512-vs-2670 question.
+run trace env BENCH_TRACE=/tmp/bench_trace python bench.py
+
+echo "=== chip queue done $(date -u +%FT%TZ) ==="
