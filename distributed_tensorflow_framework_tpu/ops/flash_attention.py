@@ -66,6 +66,18 @@ BLOCK_K_KB = int(os.environ.get("FLASH_BLOCK_K_KB", "1024"))
 # without an edit (FLASH_MAX_SEQ_VMEM=0 forces the streaming kernels
 # everywhere).
 MAX_SEQ_VMEM = int(os.environ.get("FLASH_MAX_SEQ_VMEM", "4096"))
+# Fused one-pass streaming backward (round 5, default OFF until measured
+# on silicon): one kernel over grid (B,H,nq,nk) produces dq AND dk/dv/
+# dbias, computing each (q-block, k-block) probability block ONCE — the
+# two-pass backward exps every block twice (dq pass + dkv pass). The
+# round-5 PERF_NOTES bound analysis puts the streaming regime's cost in
+# exactly that S² VPU transcendental work (~-30% predicted), at the
+# price of full-length (S_k, D) f32 dk/dv VMEM accumulators — hence the
+# MAX gate (4 MB at 8192; beyond ~2·8192 it cannot fit and the two-pass
+# kernels remain the only path). FLASH_FUSED_BWD=1 arms it for the chip
+# A/B; env read at import time like the other FLASH_* knobs.
+FUSED_BWD = os.environ.get("FLASH_FUSED_BWD", "0") not in ("", "0")
+FUSED_BWD_MAX = int(os.environ.get("FLASH_FUSED_BWD_MAX", "8192"))
 
 
 def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
@@ -331,6 +343,87 @@ def _attn_bwd_dkv_kernel_kb(q_ref, k_ref, v_ref, bias_ref, *rest,
         dbias_ref[0, 0] = db_acc[...]
 
 
+def _attn_bwd_fused_kernel_kb(q_ref, k_ref, v_ref, bias_ref, *rest,
+                              scale: float, segmented: bool):
+    """Fused one-pass streaming backward: grid (B, H, nq, nk), BOTH inner
+    axes sequential ("arbitrary"). Each (q-block, k-block) pair is
+    visited once; its probability block is exp'd ONCE and feeds all four
+    cotangents. dq accumulates per q-block in block scratch (finalized
+    when the k-scan ends); dk/dv/dbias accumulate in FULL-LENGTH VMEM
+    scratch across the whole per-(b,h) subgrid, and each visit stores
+    the current partial to the block output — grid steps execute in
+    order on the core, so the final visit's flush (qi == nq-1) is what
+    HBM keeps. Earlier flushes are dead writes: ~(nq-1)·S_k·D·4B extra
+    HBM-write traffic per (b,h), orders below the exp savings
+    (PERF_NOTES round-5 analysis)."""
+    if segmented:
+        (qseg_ref, kseg_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dk_ref, dv_ref, dbias_ref,
+         dq_acc, dk_full, dv_full, db_full) = rest
+    else:
+        (do_ref, lse_ref, delta_ref,
+         dq_ref, dk_ref, dv_ref, dbias_ref,
+         dq_acc, dk_full, dv_full, db_full) = rest
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init_dq():
+        dq_acc[...] = jnp.zeros(dq_acc.shape, dq_acc.dtype)
+
+    @pl.when((qi == 0) & (ki == 0))
+    def _init_dkv():
+        dk_full[...] = jnp.zeros(dk_full.shape, dk_full.dtype)
+        dv_full[...] = jnp.zeros(dv_full.shape, dv_full.dtype)
+        db_full[...] = jnp.zeros(db_full.shape, db_full.dtype)
+
+    q = q_ref[0, 0]                               # (BQ, D) input dtype
+    k = k_ref[0, 0]                               # (BK, D)
+    v = v_ref[0, 0]                               # (BK, D)
+    do = do_ref[0, 0]                             # (BQ, D)
+    lse = lse_ref[0, 0]                           # (BQ, 1)
+    delta = delta_ref[0, 0]                       # (BQ, 1)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + bias_ref[0]                       # (BQ, BK) f32
+    if segmented:
+        qs = qseg_ref[0, 0]
+        ks = kseg_ref[0, 0]
+        s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
+    p = jnp.exp(s - lse)                          # the ONE exp per pair
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                             # (BQ, BK)
+    ds = p * (dp - delta)                         # f32
+    dq_acc[...] = dq_acc[...] + jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    bk = k.shape[0]
+    sl = pl.ds(ki * bk, bk)
+    dv_full[sl, :] = dv_full[sl, :] + jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                             # (BK, D)
+    dk_full[sl, :] = dk_full[sl, :] + jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                     # (BK, D)
+    db_full[:, sl] = db_full[:, sl] + jnp.sum(ds, axis=0, keepdims=True)
+
+    @pl.when(ki == pl.num_programs(3) - 1)
+    def _finalize_dq():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+    # Store the running partials every visit; the last (qi) visit wins.
+    dk_ref[0, 0] = dk_full[sl, :].astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv_full[sl, :].astype(dv_ref.dtype)
+    dbias_ref[0, 0] = db_full[:, sl]
+
+
 def _xla_reference(q, k, v, bias):
     """Plain-XLA attention on the (B,H,S,D) layout — the numerics source of
     truth the kernels are tested against (tests/test_attention.py)."""
@@ -380,7 +473,8 @@ def _make_fused(segmented: bool, return_lse: bool):
             do, dlse = g if return_lse else (g, None)
             dq, dk, dv, dbias = _flash_bwd(
                 q, k, v, bias, qseg, kseg, o, lse, do, dlse=dlse,
-                segmented=True, interpret=_interpret())
+                segmented=True, interpret=_interpret(),
+                fused=FUSED_BWD and k.shape[2] <= FUSED_BWD_MAX)
             return (dq, dk, dv, dbias,
                     jnp.zeros_like(qseg), jnp.zeros_like(kseg))
     else:
@@ -401,7 +495,8 @@ def _make_fused(segmented: bool, return_lse: bool):
             do, dlse = g if return_lse else (g, None)
             dq, dk, dv, dbias = _flash_bwd(
                 q, k, v, bias, o, lse, do, dlse=dlse,
-                segmented=False, interpret=_interpret())
+                segmented=False, interpret=_interpret(),
+                fused=FUSED_BWD and k.shape[2] <= FUSED_BWD_MAX)
             return dq, dk, dv, dbias
 
     fused.defvjp(fwd, bwd)
@@ -528,17 +623,20 @@ def _pick_block(s: int, target: int) -> int:
     return b
 
 
-def _kb_params(interpret: bool):
-    """Mosaic grid semantics for the streaming kernels: (b, h, outer) are
-    parallel, the innermost accumulation axis is sequential. Interpret
-    mode (CPU tests) takes no TPU compiler params."""
+def _kb_params(interpret: bool, n_parallel: int = 3):
+    """Mosaic grid semantics for the streaming kernels: the leading
+    ``n_parallel`` axes are parallel, the rest sequential ("arbitrary").
+    The two-pass kernels accumulate only over their innermost axis
+    (n_parallel=3); the fused backward reduces over BOTH inner axes
+    (n_parallel=2). Interpret mode (CPU tests) takes no TPU compiler
+    params."""
     if interpret:
         return {}
     from jax.experimental.pallas import tpu as pltpu
 
     return {"compiler_params": pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "parallel",
-                             "arbitrary"))}
+        dimension_semantics=("parallel",) * n_parallel
+        + ("arbitrary",) * (4 - n_parallel))}
 
 
 def _flash_fwd_kb(q, k, v, bias, qseg, kseg, *, segmented: bool,
@@ -592,9 +690,10 @@ def _flash_fwd_kb(q, k, v, bias, qseg, kseg, *, segmented: bool,
     )(*operands)
 
 
-@functools.partial(jax.jit, static_argnames=("segmented", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("segmented", "interpret", "fused"))
 def _flash_bwd(q, k, v, bias, *seg_then_rest, segmented: bool,
-               interpret: bool, dlse=None):
+               interpret: bool, dlse=None, fused: bool = False):
     if segmented:
         qseg, kseg, o, lse, do = seg_then_rest
     else:
@@ -617,7 +716,8 @@ def _flash_bwd(q, k, v, bias, *seg_then_rest, segmented: bool,
 
     if max(s, s_k) > MAX_SEQ_VMEM:
         return _flash_bwd_kb(q, k, v, bias, qseg, kseg, lse, do, delta,
-                             segmented=segmented, interpret=interpret)
+                             segmented=segmented, interpret=interpret,
+                             fused=fused)
 
     block_q = min(BLOCK_Q, s)
     dq_seg_specs = [
@@ -681,15 +781,24 @@ def _flash_bwd(q, k, v, bias, *seg_then_rest, segmented: bool,
 
 
 def _flash_bwd_kb(q, k, v, bias, qseg, kseg, lse, do, delta, *,
-                  segmented: bool, interpret: bool):
+                  segmented: bool, interpret: bool, fused: bool = False):
     """Streaming backward for sequences > MAX_SEQ_VMEM: dQ accumulates
     over a sequential k-axis, dK/dV/dbias over a sequential q-axis; no
-    whole-sequence operand in VMEM (kernel docstrings)."""
+    whole-sequence operand in VMEM (kernel docstrings). ``fused`` is the
+    COMPLETE FLASH_FUSED_BWD ∧ s_k ≤ FUSED_BWD_MAX decision, made at the
+    custom_vjp layer OUTSIDE the inner jit — both module attrs are jit-
+    invisible, so reading either here would freeze it into the first
+    trace's cache."""
     b, h, s, d = q.shape
     s_k = k.shape[2]
     scale = 1.0 / (d ** 0.5)
     block_q = _pick_block(s, BLOCK_Q_KB)
     block_k = _pick_block(s_k, BLOCK_K_KB)
+
+    if fused:
+        return _flash_bwd_fused_kb(q, k, v, bias, qseg, kseg, lse, do,
+                                   delta, segmented=segmented,
+                                   interpret=interpret)
 
     seg_operands = [qseg, kseg] if segmented else []
     dq_seg_specs = [
@@ -769,6 +878,71 @@ def _flash_bwd_kb(q, k, v, bias, qseg, kseg, lse, do, delta, *,
         ),
         interpret=interpret,
         **_kb_params(interpret),
+    )(q, k, v, bias, *seg_operands, do, lse, delta)
+    dbias = jnp.sum(dbias_h, axis=1)               # (B, 1, S): Σ over heads
+    return dq, dk, dv, dbias
+
+
+def _flash_bwd_fused_kb(q, k, v, bias, qseg, kseg, lse, do, delta, *,
+                        segmented: bool, interpret: bool):
+    """One-pass streaming backward (FLASH_FUSED_BWD; kernel docstring):
+    one grid, one exp per (q-block, k-block) pair, full-length dk/dv
+    VMEM accumulators — gated to s_k ≤ FUSED_BWD_MAX by the caller."""
+    b, h, s, d = q.shape
+    s_k = k.shape[2]
+    scale = 1.0 / (d ** 0.5)
+    block_q = _pick_block(s, BLOCK_Q_KB)
+    block_k = _pick_block(s_k, BLOCK_K_KB)
+
+    seg_operands = [qseg, kseg] if segmented else []
+    seg_specs = [
+        pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, 0, qi)),
+        pl.BlockSpec((1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)),
+    ] if segmented else []
+    dq, dk, dv, dbias_h = pl.pallas_call(
+        functools.partial(_attn_bwd_fused_kernel_kb, scale=scale,
+                          segmented=segmented),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s_k, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, s_k), jnp.float32),
+        ],
+        grid=(b, h, s // block_q, s_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)),
+        ] + seg_specs + [
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, 1, block_k),
+                         lambda bi, hi, qi, ki: (bi, hi, 0, ki)),
+        ],
+        scratch_shapes=_vmem_scratch(
+            ((block_q, d), jnp.float32),
+            ((s_k, d), jnp.float32),
+            ((s_k, d), jnp.float32),
+            ((1, s_k), jnp.float32),
+        ),
+        interpret=interpret,
+        **_kb_params(interpret, n_parallel=2),
     )(q, k, v, bias, *seg_operands, do, lse, delta)
     dbias = jnp.sum(dbias_h, axis=1)               # (B, 1, S): Σ over heads
     return dq, dk, dv, dbias

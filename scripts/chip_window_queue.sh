@@ -35,6 +35,13 @@ run tile-1024-1024 env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=8192 BENC
 # 4. FLASH_CHUNK_MIN re-derive against the 2x-faster round-4 kernels.
 run crossover python scripts/bench_chunk_crossover.py 256 512 1024 2048 4096
 
+# 4b. Fused one-pass streaming backward: ON-DEVICE NUMERICS FIRST (the
+#     revisited-output flush ordering is unverifiable in interpret mode),
+#     then the A/B (PERF_NOTES predicts ~-30% VPU work at seq 8192;
+#     compare vs tile-512-1024 above). Skip the bench if numerics fail.
+run fused-bwd-verify python scripts/verify_fused_bwd.py 8192 && \
+run fused-bwd env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=8192 BENCH_BS=4 FLASH_FUSED_BWD=1 python bench.py
+
 # 5. Roofline close-out trace for the 2512-vs-2670 question.
 run trace env BENCH_TRACE=/tmp/bench_trace python bench.py
 
