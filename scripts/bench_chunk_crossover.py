@@ -15,11 +15,22 @@ timed calls after a warmup, synced by fetching a scalar VALUE (never
 block_until_ready — the axon tunnel returns early from it).
 """
 
+import os
+import pathlib
 import statistics
 import sys
 import time
 
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
 import jax
+
+if os.environ.get("BCC_CPU", "0") not in ("", "0"):
+    # CPU plumbing dry-run (timings meaningless): the sitecustomize
+    # force-selects axon, so an in-process override is the only way to
+    # validate the script without a chip (cf. verify_fused_bwd.py).
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 from distributed_tensorflow_framework_tpu.ops import flash_attention as _fa

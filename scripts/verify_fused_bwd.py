@@ -12,16 +12,32 @@ Usage (serial, backgrounded per the verify skill):
     python scripts/verify_fused_bwd.py [seq]
 """
 
+import os
+import pathlib
 import sys
 
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
 import jax
+
+if os.environ.get("VFB_CPU", "0") not in ("", "0"):
+    # CPU dry-run gate: the image's sitecustomize force-selects the axon
+    # TPU platform regardless of JAX_PLATFORMS, so validating this
+    # script's plumbing without a chip needs the in-process override
+    # (and interpret-mode kernels follow automatically).
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import numpy as np
 
 from distributed_tensorflow_framework_tpu.ops import flash_attention as fa
 
 SEQ = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
-B, H, D = 2, 4, 64
+# Env-tunable so the script itself can be dry-run on CPU interpret mode
+# (tiny dims) before a chip window burns time on a plumbing bug.
+B = int(os.environ.get("VFB_B", "2"))
+H = int(os.environ.get("VFB_H", "4"))
+D = int(os.environ.get("VFB_D", "64"))
 
 
 def main() -> int:
