@@ -434,15 +434,20 @@ def test_bf16_inputs_match_f32_reference(devices, monkeypatch):
                     rtol=6e-2, atol=6e-2, err_msg=f"{regime} d{name}")
 
 
-def test_kblocked_segmented_ring_matches_reference(devices, monkeypatch):
+@pytest.mark.parametrize("fused", [False, True])
+def test_kblocked_segmented_ring_matches_reference(devices, monkeypatch,
+                                                   fused):
     """Packed segments + ring + K-blocked chunk kernels: force every ring
     chunk through the streaming kernels (MAX_SEQ_VMEM→64, FLASH_CHUNK_MIN
-    →0) and pin output + grads against the segment-aware reference."""
+    →0) and pin output + grads against the segment-aware reference.
+    ``fused=True`` repeats the composition through the one-pass backward —
+    covering the ring-merge dlse→delta folding + segments on that path."""
     from distributed_tensorflow_framework_tpu.core.config import MeshConfig
     from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
     from distributed_tensorflow_framework_tpu.ops import flash_attention as fa
     from distributed_tensorflow_framework_tpu.parallel import ring
 
+    monkeypatch.setattr(fa, "FUSED_BWD", fused)
     # chunk = 256/4 = 64 > MAX_SEQ_VMEM(32) → K-blocked kernels with a
     # 16-wide block grid (nq = nk = 4), segments riding along.
     monkeypatch.setattr(fa, "MAX_SEQ_VMEM", 32)
