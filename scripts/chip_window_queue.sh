@@ -42,6 +42,10 @@ run crossover python scripts/bench_chunk_crossover.py 256 512 1024 2048 4096
 run fused-bwd-verify python scripts/verify_fused_bwd.py 8192 && \
 run fused-bwd env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=8192 BENCH_BS=4 FLASH_FUSED_BWD=1 python bench.py
 
+# 4c. Grad-accum fragmentation lever A/B at the production shape
+#     (effective batch 4x at fixed per-micro memory; compare bert-base).
+run bert-accum4 env BENCH_WORKLOAD=bert BENCH_ACCUM=4 python bench.py
+
 # 5. Roofline close-out trace for the 2512-vs-2670 question.
 run trace env BENCH_TRACE=/tmp/bench_trace python bench.py
 
