@@ -208,9 +208,11 @@ def bench_bert(batch_size: int, steps: int = 20, warmup: int = 3,
                remat: bool = False, pack: int = 0,
                fused_qkv: bool = False, accum: int = 1) -> dict:
     """BERT-base MLM train-step throughput — the transformer side of the
-    perf story. Measured on v5e it saturates NEITHER roofline (MFU ~27%,
-    HBM ~41%): the step is fragmented across medium GEMMs, so the lever
-    is fatter per-matmul work, not bandwidth (PERF_NOTES.md round 3).
+    perf story. Measured on v5e it saturates NEITHER roofline (MFU 17.9%
+    base at seq 512): the step is dominated by per-optimizer-step fixed
+    overheads plus medium-GEMM fragmentation, so the measured levers are
+    grad accumulation (MFU → 32-34%) and fused QKV (+21% at accum 1),
+    not bandwidth (PERF_NOTES.md round 5, 2026-08-01 window).
     Knobs via env in main(): BENCH_ATTN (pallas|xla|ring), BENCH_REMAT=1,
     BENCH_SEQ=<len>, BENCH_BS=<per-chip batch>, BENCH_FUSED_QKV=1, BENCH_PACK
     (0 = dense synthetic rows; 1 = ragged docs unpacked — the padding
@@ -255,6 +257,23 @@ def bench_bert(batch_size: int, steps: int = 20, warmup: int = 3,
     batch = to_global(host, mesh)
     state = builder.init_state(0, batch)
     out = _compile_and_time(builder, state, batch, steps, warmup)
+    if accum > 1:
+        # XLA's cost_analysis counts a lax.scan body ONCE, but the accum
+        # scan (train/step.py) runs it `accum` times per optimizer step —
+        # verified on-chip 2026-08-01: the raw accum=4 run reported
+        # exactly 1/4 the TFLOP/s its wall-clock throughput implied.
+        # Scale flops/bytes by the trip count. Residual error: the
+        # once-per-step optimizer update is also scaled, over-counting it
+        # (accum-1)×. For FLOPs that is <1% (the update is ~10 flops/param
+        # vs ~6 TFLOP per BERT-base micro-step). For BYTES it is not
+        # negligible (AdamW traffic is ~7 f32 passes over the param tree,
+        # ~3 GB for BERT-base — comparable to one micro-step), so for
+        # accum runs hbm_bw_util is an UPPER bound and arith_intensity a
+        # LOWER bound; the aggregate cost model gives no body/epilogue
+        # split to do better with.
+        for key in ("flops_per_step", "bytes_per_step"):
+            if out.get(key):
+                out[key] *= accum
     out["examples_per_sec"] = batch_size / out["sec_per_step"]
     out["tokens_per_sec"] = batch_size * seq_len / out["sec_per_step"]
     out["real_tokens_per_sec"] = real_tokens / out["sec_per_step"]
@@ -408,7 +427,11 @@ def main() -> int:
             (64 * n_chips, 32 * n_chips, 16 * n_chips), n_chips)
         # Scale the ladder by accum so each micro-step keeps the ladder's
         # GEMM shapes; the effective batch (and examples counted per
-        # timed step) grows accum×.
+        # timed step) grows accum×. NOTE this makes BENCH_BS the per-chip
+        # per-MICRO batch when BENCH_ACCUM>1 (global batch =
+        # BENCH_BS × n_chips × BENCH_ACCUM) — there is deliberately no
+        # way to pin the effective batch while varying accum, because
+        # the accum A/B's contract is constant micro-GEMM shapes.
         ladder = tuple(b * accum for b in ladder)
         result = _run_ladder(
             lambda bs: bench_bert(bs, seq_len=seq, attention_impl=attn,

@@ -66,17 +66,21 @@ BLOCK_K_KB = int(os.environ.get("FLASH_BLOCK_K_KB", "1024"))
 # without an edit (FLASH_MAX_SEQ_VMEM=0 forces the streaming kernels
 # everywhere).
 MAX_SEQ_VMEM = int(os.environ.get("FLASH_MAX_SEQ_VMEM", "4096"))
-# Fused one-pass streaming backward (round 5, default OFF until measured
-# on silicon): one kernel over grid (B,H,nq,nk) produces dq AND dk/dv/
-# dbias, computing each (q-block, k-block) probability block ONCE — the
-# two-pass backward exps every block twice (dq pass + dkv pass). The
-# round-5 PERF_NOTES bound analysis puts the streaming regime's cost in
-# exactly that S² VPU transcendental work (~-30% predicted), at the
-# price of full-length (S_k, D) f32 dk/dv VMEM accumulators — hence the
-# MAX gate (4 MB at 8192; beyond ~2·8192 it cannot fit and the two-pass
-# kernels remain the only path). FLASH_FUSED_BWD=1 arms it for the chip
-# A/B; env read at import time like the other FLASH_* knobs.
-FUSED_BWD = os.environ.get("FLASH_FUSED_BWD", "0") not in ("", "0")
+# Fused one-pass streaming backward: one kernel over grid (B,H,nq,nk)
+# produces dq AND dk/dv/dbias, computing each (q-block, k-block)
+# probability block ONCE — the two-pass backward exps every block twice
+# (dq pass + dkv pass). The round-5 PERF_NOTES bound analysis puts the
+# streaming regime's cost in exactly that S² VPU transcendental work,
+# at the price of full-length (S_k, D) f32 dk/dv VMEM accumulators —
+# hence the MAX gate (4 MB at 8192; beyond ~2·8192 it cannot fit and
+# the two-pass kernels remain the only path). Default ON since the
+# 2026-08-01 v5e window: scripts/verify_fused_bwd.py showed EXACT
+# on-device agreement with the two-pass kernels at seq 8192 (worst rel
+# diff 0.0) and the step A/B measured 36,150 vs 33,526 tok/s (+7.8%)
+# at seq 8192, bs 4 (PERF_NOTES round 5). FLASH_FUSED_BWD=0 restores
+# the two-pass path; env read at import time like the other FLASH_*
+# knobs.
+FUSED_BWD = os.environ.get("FLASH_FUSED_BWD", "1") not in ("", "0")
 FUSED_BWD_MAX = int(os.environ.get("FLASH_FUSED_BWD_MAX", "8192"))
 
 

@@ -35,12 +35,14 @@ from distributed_tensorflow_framework_tpu.ops.flash_attention import (
     flash_attention_chunk,
 )
 
-# Per-chunk implementation crossover, measured on TPU v5 lite (PERF_NOTES
-# round 3): the Pallas flash chunk wins once the per-shard sequence is
-# long enough that the (S/n)² score block dominates HBM traffic
-# (fwd+bwd 27.3 vs 30.4 ms at chunk 2048); below it XLA's fused unblocked
-# chain is faster (11.9 vs 22.5 ms at chunk 512). Module-level so tests
-# can force either path.
+# Per-chunk implementation crossover. Re-derived on TPU v5 lite against
+# the round-4 fat-tile/input-dtype kernels (scripts/bench_chunk_crossover,
+# 2026-08-01 window, fwd+bwd median ms): XLA and flash TIE within noise
+# below 2048 (chunk 512: 65.7 vs 66.7; 1024: 66.9 vs 67.0), flash wins at
+# 2048 (70.7 vs 69.6) and 4096 (89.8 vs 84.1, +6.8%). 2048 stands as the
+# measured crossover — the round-3 value survived the 2x kernel speedup
+# because XLA's chain got proportionally cheaper at short chunks too.
+# Module-level so tests can force either path.
 FLASH_CHUNK_MIN = 2048
 
 

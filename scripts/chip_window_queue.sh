@@ -29,8 +29,11 @@ run bert-base    env BENCH_WORKLOAD=bert python bench.py
 run bert-fqkv    env BENCH_WORKLOAD=bert BENCH_FUSED_QKV=1 python bench.py
 
 # 3. Post-dtype tile confirms at seq 8192 (streaming regime).
-run tile-512-1024  env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=8192 BENCH_BS=4 FLASH_BLOCK_Q_KB=512 FLASH_BLOCK_K_KB=1024 python bench.py
-run tile-1024-1024 env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=8192 BENCH_BS=4 FLASH_BLOCK_Q_KB=1024 FLASH_BLOCK_K_KB=1024 python bench.py
+#    FLASH_FUSED_BWD=0 pins the TWO-PASS backward: since the round-5
+#    default flip (ops/flash_attention.py) an env-less run takes the
+#    fused backward, which would turn 4b below into fused-vs-fused.
+run tile-512-1024  env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=8192 BENCH_BS=4 FLASH_BLOCK_Q_KB=512 FLASH_BLOCK_K_KB=1024 FLASH_FUSED_BWD=0 python bench.py
+run tile-1024-1024 env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=8192 BENCH_BS=4 FLASH_BLOCK_Q_KB=1024 FLASH_BLOCK_K_KB=1024 FLASH_FUSED_BWD=0 python bench.py
 
 # 4. FLASH_CHUNK_MIN re-derive against the 2x-faster round-4 kernels.
 run crossover python scripts/bench_chunk_crossover.py 256 512 1024 2048 4096
