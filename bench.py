@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import os
+import pathlib
 import sys
 
 TARGET_PER_CHIP = 10_000 / 64  # BASELINE.json north star on v5e-64
@@ -154,6 +155,55 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
         "image": rng.standard_normal((batch_size, 224, 224, 3))
         .astype(image_np_dtype(cfg.data.image_dtype)),
         "label": rng.integers(0, 1000, batch_size).astype(np.int32),
+    }
+    batch = to_global(host, mesh)
+    state = builder.init_state(0, batch)
+    out = _compile_and_time(builder, state, batch, steps, warmup)
+    out["images_per_sec"] = batch_size / out["sec_per_step"]
+    return out
+
+
+def bench_inception(batch_size: int, steps: int = 20, warmup: int = 3) -> dict:
+    """Inception-v3 train-step throughput — BASELINE config 4's recipe,
+    loaded from configs/inception_v3.yaml (one source of truth for the
+    hyperparameters) with only the bench-necessary overrides: synthetic
+    infeed at the recipe's 299px bf16 shape and the requested batch.
+    BENCH_WORKLOAD=inception; BENCH_REMAT=1 for full-replay remat (the
+    ResNet-only 'light'/'conv_saved' values are rejected — Inception has
+    no conv_saved policy)."""
+    import numpy as np
+
+    from distributed_tensorflow_framework_tpu.core.config import load_config
+    from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+    from distributed_tensorflow_framework_tpu.data.infeed import to_global
+    from distributed_tensorflow_framework_tpu.data.pipeline import (
+        image_np_dtype,
+    )
+    from distributed_tensorflow_framework_tpu.train.step import StepBuilder
+
+    remat_env = os.environ.get("BENCH_REMAT", "0")
+    if remat_env not in ("", "0", "1"):
+        raise ValueError(
+            f"BENCH_REMAT={remat_env!r} is ResNet-only (conv_saved policy); "
+            f"the inception workload takes BENCH_REMAT=1 (full replay) or "
+            f"unset.")
+    cfg = load_config(
+        pathlib.Path(__file__).parent / "configs" / "inception_v3.yaml",
+        overrides=[
+            "data.name=synthetic_images",
+            f"data.global_batch_size={batch_size}",
+            f"model.remat={'true' if remat_env == '1' else 'false'}",
+        ],
+    )
+    mesh = create_mesh(cfg.mesh)
+    builder = StepBuilder(cfg, mesh)
+    rng = np.random.default_rng(0)
+    host = {
+        "image": rng.standard_normal(
+            (batch_size, cfg.data.image_size, cfg.data.image_size, 3))
+        .astype(image_np_dtype(cfg.data.image_dtype)),
+        "label": rng.integers(0, cfg.data.num_classes, batch_size)
+        .astype(np.int32),
     }
     batch = to_global(host, mesh)
     state = builder.init_state(0, batch)
@@ -398,8 +448,9 @@ def _init_backend(attempts: int = 3, probe_timeout_s: float = 240.0):
 
 def main() -> int:
     workload = os.environ.get("BENCH_WORKLOAD", "resnet50")
-    metric = ("bert_base_mlm_examples_per_sec_per_chip"
-              if workload == "bert" else "resnet50_images_per_sec_per_chip")
+    metric = {"bert": "bert_base_mlm_examples_per_sec_per_chip",
+              "inception": "inception_v3_images_per_sec_per_chip"}.get(
+        workload, "resnet50_images_per_sec_per_chip")
     unit = ("examples/sec/chip" if workload == "bert" else "images/sec/chip")
     try:
         n_chips, chip = _init_backend()
@@ -464,6 +515,28 @@ def main() -> int:
                 result["real_tokens_per_sec"] / n_chips, 1),
             "docs_per_sec_per_chip": round(
                 result["docs_per_sec"] / n_chips, 2),
+        }
+        _annotate_roofline(out, result, chip, n_chips)
+        print(json.dumps(out))
+        return 0
+
+    if workload == "inception":
+        # BASELINE config 4's model; no published reference number
+        # (BASELINE.json publishes none for any workload), so like bert
+        # this reports absolute rate + roofline position only.
+        ladder = _ladder_override(
+            (128 * n_chips, 64 * n_chips, 32 * n_chips), n_chips)
+        result = _run_ladder(bench_inception, ladder, metric, unit, chip)
+        if result is None:
+            return 1
+        out = {
+            "metric": metric,
+            "value": round(result["images_per_sec"] / n_chips, 2),
+            "unit": unit,
+            "vs_baseline": 0.0,
+            "baseline_kind": "none",
+            "chip": chip,
+            "num_chips": n_chips,
         }
         _annotate_roofline(out, result, chip, n_chips)
         print(json.dumps(out))

@@ -52,4 +52,8 @@ run bert-accum4 env BENCH_WORKLOAD=bert BENCH_ACCUM=4 python bench.py
 # 5. Roofline close-out trace for the 2512-vs-2670 question.
 run trace env BENCH_TRACE=/tmp/bench_trace python bench.py
 
+# 6. Third-workload coverage: Inception-v3 at its recipe shapes
+#    (299px, RMSProp, aux head). Expect ~1959 img/s, HBM-bound.
+run inception env BENCH_WORKLOAD=inception python bench.py
+
 echo "=== chip queue done $(date -u +%FT%TZ) ==="
