@@ -82,6 +82,16 @@ MAX_SEQ_VMEM = int(os.environ.get("FLASH_MAX_SEQ_VMEM", "4096"))
 # knobs.
 FUSED_BWD = os.environ.get("FLASH_FUSED_BWD", "1") not in ("", "0")
 FUSED_BWD_MAX = int(os.environ.get("FLASH_FUSED_BWD_MAX", "8192"))
+# The fused one-pass backward also REPLACES the whole-K two-pass backward
+# for mid-length sequences (FUSED_WHOLE_K_MIN ≤ s ≤ MAX_SEQ_VMEM): the
+# whole-K dq/dkv kernel pair pays the same three S² exp evaluations the
+# streaming two-pass does, and the round-4 crossover showed the K-blocked
+# kernels already TIE whole-K at 2048 — so the fused kernel's saved exp
+# is pure win from there up. Below 2048 the K-blocked grid overhead
+# dominates (measured, PERF_NOTES round 3/4) and whole-K two-pass stays.
+# Forward stays whole-K either way (the streaming backward needs only
+# q/k/v/bias/lse/do, all of which the whole-K forward saves).
+FUSED_WHOLE_K_MIN = int(os.environ.get("FLASH_FUSED_WHOLE_K_MIN", "2048"))
 
 
 def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
@@ -475,10 +485,13 @@ def _make_fused(segmented: bool, return_lse: bool):
         def bwd(res, g):
             q, k, v, bias, qseg, kseg, o, lse = res
             do, dlse = g if return_lse else (g, None)
+            use_fused = FUSED_BWD and k.shape[2] <= FUSED_BWD_MAX
             dq, dk, dv, dbias = _flash_bwd(
                 q, k, v, bias, qseg, kseg, o, lse, do, dlse=dlse,
                 segmented=True, interpret=_interpret(),
-                fused=FUSED_BWD and k.shape[2] <= FUSED_BWD_MAX)
+                fused=use_fused,
+                force_stream=use_fused and min(
+                    q.shape[2], k.shape[2]) >= FUSED_WHOLE_K_MIN)
             return (dq, dk, dv, dbias,
                     jnp.zeros_like(qseg), jnp.zeros_like(kseg))
     else:
@@ -497,10 +510,13 @@ def _make_fused(segmented: bool, return_lse: bool):
         def bwd(res, g):
             q, k, v, bias, o, lse = res
             do, dlse = g if return_lse else (g, None)
+            use_fused = FUSED_BWD and k.shape[2] <= FUSED_BWD_MAX
             dq, dk, dv, dbias = _flash_bwd(
                 q, k, v, bias, o, lse, do, dlse=dlse,
                 segmented=False, interpret=_interpret(),
-                fused=FUSED_BWD and k.shape[2] <= FUSED_BWD_MAX)
+                fused=use_fused,
+                force_stream=use_fused and min(
+                    q.shape[2], k.shape[2]) >= FUSED_WHOLE_K_MIN)
             return dq, dk, dv, dbias
 
     fused.defvjp(fwd, bwd)
@@ -695,9 +711,11 @@ def _flash_fwd_kb(q, k, v, bias, qseg, kseg, *, segmented: bool,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("segmented", "interpret", "fused"))
+                   static_argnames=("segmented", "interpret", "fused",
+                                    "force_stream"))
 def _flash_bwd(q, k, v, bias, *seg_then_rest, segmented: bool,
-               interpret: bool, dlse=None, fused: bool = False):
+               interpret: bool, dlse=None, fused: bool = False,
+               force_stream: bool = False):
     if segmented:
         qseg, kseg, o, lse, do = seg_then_rest
     else:
@@ -718,7 +736,13 @@ def _flash_bwd(q, k, v, bias, *seg_then_rest, segmented: bool,
 
     seg_operands = [qseg, kseg] if segmented else []
 
-    if max(s, s_k) > MAX_SEQ_VMEM:
+    if max(s, s_k) > MAX_SEQ_VMEM or force_stream:
+        # force_stream: mid-length sequences take the FUSED streaming
+        # backward instead of the whole-K two-pass (FUSED_WHOLE_K_MIN
+        # note above). The decision is made at the custom_vjp layer —
+        # this function is jitted, so a module-attr read HERE would
+        # freeze into the first trace's cache (the _flash_bwd_kb
+        # docstring's rule; MAX_SEQ_VMEM predates it and is accepted).
         return _flash_bwd_kb(q, k, v, bias, qseg, kseg, lse, do, delta,
                              segmented=segmented, interpret=interpret,
                              fused=fused)

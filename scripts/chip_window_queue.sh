@@ -56,4 +56,19 @@ run trace env BENCH_TRACE=/tmp/bench_trace python bench.py
 #    (299px, RMSProp, aux head). Expect ~1959 img/s, HBM-bound.
 run inception env BENCH_WORKLOAD=inception python bench.py
 
+# 7. Whole-K takeover band (FLASH_FUSED_WHOLE_K_MIN, round 5): verify
+#    numerics on-device FIRST (per seq — gates only its own pair), then
+#    A/B fused-takeover vs whole-K two-pass. Pairs are independent so a
+#    transient failure in one cannot cancel the rest of an unattended
+#    window; each A/B is a same-epoch adjacent pair (PERF_NOTES
+#    variance rules).
+if run wk-verify-2048 python scripts/verify_fused_bwd.py 2048; then
+  run wk2048-fused env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=2048 BENCH_BS=16 python bench.py
+  run wk2048-two   env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=2048 BENCH_BS=16 FLASH_FUSED_WHOLE_K_MIN=1000000000 python bench.py
+fi
+if run wk-verify-4096 python scripts/verify_fused_bwd.py 4096; then
+  run wk4096-fused env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=4096 BENCH_BS=8 python bench.py
+  run wk4096-two   env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=4096 BENCH_BS=8 FLASH_FUSED_WHOLE_K_MIN=1000000000 python bench.py
+fi
+
 echo "=== chip queue done $(date -u +%FT%TZ) ==="

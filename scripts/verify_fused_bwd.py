@@ -46,10 +46,20 @@ def main() -> int:
     q = jax.random.normal(kq, (B, SEQ, H, D), jnp.bfloat16)
     k = jax.random.normal(kk, (B, SEQ, H, D), jnp.bfloat16)
     v = jax.random.normal(kv, (B, SEQ, H, D), jnp.bfloat16)
-    if SEQ <= fa.MAX_SEQ_VMEM:
-        print(f"seq {SEQ} <= MAX_SEQ_VMEM={fa.MAX_SEQ_VMEM}: not the "
-              f"streaming regime — nothing to verify")
+    if SEQ < fa.FUSED_WHOLE_K_MIN and SEQ <= fa.MAX_SEQ_VMEM:
+        print(f"seq {SEQ} < FUSED_WHOLE_K_MIN={fa.FUSED_WHOLE_K_MIN}: "
+              f"whole-K two-pass territory, no fused path to verify")
         return 2
+    if SEQ <= fa.MAX_SEQ_VMEM:
+        # Whole-K takeover band (FUSED_WHOLE_K_MIN ≤ seq ≤ MAX_SEQ_VMEM):
+        # the two arms are the fused STREAMING backward vs the WHOLE-K
+        # two-pass, whose K-dots accumulate in a different order — expect
+        # bf16 reassociation noise (1e-2 class), not the bit-exactness the
+        # pure-streaming comparison shows; the 5e-2 gate still separates
+        # that from a flush-ordering defect (which is >1e0 when it bites).
+        print(f"seq {SEQ}: whole-K takeover band — comparing fused "
+              f"streaming vs whole-K two-pass (different accumulation "
+              f"order; bf16 reassociation noise expected)")
 
     def loss(q, k, v):
         out = fa.flash_attention(q, k, v)

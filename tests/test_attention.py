@@ -374,6 +374,74 @@ def test_fused_streaming_backward_gate(devices, monkeypatch):
     assert calls, "fused kernel did not run below FUSED_BWD_MAX"
 
 
+def test_fused_backward_takes_over_whole_k_regime(devices, monkeypatch):
+    """FUSED_WHOLE_K_MIN routing (round 5): for mid-length sequences the
+    fused one-pass streaming backward REPLACES the whole-K two-pass even
+    though the sequence fits VMEM (s ≤ MAX_SEQ_VMEM) — it pays one fewer
+    S² exp. Scaled-down constants stand in for the real ones
+    (MIN 256 / VMEM 1024 ≈ 2048 / 4096): s=384 sits in the whole-K
+    regime but above the fused takeover. Pins the DISPATCH via a spy and
+    the numerics against both the whole-K two-pass and the XLA
+    reference, masked and segmented."""
+    from distributed_tensorflow_framework_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "MAX_SEQ_VMEM", 1024)
+    monkeypatch.setattr(fa, "BLOCK_Q_KB", 128)
+    monkeypatch.setattr(fa, "BLOCK_K_KB", 128)
+    monkeypatch.setattr(fa, "FUSED_BWD", True)
+    q, k, v = _rand_qkv(jax.random.key(17), b=2, s=384, h=2, d=32)
+    q = q.astype(jnp.bfloat16)
+    k = k.astype(jnp.bfloat16)
+    v = v.astype(jnp.bfloat16)
+    mask = jnp.ones((2, 1, 1, 384), bool).at[:, :, :, 320:].set(False)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 200), jnp.int32), jnp.ones((2, 184), jnp.int32)],
+        axis=1)
+
+    calls = []
+    orig = fa._flash_bwd_fused_kb
+    monkeypatch.setattr(
+        fa, "_flash_bwd_fused_kb",
+        lambda *a, **kw: (calls.append(1), orig(*a, **kw))[1])
+
+    def loss(q, k, v, segment_ids=None):
+        out = fa.flash_attention(q, k, v, mask=mask,
+                                 segment_ids=segment_ids)
+        return jnp.sum(jnp.sin(out.astype(jnp.float32)))
+
+    def loss_ref(q, k, v, segment_ids=None):
+        attn_mask = mask
+        if segment_ids is not None:
+            same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+            attn_mask = mask & same
+        out = dot_product_attention(q, k, v, mask=attn_mask)
+        return jnp.sum(jnp.sin(out.astype(jnp.float32)))
+
+    # Below the takeover threshold the whole-K two-pass still runs.
+    monkeypatch.setattr(fa, "FUSED_WHOLE_K_MIN", 512)  # s=384 below it
+    g_whole = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, None)
+    assert not calls, "fused kernel ran below FUSED_WHOLE_K_MIN"
+
+    # At/above it the fused streaming backward takes over — in the
+    # whole-K regime (384 ≤ MAX_SEQ_VMEM=1024).
+    monkeypatch.setattr(fa, "FUSED_WHOLE_K_MIN", 256)
+    for seg_ids in (None, seg):
+        calls.clear()
+        g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, seg_ids)
+        assert calls, "fused kernel did not take over the whole-K regime"
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v, seg_ids)
+        # vs the XLA reference always; vs the whole-K two-pass where one
+        # was computed (unsegmented arm) — the distinct comparison.
+        pairs = [("ref", g_ref)] + ([("whole-k", g_whole)]
+                                    if seg_ids is None else [])
+        for tag, ref in pairs:
+            for name, a, b in zip("qkv", g_fused, ref):
+                np.testing.assert_allclose(
+                    np.asarray(a, np.float32), np.asarray(b, np.float32),
+                    rtol=4e-2, atol=4e-2,
+                    err_msg=f"d{name} vs {tag}, seg={seg_ids is not None}")
+
+
 def test_pick_block_divisor_policy():
     """Streaming-tile picker: largest 128-multiple ≤ target dividing s;
     sub-128 env targets clamp to 128 instead of dividing by zero; short
