@@ -3,7 +3,7 @@
 The fused kernel's dk/dv/dbias correctness rests on in-order HBM
 flushes of revisited output blocks — a Mosaic behavior CPU interpret
 mode cannot exercise (it executes the grid sequentially by
-construction). Run THIS before trusting any FLASH_FUSED_BWD=1 number:
+construction). Run THIS before trusting a fused-backward number on a new backend:
 it compares fused vs two-pass gradients on the real chip at a streaming
 shape and fails loudly on divergence.
 
@@ -83,7 +83,7 @@ def main() -> int:
         print(f"d{name}: max rel diff fused-vs-two-pass = {rel:.3e}")
     if worst > 5e-2:
         print(f"FUSED BWD NUMERICS MISMATCH (worst {worst:.3e}) — do NOT "
-              f"use FLASH_FUSED_BWD=1; revisited-output flush ordering is "
+              f"use the fused backward (set FLASH_FUSED_BWD=0); flush ordering is "
               f"suspect on this backend/toolchain")
         return 1
     print(f"fused backward matches two-pass on this device "
