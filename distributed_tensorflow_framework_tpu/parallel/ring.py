@@ -42,6 +42,10 @@ from distributed_tensorflow_framework_tpu.ops.flash_attention import (
 # 2048 (70.7 vs 69.6) and 4096 (89.8 vs 84.1, +6.8%). 2048 stands as the
 # measured crossover — the round-3 value survived the 2x kernel speedup
 # because XLA's chain got proportionally cheaper at short chunks too.
+# Those flash timings are TWO-PASS backward numbers; since the round-5
+# FUSED_WHOLE_K_MIN default, chunks ≥ 2048 take the fused one-pass
+# backward, which only widens flash's margin at/above this crossover
+# (the XLA arm and sub-2048 chunks are unaffected).
 # Module-level so tests can force either path.
 FLASH_CHUNK_MIN = 2048
 
