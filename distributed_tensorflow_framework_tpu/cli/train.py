@@ -62,6 +62,11 @@ def parse_args(argv=None):
     )
     p.add_argument("--eval-only", action="store_true",
                    help="restore latest checkpoint and evaluate")
+    p.add_argument("--print-config", action="store_true",
+                   help="print the resolved config (YAML + --set overrides "
+                        "+ defaults) as YAML and exit without touching "
+                        "devices — the debugging aid for multi-host runs "
+                        "where every host must resolve identically")
     return p.parse_args(argv)
 
 
@@ -70,6 +75,11 @@ def main(argv=None) -> int:
     _honor_platform_env()
     args = parse_args(argv)
     config = load_config(args.config, overrides=args.overrides)
+    if args.print_config:
+        import yaml
+
+        print(yaml.safe_dump(config.to_dict(), sort_keys=False))
+        return 0
     from distributed_tensorflow_framework_tpu.train import Trainer
 
     trainer = Trainer(config)
