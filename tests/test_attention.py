@@ -157,6 +157,57 @@ def test_ring_attention_mask_and_gradients(devices, monkeypatch, chunk_impl):
                                    rtol=5e-4, atol=5e-5, err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("chunk_impl", ["xla", "flash"])
+def test_ring_attention_data_seq_mesh_trailing_padding(devices, monkeypatch,
+                                                       chunk_impl):
+    """Ring on a COMBINED data×seq mesh (4×2) with document-style
+    trailing padding — half the rows have their entire second KV chunk
+    padded — through BOTH per-chunk implementations (an all-f32-min
+    bias chunk must stay finite in the flash kernels too). Pinned by the
+    round-5 dp+sp+ep forensics: this exact shape was suspected when a
+    composed ring+MoE run went flat, and the probe that exonerated the
+    op (fwd + all grads ≤1.1e-6 vs reference) is kept here so the
+    composition's attention substrate stays provably exact. Loss weights
+    valid positions only, like the MLM objective."""
+    from distributed_tensorflow_framework_tpu.core.config import MeshConfig
+    from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+    from distributed_tensorflow_framework_tpu.parallel import ring
+    from distributed_tensorflow_framework_tpu.parallel.ring import (
+        ring_attention_sharded,
+    )
+
+    monkeypatch.setattr(
+        ring, "FLASH_CHUNK_MIN", 0 if chunk_impl == "flash" else 10**9)
+    mesh = create_mesh(MeshConfig(data=4, seq=2))
+    B, S = 8, 256
+    q, k, v = _rand_qkv(jax.random.key(23), b=B, s=S, h=2, d=32)
+    valid = np.ones((B, S), bool)
+    valid[:4, 80:] = False          # rows 0-3: 80-token docs → chunk 2 all pad
+    mask = jnp.asarray(valid)[:, None, None, :]
+    w = jnp.asarray(valid, jnp.float32)[:, :, None, None]
+
+    def loss_ring(q, k, v):
+        out = ring_attention_sharded(q, k, v, mesh=mesh, mask=mask)
+        return jnp.sum(jnp.sin(out.astype(jnp.float32)) * w)
+
+    def loss_ref(q, k, v):
+        out = dot_product_attention(q, k, v, mask=mask)
+        return jnp.sum(jnp.sin(out.astype(jnp.float32)) * w)
+
+    out_ring = jax.jit(
+        lambda q, k, v: ring_attention_sharded(q, k, v, mesh=mesh, mask=mask)
+    )(q, k, v)
+    out_ref = dot_product_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(out_ring) * np.asarray(w), np.asarray(out_ref) * np.asarray(w),
+        rtol=2e-5, atol=2e-5)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5, err_msg=f"d{name}")
+
+
 def test_flash_chunk_guards(devices):
     """flash_attention_chunk must refuse shapes its grid would silently
     truncate: non-multiple-of-BLOCK_Q chunk lengths (e.g. seq/ring_shards
