@@ -180,6 +180,16 @@ class ModelConfig:
     # "sorted" (argsort+gather dispatch, O(B·E·C) tables — the scalable
     # default) or "dense" (one-hot einsum dispatch, the parity reference).
     moe_dispatch: str = "sorted"
+    # Router z-loss (ST-MoE): penalizes mean(logsumexp(router logits)^2),
+    # shrinking logit magnitudes so routing stays near-uniform early —
+    # the measured round-5 failure mode is a seed-dependent router-
+    # collapse basin (docs/DISTRIBUTED.md "Operating note"). RELATIVE
+    # weight: the trainer multiplies the whole MoE aux output (balance
+    # aux + moe_zloss_weight * zloss) by train.moe_aux_weight, so with
+    # the 0.01 default, moe_zloss_weight=0.1 lands on ST-MoE's canonical
+    # 1e-3 absolute z weight. 0 disables (default — bit-identical to
+    # pre-knob behavior).
+    moe_zloss_weight: float = 0.0
     # Pipeline parallelism (parallel/pipeline.py): >1 splits the encoder
     # stack into this many stages over the `pipe` mesh axis (must equal the
     # mesh's pipe size) with microbatched GPipe scheduling.

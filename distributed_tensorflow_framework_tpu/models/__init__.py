@@ -202,6 +202,7 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             expert_topk=config.expert_topk,
             capacity_factor=config.capacity_factor,
             moe_dispatch=config.moe_dispatch,
+            moe_zloss_weight=config.moe_zloss_weight,
             remat=config.remat,
         )
     raise ValueError(f"Unknown model {config.name!r}")

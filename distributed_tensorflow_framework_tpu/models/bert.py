@@ -127,6 +127,7 @@ class EncoderLayer(nn.Module):
     expert_topk: int = 2
     capacity_factor: float = 1.25
     moe_dispatch: str = "sorted"
+    moe_zloss_weight: float = 0.0
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = True, segment_ids=None):
@@ -147,6 +148,7 @@ class EncoderLayer(nn.Module):
                 num_experts=self.num_experts, mlp_dim=self.mlp_dim,
                 topk=self.expert_topk, capacity_factor=self.capacity_factor,
                 dispatch_impl=self.moe_dispatch,
+                zloss_weight=self.moe_zloss_weight,
                 dtype=self.dtype, name="moe",
             )(x)
         else:
@@ -238,6 +240,7 @@ class BertForMLM(nn.Module):
     expert_topk: int = 2
     capacity_factor: float = 1.25
     moe_dispatch: str = "sorted"
+    moe_zloss_weight: float = 0.0
     # Rematerialize each encoder layer in the backward pass
     # (jax.checkpoint): activations are recomputed per layer instead of
     # stored, cutting activation memory from O(layers) to O(1) layers at
@@ -292,6 +295,7 @@ class BertForMLM(nn.Module):
                 expert_topk=self.expert_topk,
                 capacity_factor=self.capacity_factor,
                 moe_dispatch=self.moe_dispatch,
+                moe_zloss_weight=self.moe_zloss_weight,
                 name=f"layer{i}",
             )(x, mask, train, segment_ids)
             if use_moe:

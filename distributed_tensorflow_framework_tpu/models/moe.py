@@ -227,6 +227,10 @@ class MoEMlp(nn.Module):
     # ``expert`` instead of an involuntary full remat; both dispatchers
     # now partition dp+ep+tp warning-free (verified in the dryrun gate).
     dispatch_impl: str = "sorted"
+    # Router z-loss weight RELATIVE to the balance aux (see
+    # core/config.py ModelConfig.moe_zloss_weight for the weighting
+    # contract). 0 = off, bit-identical to the pre-knob module.
+    zloss_weight: float = 0.0
 
     @nn.compact
     def __call__(self, x: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -247,6 +251,16 @@ class MoEMlp(nn.Module):
             e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
             kernel_init=dense_kernel_init, name="gate",
         )(x.astype(jnp.float32))
+        zloss = jnp.zeros((), jnp.float32)
+        if self.zloss_weight:
+            # ST-MoE router z-loss: mean over tokens of logsumexp(logits)².
+            # Bounds router-logit magnitude so early reduction-order noise
+            # cannot push the softmax into a winner-take-all collapse
+            # (PERF_NOTES round-5 forensics); gradient is well-defined and
+            # small near uniform logits.
+            z = jax.scipy.special.logsumexp(gate_logits, axis=-1)  # (B,S)
+            zloss = jnp.mean(jnp.square(z))
+            self.sow("intermediates", "moe_zloss", zloss)
 
         wi = self.param("wi", expert_kernel_init, (e, h, self.mlp_dim),
                         jnp.float32)
@@ -333,4 +347,4 @@ class MoEMlp(nn.Module):
         else:
             out = jnp.einsum("bsec,bech->bsh", combine.astype(self.dtype),
                              oe)
-        return out, aux_loss
+        return out, aux_loss + self.zloss_weight * zloss

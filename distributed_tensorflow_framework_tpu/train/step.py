@@ -80,6 +80,21 @@ class StepBuilder:
                 "train.grad_allreduce_accum must be 'float32' or 'wire', "
                 f"got {config.train.grad_allreduce_accum!r}"
             )
+        if (self.task == "mlm"
+                and getattr(config.data, "vocab_size", None) is not None
+                and config.data.vocab_size > config.model.vocab_size):
+            # Token ids at/above the embedding size clamp silently under
+            # jit and the CE loss on out-of-range TARGETS goes NaN on the
+            # first step — measured: a drive with model.vocab_size=512
+            # over the recipe's 30522-token synthetic stream was
+            # loss=nan at step 1 with nothing pointing at the cause.
+            raise ValueError(
+                f"data.vocab_size={config.data.vocab_size} exceeds "
+                f"model.vocab_size={config.model.vocab_size}: the stream "
+                f"can emit token ids the embedding/MLM head cannot "
+                f"represent (silent clamp + NaN loss). Shrink "
+                f"data.vocab_size or grow model.vocab_size."
+            )
         if self.shard_map_mode and mesh.shape.get("expert", 1) > 1:
             raise ValueError(
                 "spmd_mode='shard_map' is the pure-DP reference-parity path; "
@@ -236,16 +251,28 @@ class StepBuilder:
                 logits, new_model_state = out
             else:
                 logits, new_model_state = out, {}
-            drop_fracs = None
+            drop_fracs = zlosses = None
             if want_drop:
                 new_model_state = dict(new_model_state)
                 inter = new_model_state.pop("intermediates", {})
                 # Filter by key so other sown intermediates can never
-                # leak into this metric.
+                # leak into these metrics.
+                leaves = jax.tree_util.tree_flatten_with_path(inter)[0]
                 drop_fracs = [
-                    leaf for path, leaf in
-                    jax.tree_util.tree_flatten_with_path(inter)[0]
+                    leaf for path, leaf in leaves
                     if any(getattr(k, "key", None) == "moe_drop_frac"
+                           for k in path)
+                ]
+                # Router z-loss diagnostic (sown only when the knob is
+                # armed): surfaced separately so moe_aux_loss — which the
+                # loss-side contract makes balance-aux PLUS the weighted
+                # z term — can be disambiguated when reading the
+                # collapse signature (docs/DISTRIBUTED.md). Like
+                # moe_drop_frac, dies under model.remat (sow is dropped
+                # in replayed segments) — accepted diagnostic limitation.
+                zlosses = [
+                    leaf for path, leaf in leaves
+                    if any(getattr(k, "key", None) == "moe_zloss"
                            for k in path)
                 ]
             if self.task == "mlm":
@@ -265,6 +292,8 @@ class StepBuilder:
                     # per-microbatch mean) — fine for a diagnostic.
                     metrics["moe_drop_frac"] = jnp.mean(
                         jnp.stack(drop_fracs))
+                if zlosses:
+                    metrics["moe_zloss"] = jnp.mean(jnp.stack(zlosses))
             else:
                 aux_logits = None
                 if isinstance(logits, dict):  # Inception aux head

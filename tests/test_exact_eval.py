@@ -213,6 +213,7 @@ def test_native_reader_eval_rejected_at_build(devices, tmp_path):
                   "num_layers": 1, "num_heads": 2, "mlp_dim": 64,
                   "max_seq_len": 16, "dtype": "float32"},
         "data": {"name": "text_mlm", "data_dir": root, "seq_len": 16,
+                 "vocab_size": 512,  # match the model (vocab guard)
                  "global_batch_size": 8, "use_native_reader": True},
         "train": {"total_steps": 2, "eval_steps": 2},
     })

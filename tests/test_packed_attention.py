@@ -169,8 +169,11 @@ def test_packed_train_step_end_to_end(devices):
                   "num_layers": 1, "num_heads": 2, "mlp_dim": 64,
                   "max_seq_len": 32, "dtype": "float32",
                   "attention_impl": "pallas"},
-        "data": {"name": "synthetic_mlm", "global_batch_size": 8,
-                 "seq_len": 32},
+        # data.vocab_size must not exceed the model's — StepBuilder now
+        # rejects the mismatch (the default-30522 stream would feed token
+        # ids the 512-entry embedding clamps silently).
+        "data": {"name": "synthetic_mlm", "vocab_size": 512,
+                 "global_batch_size": 8, "seq_len": 32},
         "optimizer": {"name": "adamw", "learning_rate": 1e-3},
         "train": {"total_steps": 1},
     })
