@@ -17,7 +17,17 @@ intensity below the ridge means the step is HBM-bandwidth-bound.
 Measured numbers and analysis are recorded in PERF_NOTES.md.
 
 Set BENCH_TRACE=<dir> to also capture an XPlane trace of the timed window
-(core/profiling.trace) for TensorBoard/Perfetto inspection.
+(core/profiling.trace) for TensorBoard/Perfetto inspection; the compiled
+HLO text is dumped next to it so scripts/analyze_trace.py can attribute
+trace events to source scopes.
+
+Besides the stdout line (the driver contract), every result/failure is
+also appended as a schema-versioned telemetry event (core/telemetry,
+docs/OBSERVABILITY.md) with per-collective byte counts, joinable with a
+training run's events.jsonl by run id. BENCH_JSONL=<path> overrides the
+sink (default: <BENCH_TRACE>/bench_events.jsonl, else ./bench_events.jsonl;
+BENCH_JSONL=0 disables). BENCH_WAIT=<minutes> arms a bounded backend-init
+retry budget (see _init_backend).
 """
 
 from __future__ import annotations
@@ -57,11 +67,32 @@ def _compile_and_time(builder, state, batch, steps: int, warmup: int) -> dict:
     import jax
 
     from distributed_tensorflow_framework_tpu.core.profiling import trace
+    from distributed_tensorflow_framework_tpu.parallel import collectives as coll
 
     step = builder.make_train_step(batch)
     flops_per_step = bytes_per_step = None
+    collectives = None
+    trace_dir = os.environ.get("BENCH_TRACE")
     try:
-        compiled = step.lower(state, batch).compile()
+        # Collective byte counters record at JAX *trace* time, and
+        # lower() IS the trace (it also populates the jit call cache, so
+        # the timed loop below never re-traces) — tally around it and the
+        # counts describe every timed step.
+        with coll.tally() as tly:
+            lowered = step.lower(state, batch)
+        collectives = tly.summary()
+        compiled = lowered.compile()
+        if trace_dir:
+            # The optimized-HLO side channel scripts/analyze_trace.py uses
+            # for scope attribution (same layout as ProfileHook's dump).
+            try:
+                os.makedirs(trace_dir, exist_ok=True)
+                hlo_path = os.path.join(trace_dir, "train_step.hlo.txt")
+                with open(hlo_path, "w") as fh:
+                    fh.write(compiled.as_text())
+            except Exception as e:
+                print(f"bench: HLO dump failed ({type(e).__name__}: {e})",
+                      file=sys.stderr)
         ca = compiled.cost_analysis()
         ca = ca[0] if isinstance(ca, (list, tuple)) else ca
         flops_per_step = float(ca.get("flops", 0.0)) or None
@@ -78,7 +109,6 @@ def _compile_and_time(builder, state, batch, steps: int, warmup: int) -> dict:
     for _ in range(warmup):
         state, metrics = step(state, batch)
     sync(state)
-    trace_dir = os.environ.get("BENCH_TRACE")
     ctx = trace(trace_dir) if trace_dir else contextlib.nullcontext()
     with ctx:
         t0 = time.perf_counter()
@@ -90,6 +120,7 @@ def _compile_and_time(builder, state, batch, steps: int, warmup: int) -> dict:
         "sec_per_step": dt / steps,
         "flops_per_step": flops_per_step,
         "bytes_per_step": bytes_per_step,
+        "collectives": collectives,
     }
 
 
@@ -331,12 +362,22 @@ def bench_bert(batch_size: int, steps: int = 20, warmup: int = 3,
     return out
 
 
-def _annotate_roofline(out: dict, result: dict, chip: str, n_chips: int) -> None:
+def _annotate_roofline(out: dict, result: dict, chip: str, n_chips: int,
+                       *, accum_scaled: bool = False) -> None:
     """Achieved TFLOP/s, MFU, arithmetic intensity and the bottleneck
-    verdict from the XLA cost model + public chip peaks."""
+    verdict from the XLA cost model + public chip peaks.
+
+    ``accum_scaled``: the flops/bytes were multiplied by the accum trip
+    count (bench_bert) and the once-per-step optimizer traffic got scaled
+    with them, so hbm_bw_util is an UPPER bound and arith_intensity a
+    LOWER bound. Tag the output so accum and non-accum artifacts are not
+    read as directly comparable roofline positions.
+    """
     peak = CHIP_PEAKS.get(chip)
     if not result["flops_per_step"]:
         return
+    if accum_scaled:
+        out["roofline_bound"] = "accum-scaled-upper"
     achieved = result["flops_per_step"] / result["sec_per_step"] / n_chips
     out["tflops_per_sec"] = round(achieved / 1e12, 2)
     intensity = None
@@ -357,10 +398,10 @@ def _annotate_roofline(out: dict, result: dict, chip: str, n_chips: int) -> None
 
 
 def _run_ladder(bench_fn, sizes, failure_metric: str, failure_unit: str,
-                chip: str):
+                chip: str, writer=None):
     """Try batch sizes largest-first (OOM → retry smaller); on total
-    failure print the zero-value JSON line (with the last error) and
-    return None."""
+    failure print the zero-value JSON line (with the last error), mirror
+    it as a telemetry failure event, and return None."""
     last = "no batch size attempted"
     for bs in sizes:
         try:
@@ -368,9 +409,16 @@ def _run_ladder(bench_fn, sizes, failure_metric: str, failure_unit: str,
         except Exception as e:
             last = f"batch {bs}: {type(e).__name__}: {e}"
             print(f"bench: {last}, retrying", file=sys.stderr)
-    print(json.dumps({"metric": failure_metric, "value": 0.0,
-                      "unit": failure_unit, "vs_baseline": 0.0,
-                      "chip": chip, "error": last}))
+    fail = {"metric": failure_metric, "value": 0.0, "unit": failure_unit,
+            "vs_baseline": 0.0, "chip": chip, "error": last}
+    if writer is not None:
+        from distributed_tensorflow_framework_tpu.core import telemetry
+
+        fail["run_id"] = writer.run_id
+        writer.emit(telemetry.KIND_FAILURE,
+                    health={"failure": "bench_ladder", "error": last},
+                    metric=failure_metric, chip=chip)
+    print(json.dumps(fail))
     return None
 
 
@@ -381,7 +429,67 @@ def _ladder_override(default: tuple, n_chips: int) -> tuple:
     return default
 
 
-def _init_backend(attempts: int = 3, probe_timeout_s: float = 240.0):
+class BenchBackendError(RuntimeError):
+    """Backend bring-up failure carrying the full probe history, so the
+    structured failure line records WHAT was tried, not just the last
+    stderr fragment (VERDICT item 2)."""
+
+    def __init__(self, message: str, probe_history: list[dict]):
+        super().__init__(message)
+        self.probe_history = probe_history
+
+
+def _probe_device_count(timeout_s: float) -> tuple[str, object]:
+    """One SUBPROCESS probe of ``jax.devices()`` under a hard timeout.
+
+    Returns ``("ok", None)``, ``("error", last_stderr_line)`` for a probe
+    that exited nonzero, or ``("hang", pid)`` for one that outlived the
+    timeout — the hung child is ABANDONED alive (see _init_backend).
+    """
+    import subprocess
+
+    # start_new_session: the abandoned child must survive this process's
+    # exit / Ctrl-C (a group SIGINT would kill it mid-handshake — the
+    # exact wedge this code exists to avoid).
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices(); "
+         "print(len(d), d[0].device_kind, sep='\\t')"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+    try:
+        _, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        # Leave the child running. Drop our pipe ends so it can't block
+        # on a full pipe once we're gone.
+        for p in (proc.stdout, proc.stderr):
+            if p is not None:
+                p.close()
+        return "hang", proc.pid
+    if proc.returncode == 0:
+        return "ok", None
+    return "error", (err.strip().splitlines() or ["no stderr"])[-1]
+
+
+def _bench_wait_budget_s() -> float:
+    """BENCH_WAIT → retry budget in seconds (0 = legacy 3-attempt mode).
+
+    The value is minutes; a non-numeric truthy value (BENCH_WAIT=y) means
+    the default hour. Unset/empty/0 keeps the fast-fail behavior."""
+    raw = os.environ.get("BENCH_WAIT", "").strip()
+    if raw in ("", "0"):
+        return 0.0
+    try:
+        return max(0.0, float(raw) * 60.0)
+    except ValueError:
+        return 60.0 * 60.0
+
+
+def _init_backend(attempts: int = 3, probe_timeout_s: float = 240.0, *,
+                  wait_budget_s: float | None = None,
+                  retry_interval_s: float = 300.0,
+                  probe=None, sleep=None, monotonic=None):
     """Bounded, *subprocess-probed* backend bring-up.
 
     Round 3's perf evidence was erased by a wedged TPU tunnel: a bare
@@ -391,74 +499,149 @@ def _init_backend(attempts: int = 3, probe_timeout_s: float = 240.0):
     recovered in-process (the first backend touch caches forever), so
     each attempt probes ``jax.device_count()`` in a SUBPROCESS under a
     hard timeout; only after a probe succeeds do we touch the backend
-    here. Returns (n_chips, device_kind) or raises RuntimeError with the
-    last failure reason.
+    here. Returns (n_chips, device_kind) or raises BenchBackendError
+    carrying the per-probe history.
 
-    A timed-out probe is ABANDONED, never killed: both observed tunnel
-    wedges (round 3, and round 4's BERT ladder) immediately followed a
-    SIGKILL of a client mid-backend-handshake — the remote terminal's
-    libtpu client survives the local kill and holds the chip, wedging
-    every later dial for the rest of the session. A slow-but-alive probe
-    that eventually completes exits harmlessly; an orphaned remote
-    handshake never recovers. For the same reason the timeout is long
-    (4 min): it should only ever fire on a truly dead tunnel, not on a
-    bring-up that is merely slow under host CPU load.
+    Two retry regimes for fast-FAILING probes:
+
+      * default: ``attempts`` tries with short backoff — a broken env
+        fails the dial quickly;
+      * BENCH_WAIT=<minutes> (``wait_budget_s``): re-probe every
+        ``retry_interval_s`` (5 min) until the budget is spent — for
+        dials raced against a slice that is still being provisioned,
+        where "wait up to an hour" beats "fail in 15 s".
+
+    A timed-out probe is ABANDONED, never killed, and is NEVER retried
+    (in either regime — a fresh probe would just queue behind the
+    abandoned one's exclusive chip client and burn another timeout):
+    both observed tunnel wedges (round 3, and round 4's BERT ladder)
+    immediately followed a SIGKILL of a client mid-backend-handshake —
+    the remote terminal's libtpu client survives the local kill and
+    holds the chip, wedging every later dial for the rest of the
+    session. A slow-but-alive probe that eventually completes exits
+    harmlessly; an orphaned remote handshake never recovers. For the
+    same reason the timeout is long (4 min): it should only ever fire on
+    a truly dead tunnel, not on a bring-up that is merely slow under
+    host CPU load.
+
+    ``probe``/``sleep``/``monotonic`` are injectable for tests.
     """
-    import subprocess
     import time
 
-    last_err = "unknown"
-    for attempt in range(attempts):
-        # start_new_session: the abandoned child must survive this
-        # process's exit / Ctrl-C (a group SIGINT would kill it
-        # mid-handshake — the exact wedge this code exists to avoid).
-        proc = subprocess.Popen(
-            [sys.executable, "-c",
-             "import jax; d = jax.devices(); "
-             "print(len(d), d[0].device_kind, sep='\\t')"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            start_new_session=True,
-        )
-        try:
-            _, err = proc.communicate(timeout=probe_timeout_s)
-        except subprocess.TimeoutExpired:
-            # Leave the child running (see docstring). Drop our pipe
-            # ends so it can't block on a full pipe once we're gone.
-            for p in (proc.stdout, proc.stderr):
-                if p is not None:
-                    p.close()
-            # No retry after a hang: the chip client is exclusive, so a
-            # fresh probe would just queue behind the abandoned one and
-            # burn another timeout. Retries are for fast-FAILING probes.
-            raise RuntimeError(
-                f"backend probe still hung after {probe_timeout_s:.0f}s "
-                f"(left alive, pid {proc.pid} — killing it can wedge "
-                f"the tunnel)")
-        if proc.returncode == 0:
+    probe = probe or _probe_device_count
+    sleep = sleep or time.sleep
+    monotonic = monotonic or time.monotonic
+    if wait_budget_s is None:
+        wait_budget_s = _bench_wait_budget_s()
+
+    history: list[dict] = []
+    t0 = monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        p0 = monotonic()
+        outcome, payload = probe(probe_timeout_s)
+        history.append({
+            "attempt": attempt,
+            "t": time.time(),
+            "elapsed_s": round(monotonic() - p0, 1),
+            "outcome": outcome,
+            "error": None if outcome == "ok" else str(payload),
+        })
+        if outcome == "ok":
             import jax
 
             return jax.device_count(), jax.devices()[0].device_kind
-        last_err = (err.strip().splitlines() or ["no stderr"])[-1]
-        print(f"bench: backend init attempt {attempt + 1}/{attempts} "
-              f"failed ({last_err})", file=sys.stderr)
-        if attempt + 1 < attempts:
-            time.sleep(5 * (attempt + 1))
-    raise RuntimeError(last_err)
+        if outcome == "hang":
+            raise BenchBackendError(
+                f"backend probe still hung after {probe_timeout_s:.0f}s "
+                f"(left alive, pid {payload} — killing it can wedge "
+                f"the tunnel)", history)
+        print(f"bench: backend init attempt {attempt} failed ({payload})",
+              file=sys.stderr)
+        if wait_budget_s > 0:
+            elapsed = monotonic() - t0
+            if elapsed + retry_interval_s > wait_budget_s:
+                raise BenchBackendError(
+                    f"backend init failed for {elapsed / 60:.1f} min "
+                    f"({attempt} probes, BENCH_WAIT budget "
+                    f"{wait_budget_s / 60:.0f} min): {payload}", history)
+            sleep(retry_interval_s)
+        else:
+            if attempt >= attempts:
+                raise BenchBackendError(str(payload), history)
+            sleep(5 * attempt)
 
 
-def main() -> int:
+_ROOFLINE_KEYS = ("tflops_per_sec", "mfu", "arith_intensity", "bound",
+                  "hbm_bw_util", "roofline_bound")
+
+
+def _bench_writer():
+    """Telemetry sink for this bench invocation (module docstring)."""
+    from distributed_tensorflow_framework_tpu.core import telemetry
+
+    path = os.environ.get("BENCH_JSONL", "").strip()
+    if path.lower() in ("0", "off", "none"):
+        path = None
+    elif not path:
+        trace_dir = os.environ.get("BENCH_TRACE")
+        path = (os.path.join(trace_dir, "bench_events.jsonl")
+                if trace_dir else "bench_events.jsonl")
+    return telemetry.TelemetryWriter(
+        path, run_id=os.environ.get("BENCH_RUN_ID") or None)
+
+
+def _emit_bench_result(writer, workload: str, out: dict, result: dict) -> None:
+    """Mirror the stdout JSON line as a schema-versioned bench event, with
+    the cost-model raw numbers and per-collective byte counts attached."""
+    from distributed_tensorflow_framework_tpu.core import telemetry
+
+    metrics = {"value": out["value"], "sec_per_step": result["sec_per_step"]}
+    for k in ("flops_per_step", "bytes_per_step"):
+        if result.get(k):
+            metrics[k] = result[k]
+    roofline = {k: out[k] for k in _ROOFLINE_KEYS if k in out} or None
+    extra = {k: v for k, v in out.items()
+             if k not in metrics and k not in _ROOFLINE_KEYS
+             and k != "run_id"}
+    writer.emit(telemetry.KIND_BENCH, metrics=metrics, roofline=roofline,
+                collectives=result.get("collectives"), workload=workload,
+                **extra)
+
+
+def _run(writer) -> int:
+    from distributed_tensorflow_framework_tpu.core import telemetry
+
     workload = os.environ.get("BENCH_WORKLOAD", "resnet50")
     metric = {"bert": "bert_base_mlm_examples_per_sec_per_chip",
               "inception": "inception_v3_images_per_sec_per_chip"}.get(
         workload, "resnet50_images_per_sec_per_chip")
     unit = ("examples/sec/chip" if workload == "bert" else "images/sec/chip")
+    writer.emit_run_meta(
+        argv=sys.argv, workload=workload,
+        bench_env={k: v for k, v in sorted(os.environ.items())
+                   if k.startswith("BENCH_")})
     try:
         n_chips, chip = _init_backend()
     except Exception as e:
         # Structured failure line: the driver still gets valid JSON (and
-        # the error cause) when the environment, not the code, is broken.
-        print(json.dumps({"metric": metric, "value": 0.0, "unit": unit,
-                          "vs_baseline": 0.0, "error": f"backend init: {e}"}))
+        # the error cause + full probe history) when the environment, not
+        # the code, is broken.
+        history = list(getattr(e, "probe_history", None) or [])
+        for rec in history:
+            writer.emit(telemetry.KIND_BENCH_PROBE, t=rec.get("t"),
+                        health={k: rec.get(k) for k in
+                                ("attempt", "elapsed_s", "outcome", "error")})
+        writer.emit(telemetry.KIND_FAILURE,
+                    health={"failure": "backend_init", "error": str(e),
+                            "num_probes": len(history)})
+        fail = {"metric": metric, "value": 0.0, "unit": unit,
+                "vs_baseline": 0.0, "error": f"backend init: {e}",
+                "run_id": writer.run_id}
+        if history:
+            fail["probe_history"] = history
+        print(json.dumps(fail))
         return 1
 
     if workload == "bert":
@@ -488,7 +671,7 @@ def main() -> int:
             lambda bs: bench_bert(bs, seq_len=seq, attention_impl=attn,
                                   remat=remat, pack=pack,
                                   fused_qkv=fused_qkv, accum=accum),
-            ladder, metric, unit, chip)
+            ladder, metric, unit, chip, writer=writer)
         if result is None:
             return 1
         out = {
@@ -515,8 +698,11 @@ def main() -> int:
                 result["real_tokens_per_sec"] / n_chips, 1),
             "docs_per_sec_per_chip": round(
                 result["docs_per_sec"] / n_chips, 2),
+            "run_id": writer.run_id,
         }
-        _annotate_roofline(out, result, chip, n_chips)
+        _annotate_roofline(out, result, chip, n_chips,
+                           accum_scaled=accum > 1)
+        _emit_bench_result(writer, workload, out, result)
         print(json.dumps(out))
         return 0
 
@@ -526,7 +712,8 @@ def main() -> int:
         # this reports absolute rate + roofline position only.
         ladder = _ladder_override(
             (128 * n_chips, 64 * n_chips, 32 * n_chips), n_chips)
-        result = _run_ladder(bench_inception, ladder, metric, unit, chip)
+        result = _run_ladder(bench_inception, ladder, metric, unit, chip,
+                             writer=writer)
         if result is None:
             return 1
         out = {
@@ -537,14 +724,17 @@ def main() -> int:
             "baseline_kind": "none",
             "chip": chip,
             "num_chips": n_chips,
+            "run_id": writer.run_id,
         }
         _annotate_roofline(out, result, chip, n_chips)
+        _emit_bench_result(writer, workload, out, result)
         print(json.dumps(out))
         return 0
 
     ladder = _ladder_override(
         (256 * n_chips, 128 * n_chips, 64 * n_chips), n_chips)
-    result = _run_ladder(bench_resnet50, ladder, metric, unit, chip)
+    result = _run_ladder(bench_resnet50, ladder, metric, unit, chip,
+                         writer=writer)
     if result is None:
         return 1
 
@@ -562,10 +752,20 @@ def main() -> int:
         "baseline_value": TARGET_PER_CHIP,
         "chip": chip,
         "num_chips": n_chips,
+        "run_id": writer.run_id,
     }
     _annotate_roofline(out, result, chip, n_chips)
+    _emit_bench_result(writer, workload, out, result)
     print(json.dumps(out))
     return 0
+
+
+def main() -> int:
+    writer = _bench_writer()
+    try:
+        return _run(writer)
+    finally:
+        writer.close()
 
 
 if __name__ == "__main__":

@@ -42,6 +42,30 @@ def _unpack(raw: Any, like: TrainState) -> TrainState:
     return raw.replace(rng=jax.random.wrap_key_data(raw.rng, impl=impl))
 
 
+def _param_key_names(tree: Any) -> set[str]:
+    """Every dict-key name appearing anywhere in a params pytree."""
+    names: set[str] = set()
+    for path, _ in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        for p in path:
+            k = getattr(p, "key", None)
+            if isinstance(k, str):
+                names.add(k)
+    return names
+
+
+def _attention_layout(key_names: set[str]) -> str | None:
+    """'fused' / 'unfused' QKV projection layout, or None if the tree has
+    no attention projections at all (conv nets). The fused module stores
+    one ``attn/qkv`` kernel; unfused stores ``attn/{query,key,value}``
+    (models/bert.py) — require the full triple so a stray generic 'key'
+    entry can't misclassify."""
+    if "qkv" in key_names:
+        return "fused"
+    if {"query", "key", "value"} <= key_names:
+        return "unfused"
+    return None
+
+
 class CheckpointManager:
     def __init__(self, config: CheckpointConfig, *, is_chief: bool = True):
         if not config.directory:
@@ -95,6 +119,7 @@ class CheckpointManager:
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
+        self._check_attention_layout(step, template)
 
         want_ema = bool(jax.tree.leaves(template.ema_params))
 
@@ -180,6 +205,52 @@ class CheckpointManager:
             if keys and keys[0].get("key") == "ema_params" and len(keys) > 1:
                 return True
         return False
+
+    def _stored_param_key_names(self, step: int) -> set[str] | None:
+        """Dict-key names under the stored tree's ``params`` subtree, from
+        the step's PyTree ``_METADATA`` JSON; None when unreadable (the
+        probe is best-effort, like ``_stored_has_ema``)."""
+        import json
+
+        path = os.path.join(self._path, str(step), "state", "_METADATA")
+        try:
+            with open(path) as fh:
+                tree_meta = json.load(fh).get("tree_metadata", {})
+        except Exception:
+            return None
+        names: set[str] = set()
+        for entry in tree_meta.values():
+            keys = [k.get("key") for k in (entry.get("key_metadata") or [])]
+            if keys and keys[0] == "params":
+                names.update(k for k in keys[1:] if isinstance(k, str))
+        return names or None
+
+    def _check_attention_layout(self, step: int, template: TrainState) -> None:
+        """Fail fast, with the fix named, when the stored params use the
+        opposite ``model.fused_qkv`` layout from the restore template.
+
+        Without this the mismatch surfaces as an opaque Orbax tree-structure
+        error deep inside StandardRestore ('user-provided restore item and
+        on-disk value metadata tree structures do not match'), long after
+        the config change that caused it.
+        """
+        stored_keys = self._stored_param_key_names(step)
+        if stored_keys is None:
+            return
+        stored = _attention_layout(stored_keys)
+        want = _attention_layout(_param_key_names(template.params))
+        if stored is None or want is None or stored == want:
+            return
+        raise ValueError(
+            f"Checkpoint at step {step} in {self._path} stores "
+            f"{stored} attention projections but the model is configured "
+            f"for {want} (model.fused_qkv="
+            f"{'true' if want == 'fused' else 'false'}). Set model."
+            f"fused_qkv to match the checkpoint, or transplant the params "
+            f"— the fused kernel is stack([query, key, value], axis=1) of "
+            f"the unfused kernels, see tests/test_models.py::"
+            f"test_fused_qkv_transplant_parity and docs/MIGRATING.md."
+        )
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()

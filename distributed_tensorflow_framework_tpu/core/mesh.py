@@ -45,7 +45,7 @@ def initialize_distributed() -> None:
     """
     # NOTE: must not touch jax.process_count()/devices() here — any backend
     # query initializes XLA, after which jax.distributed.initialize raises.
-    if jax.distributed.is_initialized():
+    if _distributed_initialized():
         return
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
     num_procs = os.environ.get("JAX_NUM_PROCESSES")
@@ -55,6 +55,21 @@ def initialize_distributed() -> None:
             num_processes=int(num_procs),
             process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
         )
+
+
+def _distributed_initialized() -> bool:
+    """``jax.distributed.is_initialized`` without requiring it to exist —
+    jax < 0.5 has no public probe, but the private global_state.client is
+    the exact value the public API later wrapped."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:  # pragma: no cover - private API moved
+        return False
 
 
 def _resolve_axis_sizes(config: MeshConfig, n: int) -> dict[str, int]:

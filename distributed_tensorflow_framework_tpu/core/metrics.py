@@ -6,15 +6,21 @@ fans out to the console and, when available, a TensorBoard event file
 (written through TF's summary writer — TF is in the image for tf.data), plus
 a ThroughputMeter tracking the BASELINE.json north-star metric
 (images/sec and images/sec/chip).
+
+The on-disk record is the versioned telemetry schema (core/telemetry.py):
+``events.jsonl`` in the logdir, one ``dtf-telemetry/1`` event per write,
+with phase timings, throughput and collective byte counters split into
+their schema fields rather than flattened into one ad-hoc dict.
 """
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import time
 from typing import Any, Mapping
+
+from distributed_tensorflow_framework_tpu.core import telemetry
 
 log = logging.getLogger("dtf_tpu")
 
@@ -40,10 +46,16 @@ class MetricWriter:
         *,
         is_chief: bool = True,
         jsonl: bool = True,
+        run_id: str | None = None,
     ):
         self._enabled = is_chief
         self._tb = None
-        self._jsonl_fh = None
+        self.telemetry = telemetry.TelemetryWriter(
+            os.path.join(logdir, "events.jsonl") if (logdir and jsonl) else None,
+            run_id=run_id,
+            is_chief=is_chief,
+        )
+        self.run_id = self.telemetry.run_id
         if not self._enabled:
             return
         if logdir:
@@ -54,12 +66,15 @@ class MetricWriter:
                 self._tb = tf.summary.create_file_writer(logdir)
             except Exception:  # pragma: no cover - TF missing/broken
                 log.warning("TensorBoard writer unavailable; console only")
-            if jsonl:
-                self._jsonl_fh = open(
-                    os.path.join(logdir, "metrics.jsonl"), "a", buffering=1
-                )
 
-    def write(self, step: int, values: Mapping[str, Any]) -> None:
+    def write(
+        self,
+        step: int,
+        values: Mapping[str, Any],
+        *,
+        kind: str = telemetry.KIND_TRAIN_STEP,
+        collectives: Mapping[str, Any] | None = None,
+    ) -> None:
         if not self._enabled:
             return
         scalars = {k: _to_scalar(v) for k, v in values.items()}
@@ -73,15 +88,18 @@ class MetricWriter:
                     if isinstance(v, (int, float)):
                         tf.summary.scalar(k, v, step=step)
                 self._tb.flush()
-        if self._jsonl_fh is not None:
-            self._jsonl_fh.write(
-                json.dumps({"step": step, **scalars}, default=str) + "\n"
-            )
+        metrics, phases, throughput = telemetry.split_metrics(scalars)
+        self.telemetry.emit(
+            kind,
+            step=step,
+            metrics=metrics or None,
+            phases=phases or None,
+            throughput=throughput or None,
+            collectives=collectives,
+        )
 
     def close(self) -> None:
-        if self._jsonl_fh is not None:
-            self._jsonl_fh.close()
-            self._jsonl_fh = None
+        self.telemetry.close()
 
 
 def _to_scalar(v: Any) -> Any:

@@ -34,12 +34,55 @@ FAST_FAIL_COLLECTIVE_FLAGS: tuple[tuple[str, int], ...] = (
 )
 
 
+def xla_flag_supported(name: str) -> bool:
+    """Whether this jaxlib's XLA knows flag ``name``.
+
+    XLA *hard-aborts the process* at first backend init on any unknown
+    flag in XLA_FLAGS (``parse_flags_from_env.cc``) — observed killing
+    every test in the suite when a jaxlib upgrade dropped the
+    ``xla_cpu_collective_call_*`` timeout flags. Registered flag names
+    are compiled into the xla_extension binary as plain strings, so a
+    substring probe of the shared object is a reliable, cheap (mmap'd)
+    check that never needs to initialize a backend. Unknown layouts
+    (no .so found) fail open: the flag is assumed supported, matching
+    the old unconditional behavior.
+    """
+    return name in _xla_binary_flag_blob()
+
+
+_XLA_BINARY_BLOB = None  # bytes | mmap.mmap once probed
+
+
+def _xla_binary_flag_blob():
+    global _XLA_BINARY_BLOB
+    if _XLA_BINARY_BLOB is None:
+        import mmap
+        import pathlib
+
+        blob = b""
+        try:
+            import jaxlib
+
+            root = pathlib.Path(jaxlib.__file__).parent
+            so = next(root.glob("**/xla_extension*.so"), None)
+            if so is not None:
+                with open(so, "rb") as fh:
+                    # mmap: the binary is hundreds of MB; don't copy it.
+                    blob = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+        except Exception:
+            blob = b""
+        _XLA_BINARY_BLOB = blob
+    return _XLA_BINARY_BLOB
+
+
 def with_cpu_collective_timeouts(flags: str, table=None) -> str:
     """Append rendezvous-timeout flags to an XLA_FLAGS string, skipping
-    any flag the caller already set. ``table`` defaults to the
+    any flag the caller already set and any flag this jaxlib's XLA does
+    not register (an unknown flag aborts the process — see
+    ``xla_flag_supported``). ``table`` defaults to the
     long-run-tolerant values; pass FAST_FAIL_COLLECTIVE_FLAGS for the
     relaunch-loop tuning."""
     for name, value in (table or CPU_COLLECTIVE_TIMEOUT_FLAGS):
-        if name not in flags:
+        if name not in flags and xla_flag_supported(name):
             flags += f" --{name}={value}"
     return flags.strip()

@@ -1,0 +1,401 @@
+"""XPlane trace analysis: where did the traced step time actually go?
+
+ProfileHook / BENCH_TRACE capture ``*.xplane.pb`` (an ``XSpace`` proto of
+planes → lines → events). This module turns one into a time-by-category
+breakdown — GEMM/conv, collectives, infeed/host transfers, optimizer
+update, other compute, launch gaps — without any profiler-proto Python
+package: the image ships no ``xplane_pb2``, so a ~80-line protobuf
+wire-format reader below decodes the handful of fields we need. Field
+numbers follow tensorflow/tsl ``xplane.proto`` (stable since 2019).
+
+Attribution has two layers:
+
+  * Event names. XLA trace events are named by HLO *instruction*
+    (``dot.11``, ``all-reduce.3``, ``multiply_add_fusion``) — enough for
+    GEMM/collective/infeed classification by opcode pattern.
+  * Optimized-HLO side channel. Instruction names carry no scope, but the
+    compiled executable's HLO text names instructions identically AND
+    records ``metadata={op_name="...optimizer_update/mul"}`` per op. When
+    the caller passes that text (ProfileHook dumps ``train_step.hlo.txt``
+    next to the trace; bench dumps under BENCH_TRACE), events are mapped
+    through it and scope-based categories (optimizer_update) attach.
+
+The breakdown is exhaustive over the traced window: busy time (union of
+executor-line event intervals) is split over categories proportionally to
+their summed event durations (concurrent executor threads can sum past
+wall time — the proportional split keeps categories + launch_gap == the
+window, so fractions are honest wall-clock shares).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Iterator, Mapping
+
+from distributed_tensorflow_framework_tpu.core import telemetry
+
+# ------------------------------------------------------------------ wire --
+# Minimal protobuf wire-format reader. Wire types: 0 varint, 1 fixed64,
+# 2 length-delimited, 5 fixed32.
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long — corrupt protobuf")
+
+
+def _fields(buf: bytes) -> Iterator[tuple[int, int, Any]]:
+    """Yield (field_number, wire_type, value) over one message's bytes."""
+    pos, end = 0, len(buf)
+    while pos < end:
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            val, pos = buf[pos:pos + 8], pos + 8
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val, pos = buf[pos:pos + ln], pos + ln
+        elif wire == 5:
+            val, pos = buf[pos:pos + 4], pos + 4
+        else:
+            raise ValueError(f"unsupported wire type {wire} (field {field})")
+        yield field, wire, val
+
+
+def _signed(v: int) -> int:
+    """Reinterpret a varint as two's-complement int64 (proto int64)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+# ---------------------------------------------------------------- schema --
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    name: str
+    start_ps: int  # absolute, within the trace's timebase
+    duration_ps: int
+    line: str
+    plane: str
+
+
+def _parse_map_entry(buf: bytes) -> tuple[int, bytes]:
+    key, val = 0, b""
+    for f, _, v in _fields(buf):
+        if f == 1:
+            key = v
+        elif f == 2:
+            val = v
+    return key, val
+
+
+def _parse_event_metadata(buf: bytes) -> str:
+    name = display = ""
+    for f, _, v in _fields(buf):
+        if f == 2:
+            name = v.decode("utf-8", "replace")
+        elif f == 4:
+            display = v.decode("utf-8", "replace")
+    return display or name
+
+
+def parse_xspace(data: bytes) -> list[TraceEvent]:
+    """Decode an XSpace blob into flat TraceEvents (only timed fields)."""
+    events: list[TraceEvent] = []
+    for f, _, plane_buf in _fields(data):
+        if f != 1:  # XSpace.planes
+            continue
+        plane_name = ""
+        metadata: dict[int, str] = {}
+        line_bufs: list[bytes] = []
+        for pf, _, pv in _fields(plane_buf):
+            if pf == 2:  # XPlane.name
+                plane_name = pv.decode("utf-8", "replace")
+            elif pf == 3:  # XPlane.lines
+                line_bufs.append(pv)
+            elif pf == 4:  # XPlane.event_metadata (map)
+                k, v = _parse_map_entry(pv)
+                metadata[k] = _parse_event_metadata(v)
+        for line_buf in line_bufs:
+            line_name = ""
+            ts_ns = 0
+            event_bufs: list[bytes] = []
+            for lf, _, lv in _fields(line_buf):
+                if lf == 2:  # XLine.name
+                    line_name = lv.decode("utf-8", "replace")
+                elif lf == 3:  # XLine.timestamp_ns
+                    ts_ns = _signed(lv)
+                elif lf == 4:  # XLine.events
+                    event_bufs.append(lv)
+                elif lf == 11 and not line_name:  # display_name
+                    line_name = lv.decode("utf-8", "replace")
+            base_ps = ts_ns * 1000
+            for ev_buf in event_bufs:
+                mid = offset = dur = 0
+                for ef, _, evv in _fields(ev_buf):
+                    if ef == 1:
+                        mid = evv
+                    elif ef == 2:
+                        offset = _signed(evv)
+                    elif ef == 3:
+                        dur = _signed(evv)
+                if dur <= 0:
+                    continue  # instantaneous markers carry no time
+                events.append(TraceEvent(
+                    name=metadata.get(mid, f"?{mid}"),
+                    start_ps=base_ps + offset,
+                    duration_ps=dur,
+                    line=line_name,
+                    plane=plane_name,
+                ))
+    return events
+
+
+# ------------------------------------------------------- classification --
+
+CATEGORIES = (
+    "gemm_conv", "collectives", "infeed", "optimizer_update",
+    "other_compute",
+)
+GAP = "launch_gap"
+
+_COLLECTIVE_RE = re.compile(
+    r"all-reduce|all-gather|all-to-all|reduce-scatter|collective-permute"
+    r"|psum|ppermute", re.I)
+_GEMM_RE = re.compile(r"\bdot\b|^dot[._]|convolution|conv[._\d]|gemm|matmul", re.I)
+_INFEED_RE = re.compile(r"infeed|outfeed|copy[-._]|^copy|transfer|buffer[- ]", re.I)
+# Executor lines: XLA:CPU client threads (tf_XLATfrtCpuClient/<n>) or
+# TPU/GPU device streams. The "python" host line (PjitFunction spans etc.)
+# wraps device time and must not be double counted.
+_EXECUTOR_LINE_RE = re.compile(r"XLA|TfrtCpuClient|/device:|Stream|TensorFlow", re.I)
+# Runtime bookkeeping spans that WRAP the real op events on the same lines
+# (ThunkExecutor::Execute covers the whole dispatch including its waits).
+# Dropped entirely: leaf ops define busy time, so wrapper-only time —
+# genuinely waiting — lands in launch_gap instead of other_compute.
+_WRAPPER_EVENT_RE = re.compile(
+    r"ThunkExecutor|TfrtCpuExecutable|PjitFunction|ThreadpoolListener"
+    r"|ExecuteGraph|BufferAllocations|RunId", re.I)
+
+# HLO text: `  %name.1 = f32[...] opcode(...), metadata={op_name="..."}`
+_HLO_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*[^\s]+\s+([\w-]+)\(")
+_HLO_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def parse_hlo_op_map(hlo_text: str) -> dict[str, tuple[str, str]]:
+    """instruction name → (opcode, op_name scope path) from HLO text."""
+    out: dict[str, tuple[str, str]] = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_INSTR_RE.match(line)
+        if not m:
+            continue
+        name, opcode = m.groups()
+        op = _HLO_OPNAME_RE.search(line)
+        out[name] = (opcode, op.group(1) if op else "")
+    return out
+
+
+def classify(name: str, hlo_map: Mapping[str, tuple[str, str]] | None) -> str:
+    opcode, scope = "", ""
+    if hlo_map:
+        opcode, scope = hlo_map.get(name, ("", ""))
+    if "optimizer_update" in scope:
+        return "optimizer_update"
+    subject = f"{name} {opcode}"
+    if _COLLECTIVE_RE.search(subject):
+        return "collectives"
+    if _GEMM_RE.search(subject):
+        return "gemm_conv"
+    if _INFEED_RE.search(subject):
+        return "infeed"
+    return "other_compute"
+
+
+# ----------------------------------------------------------- aggregation --
+
+
+def _union_ps(intervals: list[tuple[int, int]]) -> int:
+    if not intervals:
+        return 0
+    intervals.sort()
+    total = 0
+    cur_s, cur_e = intervals[0]
+    for s, e in intervals[1:]:
+        if s > cur_e:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    return total + (cur_e - cur_s)
+
+
+def analyze(
+    events: list[TraceEvent],
+    hlo_map: Mapping[str, tuple[str, str]] | None = None,
+    *,
+    top_n: int = 15,
+) -> dict[str, Any]:
+    """Category breakdown over the executor window (see module docstring)."""
+    exe = [e for e in events if _EXECUTOR_LINE_RE.search(e.line)]
+    if not exe:
+        # Unknown runtime naming — degrade to every timed event rather
+        # than an empty report.
+        exe = events
+    leaf = [e for e in exe if not _WRAPPER_EVENT_RE.search(e.name)]
+    if leaf:
+        exe = leaf
+    if not exe:
+        raise ValueError("trace contains no timed events")
+
+    window_start = min(e.start_ps for e in exe)
+    window_end = max(e.start_ps + e.duration_ps for e in exe)
+    window_ps = window_end - window_start
+    busy_ps = _union_ps([(e.start_ps, e.start_ps + e.duration_ps) for e in exe])
+    busy_ps = min(busy_ps, window_ps)
+    gap_ps = window_ps - busy_ps
+
+    raw: dict[str, int] = {c: 0 for c in CATEGORIES}
+    per_op: dict[str, int] = {}
+    for e in exe:
+        raw[classify(e.name, hlo_map)] += e.duration_ps
+        per_op[e.name] = per_op.get(e.name, 0) + e.duration_ps
+    raw_total = sum(raw.values()) or 1
+
+    # Proportional wall-clock attribution (see module docstring).
+    breakdown: dict[str, dict[str, float]] = {}
+    for cat in CATEGORIES:
+        wall = busy_ps * raw[cat] / raw_total
+        breakdown[cat] = {
+            "time_ps": int(wall),
+            "fraction_of_window": wall / window_ps if window_ps else 0.0,
+            "summed_event_ps": raw[cat],
+        }
+    breakdown[GAP] = {
+        "time_ps": int(gap_ps),
+        "fraction_of_window": gap_ps / window_ps if window_ps else 0.0,
+        "summed_event_ps": int(gap_ps),
+    }
+    covered = sum(v["time_ps"] for v in breakdown.values())
+
+    top_ops = sorted(per_op.items(), key=lambda kv: -kv[1])[:top_n]
+    return {
+        "window_ps": int(window_ps),
+        "busy_ps": int(busy_ps),
+        "launch_gap_ps": int(gap_ps),
+        "coverage": covered / window_ps if window_ps else 0.0,
+        "num_events": len(exe),
+        "hlo_map_used": bool(hlo_map),
+        "breakdown": breakdown,
+        "top_ops": [
+            {"name": n, "summed_ps": d,
+             "category": classify(n, hlo_map)}
+            for n, d in top_ops
+        ],
+    }
+
+
+# ------------------------------------------------------------ entrypoints --
+
+
+def find_xplane_files(path: str) -> list[str]:
+    """Accept a trace file, a trace dir, or a profiler logdir root."""
+    if os.path.isfile(path):
+        return [path]
+    hits: list[str] = []
+    for root, _, names in os.walk(path):
+        hits.extend(os.path.join(root, n) for n in names
+                    if n.endswith(".xplane.pb"))
+    return sorted(hits)
+
+
+def find_hlo_text(trace_path: str) -> str | None:
+    """Locate a dumped HLO text near the trace (ProfileHook/bench layout)."""
+    d = trace_path if os.path.isdir(trace_path) else os.path.dirname(trace_path)
+    for _ in range(6):  # walk up through plugins/profile/<ts>/ nesting
+        for name in sorted(os.listdir(d) if os.path.isdir(d) else []):
+            if name.endswith(".hlo.txt"):
+                return os.path.join(d, name)
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
+def analyze_trace_file(
+    trace_path: str, hlo_text: str | None = None, *, top_n: int = 15,
+) -> dict[str, Any]:
+    with open(trace_path, "rb") as fh:
+        events = parse_xspace(fh.read())
+    hlo_map = parse_hlo_op_map(hlo_text) if hlo_text else None
+    report = analyze(events, hlo_map, top_n=top_n)
+    report["trace_path"] = trace_path
+    return report
+
+
+def format_report(report: dict[str, Any]) -> str:
+    """Human-readable breakdown table."""
+    def ms(ps: float) -> str:
+        return f"{ps / 1e9:10.3f} ms"
+
+    lines = [
+        f"trace: {report.get('trace_path', '<memory>')}",
+        f"window: {ms(report['window_ps'])}   busy: {ms(report['busy_ps'])}   "
+        f"events: {report['num_events']}   "
+        f"hlo attribution: {'yes' if report['hlo_map_used'] else 'no'}",
+        "",
+        f"{'category':<18} {'wall time':>13} {'% window':>9} {'event sum':>13}",
+    ]
+    for cat in (*CATEGORIES, GAP):
+        b = report["breakdown"][cat]
+        lines.append(
+            f"{cat:<18} {ms(b['time_ps']):>13} "
+            f"{100 * b['fraction_of_window']:>8.1f}% {ms(b['summed_event_ps']):>13}"
+        )
+    lines.append(f"{'TOTAL':<18} {'':>13} {100 * report['coverage']:>8.1f}%")
+    lines.append("")
+    lines.append("top ops by summed event time:")
+    for op in report["top_ops"]:
+        lines.append(
+            f"  {ms(op['summed_ps'])}  [{op['category']:<16}] {op['name']}"
+        )
+    return "\n".join(lines)
+
+
+def write_summary_event(report: dict[str, Any], out_path: str,
+                        run_id: str | None = None) -> dict:
+    """Persist the report as a schema-versioned trace_summary event."""
+    writer = telemetry.TelemetryWriter(out_path, run_id=run_id)
+    try:
+        return writer.emit(
+            telemetry.KIND_TRACE_SUMMARY,
+            metrics={
+                "window_ms": report["window_ps"] / 1e9,
+                "busy_ms": report["busy_ps"] / 1e9,
+                "launch_gap_ms": report["launch_gap_ps"] / 1e9,
+                "coverage": report["coverage"],
+            },
+            phases={
+                cat: report["breakdown"][cat]["time_ps"] / 1e9
+                for cat in (*CATEGORIES, GAP)
+            },
+            trace_path=report.get("trace_path", ""),
+            hlo_map_used=report["hlo_map_used"],
+            top_ops=json.dumps(report["top_ops"][:5]),
+        )
+    finally:
+        writer.close()

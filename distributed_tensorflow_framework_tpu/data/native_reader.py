@@ -47,7 +47,16 @@ def _build() -> str:
     if os.path.exists(lib) and os.path.exists(sidecar):
         with open(sidecar) as f:
             if f.read().strip() == src_hash:
-                return lib
+                # Hash match isn't enough: a committed .so built against a
+                # newer glibc/libjpeg fails dlopen on this host (observed:
+                # GLIBC_2.34 symbols on a 2.31 image). Probe before trusting.
+                try:
+                    ctypes.CDLL(lib)
+                    return lib
+                except OSError as e:
+                    log.warning(
+                        "cached native reader unloadable (%s); rebuilding", e
+                    )
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
            src, "-ljpeg", "-o", lib]
     log.info("building native record reader: %s", " ".join(cmd))

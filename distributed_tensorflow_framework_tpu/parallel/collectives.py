@@ -11,13 +11,108 @@ shard_map training path reads like the reference's pipeline.
 
 from __future__ import annotations
 
-from typing import Any, Sequence
+import contextlib
+from typing import Any, Iterator, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 DATA_AXES = ("data", "fsdp")
+
+
+def axis_size(axis_name) -> int:
+    """Static mesh-axis size inside shard_map, across jax versions.
+
+    ``lax.axis_size`` is newer than 0.4; ``lax.psum`` of a Python literal
+    has always constant-folded to ``size * x`` at trace time, so it
+    yields the same static int on old jaxlibs.
+    """
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions.
+
+    The public ``jax.shard_map`` (with ``check_vma``) landed after 0.4;
+    earlier jaxlibs only have ``jax.experimental.shard_map.shard_map``
+    whose equivalent knob is ``check_rep``. All in-repo call sites go
+    through this wrapper so the version split lives in one place.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as legacy_sm
+
+    return legacy_sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=check_vma)
+
+
+class CollectiveTally:
+    """Per-collective call and byte counters, recorded at JAX *trace* time.
+
+    Every wrapper below reports (kind, payload bytes) for each leaf it
+    lowers while a tally is active. Because jit traces once per shape,
+    wrap the FIRST dispatch (or an explicit lower/compile) in ``tally()``
+    and the numbers describe every subsequent step of that executable.
+
+    Bytes are the logical per-device payload at the collective's wire
+    dtype (size × itemsize of the reduced/gathered operand) — the
+    topology-independent quantity. Per-link ring traffic is
+    ``(n-1)/n × payload`` for reduce/gather collectives; readers that
+    want wire bytes apply that factor with their own axis size.
+    """
+
+    def __init__(self) -> None:
+        self.calls: dict[str, int] = {}
+        self.bytes: dict[str, int] = {}
+
+    def record(self, kind: str, nbytes: int) -> None:
+        self.calls[kind] = self.calls.get(kind, 0) + 1
+        self.bytes[kind] = self.bytes.get(kind, 0) + int(nbytes)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.bytes.values())
+
+    def summary(self) -> dict[str, int]:
+        """Flat dict for the telemetry event's ``collectives`` field."""
+        out: dict[str, int] = {}
+        for kind in sorted(self.calls):
+            out[f"{kind}_calls"] = self.calls[kind]
+            out[f"{kind}_bytes"] = self.bytes[kind]
+        out["total_bytes"] = self.total_bytes
+        return out
+
+
+_TALLY_STACK: list[CollectiveTally] = []
+
+
+@contextlib.contextmanager
+def tally() -> Iterator[CollectiveTally]:
+    """Collect collective byte counters from wrappers traced inside."""
+    t = CollectiveTally()
+    _TALLY_STACK.append(t)
+    try:
+        yield t
+    finally:
+        _TALLY_STACK.remove(t)
+
+
+def _record(kind: str, leaf: Any, dtype: Any = None) -> None:
+    if not _TALLY_STACK:
+        return
+    try:
+        size = leaf.size
+        itemsize = jnp.dtype(dtype or leaf.dtype).itemsize
+    except Exception:  # non-array leaf (python scalar etc.)
+        size, itemsize = 1, 4
+    for t in _TALLY_STACK:
+        t.record(kind, size * itemsize)
 
 
 def allreduce_gradients(
@@ -51,11 +146,16 @@ def allreduce_gradients(
     optimizer tolerates the noise.
     """
     if compute_dtype is None:
-        return jax.tree.map(lambda g: lax.pmean(g, axis_names), grads)
+        def reduce(g):
+            _record("allreduce_grads_pmean", g)
+            return lax.pmean(g, axis_names)
+
+        return jax.tree.map(reduce, grads)
     compute_dtype = jnp.dtype(compute_dtype)
 
     if not accumulate_f32 or compute_dtype.itemsize >= 4:
         def reduce(g):
+            _record("allreduce_grads_pmean_narrow", g, compute_dtype)
             return lax.pmean(g.astype(compute_dtype), axis_names).astype(g.dtype)
 
         return jax.tree.map(reduce, grads)
@@ -63,7 +163,7 @@ def allreduce_gradients(
     axes = (axis_names,) if isinstance(axis_names, str) else tuple(axis_names)
     n = 1
     for a in axes:
-        n *= lax.axis_size(a)
+        n *= axis_size(a)
 
     def reduce(g):
         flat = g.astype(jnp.float32).reshape(-1)
@@ -72,32 +172,46 @@ def allreduce_gradients(
             flat = jnp.pad(flat, (0, pad))
         # Exact f32 adds on the scatter; the only lossy step is the final
         # narrow-dtype representation of the already-reduced mean.
+        _record("allreduce_grads_scatter_f32", flat)
         shard = lax.psum_scatter(flat, axes, scatter_dimension=0, tiled=True) / n
-        full = lax.all_gather(shard.astype(compute_dtype), axes, axis=0, tiled=True)
+        narrow = shard.astype(compute_dtype)
+        _record("allreduce_grads_gather_narrow", narrow)
+        full = lax.all_gather(narrow, axes, axis=0, tiled=True)
         return full[: g.size].astype(g.dtype).reshape(g.shape)
 
     return jax.tree.map(reduce, grads)
 
 
 def psum(x: Any, axis_names: Sequence[str] | str) -> Any:
-    return jax.tree.map(lambda v: lax.psum(v, axis_names), x)
+    def op(v):
+        _record("psum", v)
+        return lax.psum(v, axis_names)
+
+    return jax.tree.map(op, x)
 
 
 def pmean(x: Any, axis_names: Sequence[str] | str) -> Any:
-    return jax.tree.map(lambda v: lax.pmean(v, axis_names), x)
+    def op(v):
+        _record("pmean", v)
+        return lax.pmean(v, axis_names)
+
+    return jax.tree.map(op, x)
 
 
 def all_gather(x: jax.Array, axis_name: str, *, axis: int = 0, tiled: bool = True) -> jax.Array:
+    _record("all_gather", x)
     return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x: jax.Array, axis_name: str, *, scatter_axis: int = 0) -> jax.Array:
+    _record("reduce_scatter", x)
     return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis, tiled=True)
 
 
 def ppermute_shift(x: jax.Array, axis_name: str, *, shift: int = 1) -> jax.Array:
     """Ring shift: send to (i + shift) mod N — the ring-attention primitive."""
-    n = lax.axis_size(axis_name)
+    _record("ppermute", x)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 

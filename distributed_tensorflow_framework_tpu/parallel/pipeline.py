@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from distributed_tensorflow_framework_tpu.parallel import collectives as coll
+
 # Param-tree key for the stacked layer stack — parallel/sharding.py keys its
 # P("pipe", None, ...) rule off this prefix.
 STACK_KEY = "pipeline_layers"
@@ -148,7 +150,7 @@ def pipeline_apply(
         mask_spec = P(data_axes, *([None] * (mask.ndim - 1)))
     rng_spec = None if rng is None else P()
     out_spec = P(axis_name, data_axes, *([None] * (x.ndim - 1)))
-    mapped = jax.shard_map(
+    mapped = coll.shard_map(
         fn,
         mesh=mesh,
         in_specs=(stack_spec, x_spec, mask_spec, rng_spec),

@@ -30,6 +30,7 @@ from jax.sharding import PartitionSpec as P
 # Module import (not by-value) so the env/monkeypatch-tunable dispatch
 # constants (MAX_SEQ_VMEM) stay coherent between the two modules.
 from distributed_tensorflow_framework_tpu.ops import flash_attention as _fa
+from distributed_tensorflow_framework_tpu.parallel import collectives as coll
 from distributed_tensorflow_framework_tpu.ops.flash_attention import (
     chunk_supported,
     flash_attention_chunk,
@@ -113,7 +114,7 @@ def ring_attention(q, k, v, bias, segment_ids=None, *, axis_name: str = "seq"):
     ``segment_ids`` (B, S/n) optional packed-sequence ids — the K/V-side
     shard rotates with its chunk while the local shard masks queries, so
     packing works across ring shard boundaries."""
-    n = lax.axis_size(axis_name)
+    n = coll.axis_size(axis_name)
 
     seg = segment_ids
     o0, lse0 = _chunk_attention(q, k, v, bias, seg, seg)
@@ -171,7 +172,7 @@ def ring_attention_sharded(q, k, v, *, mesh, mask=None, segment_ids=None,
     else:
         in_specs = (spec, spec, spec, bias_spec, bias_spec)
         args = (q, k, v, bias, segment_ids)
-    fn = jax.shard_map(
+    fn = coll.shard_map(
         functools.partial(ring_attention, axis_name=axis_name),
         mesh=mesh,
         in_specs=in_specs,

@@ -9,9 +9,16 @@ values (already-fetched scalars) so they never force extra device syncs.
 
 from __future__ import annotations
 
+import json
+import logging
+import math
+import os
+import time
 from typing import Any, Mapping, Protocol
 
-import math
+from distributed_tensorflow_framework_tpu.core import telemetry
+
+log = logging.getLogger(__name__)
 
 
 class Hook(Protocol):
@@ -36,7 +43,10 @@ class NaNGuardHook(BaseHook):
     """NanTensorHook analogue: abort when the loss goes non-finite.
 
     Checks only at metric-fetch steps (metrics is None otherwise) to avoid
-    per-step device→host syncs.
+    per-step device→host syncs. The abort carries provenance — which
+    metric, which step, and the last-good checkpoint to restart from — and
+    lands in the run's telemetry as a ``failure`` event, so post-mortems
+    don't start from a bare stack trace.
     """
 
     def after_step(self, trainer, step, metrics) -> None:
@@ -48,10 +58,43 @@ class NaNGuardHook(BaseHook):
             except (TypeError, ValueError):
                 continue
             if not math.isfinite(val):
+                ckpt = self._last_good_checkpoint(trainer)
+                self._emit_failure(trainer, step, name, v, ckpt)
+                restart = (
+                    f"restart from {ckpt}" if ckpt
+                    else "no checkpoint saved — restart from scratch"
+                )
                 raise FloatingPointError(
                     f"Non-finite metric {name}={v} at step {step} — aborting "
-                    f"(NaNGuardHook; reference NanTensorHook contract)"
+                    f"(NaNGuardHook; reference NanTensorHook contract). "
+                    f"Last good checkpoint: {restart}."
                 )
+
+    @staticmethod
+    def _last_good_checkpoint(trainer) -> str | None:
+        mgr = getattr(trainer, "_ckpt_manager", None)
+        if mgr is None:
+            return None
+        try:
+            last = mgr.latest_step()
+        except Exception:
+            return None
+        if last is None:
+            return None
+        return os.path.join(trainer.config.checkpoint.directory, str(last))
+
+    @staticmethod
+    def _emit_failure(trainer, step, name, value, ckpt) -> None:
+        writer = getattr(trainer, "writer", None)
+        if writer is None or not hasattr(writer, "telemetry"):
+            return
+        writer.telemetry.emit(
+            telemetry.KIND_FAILURE,
+            step=step,
+            health={"failure": "non_finite_metric", "metric": name,
+                    "value": str(value),
+                    "last_good_checkpoint": ckpt or ""},
+        )
 
 
 class ThroughputHook(BaseHook):
@@ -91,7 +134,10 @@ class LoggingHook(BaseHook):
         if self.throughput is not None:
             out.update(self.throughput.rates())
             self.throughput.meter.reset()
-        self.writer.write(step, out)
+        self.writer.write(
+            step, out,
+            collectives=getattr(trainer, "collectives_summary", None),
+        )
 
 
 class CheckpointHook(BaseHook):
@@ -110,9 +156,124 @@ class CheckpointHook(BaseHook):
         self.manager.wait_until_finished()
 
 
+class HeartbeatHook(BaseHook):
+    """Liveness file for external watchdogs (scripts/train_resilient.py).
+
+    Atomically rewrites a small JSON file — run_id, step, wall time, the
+    last fetched metrics — every ``min_interval_s`` of wall time. A
+    supervisor distinguishes "slow" from "wedged" by the file's age
+    instead of attaching a debugger to a silent process; the XLA:CPU
+    collective-freeze failure mode (core/platform.py) is exactly the case
+    this detects.
+    """
+
+    def __init__(self, path: str, *, min_interval_s: float = 10.0):
+        self.path = path
+        self.min_interval_s = min_interval_s
+        self._last_write = 0.0
+        self._last_metrics: dict | None = None
+
+    def on_start(self, trainer) -> None:
+        self._write(trainer, step=int(trainer.host_step), status="running")
+
+    def after_step(self, trainer, step, metrics) -> None:
+        if metrics is not None:
+            self._last_metrics = {k: float(v) for k, v in metrics.items()}
+        now = time.time()
+        if now - self._last_write >= self.min_interval_s:
+            self._write(trainer, step=step, status="running", now=now)
+
+    def on_end(self, trainer) -> None:
+        self._write(trainer, step=int(trainer.host_step), status="finished")
+
+    def _write(self, trainer, *, step, status, now=None) -> None:
+        now = time.time() if now is None else now
+        record = {
+            "schema": telemetry.SCHEMA,
+            "run_id": getattr(trainer, "run_id", ""),
+            "status": status,
+            "step": step,
+            "t": now,
+            "pid": os.getpid(),
+            "last_metrics": self._last_metrics,
+        }
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+        with open(tmp, "w") as fh:
+            json.dump(record, fh)
+        os.replace(tmp, self.path)  # atomic: readers never see a torn file
+        self._last_write = now
+
+
+class MoECollapseHook(BaseHook):
+    """Detects expert-routing collapse from the step metrics.
+
+    Collapse signatures (models/moe.py): ``moe_drop_frac`` climbing toward
+    1 - 1/num_experts (all tokens racing to one expert, the rest dropped
+    by capacity) and ``moe_aux_loss`` rising well above its balanced value
+    of ~1.0. Either alone can be a transient; this hook warns loudly —
+    structured, with the run context — once a threshold holds for
+    ``patience`` consecutive metric fetches, and emits a telemetry
+    ``health`` event so the collapse is visible in the run's event stream,
+    not just the console. It never aborts: collapsed runs often still
+    carry signal and the operator may want the checkpoint.
+    """
+
+    def __init__(self, *, drop_frac_threshold: float = 0.35,
+                 aux_loss_threshold: float = 2.0, patience: int = 2):
+        self.drop_frac_threshold = drop_frac_threshold
+        self.aux_loss_threshold = aux_loss_threshold
+        self.patience = max(1, patience)
+        self._streak = 0
+        self.fired_steps: list[int] = []
+
+    def after_step(self, trainer, step, metrics) -> None:
+        if metrics is None:
+            return
+        drop = metrics.get("moe_drop_frac")
+        aux = metrics.get("moe_aux_loss")
+        if drop is None and aux is None:
+            return
+        violations = {}
+        if drop is not None and float(drop) > self.drop_frac_threshold:
+            violations["moe_drop_frac"] = {
+                "value": float(drop), "threshold": self.drop_frac_threshold}
+        if aux is not None and float(aux) > self.aux_loss_threshold:
+            violations["moe_aux_loss"] = {
+                "value": float(aux), "threshold": self.aux_loss_threshold}
+        if not violations:
+            self._streak = 0
+            return
+        self._streak += 1
+        if self._streak < self.patience:
+            return
+        self.fired_steps.append(step)
+        payload = {
+            "warning": "moe_collapse",
+            "step": step,
+            "streak": self._streak,
+            "violations": violations,
+        }
+        log.warning("MOE COLLAPSE SUSPECTED %s", json.dumps(payload))
+        writer = getattr(trainer, "writer", None)
+        if writer is not None and hasattr(writer, "telemetry"):
+            writer.telemetry.emit(
+                telemetry.KIND_HEALTH, step=step,
+                health={"warning": "moe_collapse", "streak": self._streak,
+                        **{f"{k}_value": v["value"]
+                           for k, v in violations.items()}},
+            )
+
+
 class ProfileHook(BaseHook):
     """Captures an XPlane trace over steps [start, stop) — the analogue of
-    the reference's tf.profiler/timeline option (SURVEY.md §5)."""
+    the reference's tf.profiler/timeline option (SURVEY.md §5).
+
+    Alongside the trace it writes the compiled train step's optimized HLO
+    (``train_step.hlo.txt``) when the Trainer captured it: trace events
+    carry bare HLO instruction names, and the HLO text's op_name metadata
+    is what lets scripts/analyze_trace.py attribute them to named scopes
+    (optimizer_update etc.)."""
 
     def __init__(self, logdir: str, start: int, stop: int):
         self.logdir = logdir
@@ -122,10 +283,21 @@ class ProfileHook(BaseHook):
         self.stop = stop
         self._active = False
 
+    def _dump_hlo(self, trainer) -> None:
+        hlo = getattr(trainer, "compiled_hlo", None)
+        if not hlo:
+            return
+        os.makedirs(self.logdir, exist_ok=True)
+        path = os.path.join(self.logdir, "train_step.hlo.txt")
+        with open(path, "w") as fh:
+            fh.write(hlo)
+        log.info("wrote compiled HLO for trace attribution: %s", path)
+
     def after_step(self, trainer, step, metrics) -> None:
         import jax
 
         if step >= self.start and step < self.stop and not self._active:
+            self._dump_hlo(trainer)
             jax.profiler.start_trace(self.logdir)
             self._active = True
         elif step >= self.stop and self._active:

@@ -17,16 +17,19 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
+import sys
 from typing import Any
 
 import jax
 
 from distributed_tensorflow_framework_tpu.core.config import ExperimentConfig
-from distributed_tensorflow_framework_tpu.core import profiling
+from distributed_tensorflow_framework_tpu.core import profiling, telemetry
 from distributed_tensorflow_framework_tpu.core.mesh import MeshRuntime, initialize_runtime
 from distributed_tensorflow_framework_tpu.core.metrics import MetricWriter, setup_logging
 from distributed_tensorflow_framework_tpu.data import get_dataset
 from distributed_tensorflow_framework_tpu.data.infeed import prefetch_to_device, to_global
+from distributed_tensorflow_framework_tpu.parallel import collectives as coll
 from distributed_tensorflow_framework_tpu.train import hooks as hooks_lib
 from distributed_tensorflow_framework_tpu.train.step import StepBuilder
 
@@ -49,14 +52,29 @@ class Trainer:
             logdir=(config.checkpoint.directory or None),
             is_chief=self.runtime.is_chief,
         )
+        self.run_id = self.writer.run_id
         self.state: Any = None
         self.host_step = 0
         self._ckpt_manager = None
+        # Per-collective (calls, bytes) recorded while tracing the train
+        # step; None until the first dispatch compiles. Shape-static, so
+        # one trace describes every step of the executable.
+        self.collectives_summary: dict[str, int] | None = None
         # Iterator snapshot aligned with host_step (see data/infeed.py).
         self.data_ckpt_state: dict = self.dataset.state()
 
     # -------------------------------------------------------------- setup --
     def build(self) -> None:
+        self.writer.telemetry.emit_run_meta(
+            argv=list(sys.argv),
+            config_name=self.config.name,
+            spmd_mode=self.config.train.spmd_mode,
+            model=self.config.model.name,
+            dataset=self.config.data.name,
+            global_batch_size=self.config.data.global_batch_size,
+            mesh={k: int(v) for k, v in self.mesh.shape.items()},
+            process_count=self.runtime.process_count,
+        )
         # Peek one batch for shapes, then restore the stream to the start.
         start_state = self.dataset.state()
         host_batch = next(self.dataset)
@@ -64,6 +82,24 @@ class Trainer:
         sample = to_global(host_batch, self.mesh)
         self.state = self.builder.init_state(self.config.train.seed, sample)
         self.train_step = self.builder.make_train_step(sample)
+        # Optimized-HLO capture for trace attribution (ProfileHook dumps
+        # it next to the .xplane.pb). Only when profiling is armed: the
+        # explicit lower+compile does not populate the jit call cache, so
+        # it costs one extra compile — acceptable for a profiling run,
+        # not for every training launch.
+        self.compiled_hlo = None
+        tcfg = self.config.train
+        if tcfg.profile_stop > tcfg.profile_start and self.runtime.is_chief:
+            try:
+                # This lower+compile populates the jit call cache, so the
+                # loop's first-dispatch tally would see an already-traced
+                # step — capture the collective counters here instead.
+                with coll.tally() as tly:
+                    lowered = self.train_step.lower(self.state, sample)
+                self.collectives_summary = tly.summary()
+                self.compiled_hlo = lowered.compile().as_text()
+            except Exception:
+                log.warning("could not capture compiled HLO", exc_info=True)
         # eval_step compiles from the EVAL stream's sample batch (its
         # element spec differs from training: weight key, no aug). Built
         # HERE rather than at the first evaluate() when eval will run, so
@@ -122,6 +158,12 @@ class Trainer:
         hooks = [tp, hooks_lib.LoggingHook(self.writer, cfg.train.log_interval, tp)]
         if cfg.train.nan_guard:
             hooks.append(hooks_lib.NaNGuardHook())
+        if self.runtime.is_chief and cfg.checkpoint.directory:
+            hooks.append(hooks_lib.HeartbeatHook(
+                os.path.join(cfg.checkpoint.directory, "heartbeat.json")
+            ))
+        if cfg.model.num_experts > 0:
+            hooks.append(hooks_lib.MoECollapseHook())
         if self._ckpt_manager is not None:
             hooks.append(
                 hooks_lib.CheckpointHook(
@@ -145,8 +187,6 @@ class Trainer:
                     "eval disabled", cfg.train.eval_interval,
                 )
         if cfg.train.profile_stop > cfg.train.profile_start and self.runtime.is_chief:
-            import os
-
             trace_dir = os.path.join(
                 cfg.checkpoint.directory or "/tmp/dtf_tpu", "traces"
             )
@@ -207,7 +247,16 @@ class Trainer:
                         float(jax.device_get(
                             next(iter(pending.popleft().values()))))
                 with timer.phase("dispatch"), profiling.annotate("train_step"):
-                    self.state, metrics = self.train_step(self.state, batch)
+                    if self.collectives_summary is None:
+                        # First dispatch traces/compiles the step; the
+                        # tally sees every collective the executable will
+                        # ever run (jit traces once per shape).
+                        with coll.tally() as tly:
+                            self.state, metrics = self.train_step(
+                                self.state, batch)
+                        self.collectives_summary = tly.summary()
+                    else:
+                        self.state, metrics = self.train_step(self.state, batch)
                 if cfg.dispatch_ahead > 0:
                     pending.append(metrics)
                 self.host_step += 1
@@ -318,5 +367,5 @@ class Trainer:
         # full-set coverage (e.g. 50000 for ImageNet validation).
         results["eval_examples"] = weight
         if step is not None:
-            self.writer.write(step, results)
+            self.writer.write(step, results, kind=telemetry.KIND_EVAL)
         return results

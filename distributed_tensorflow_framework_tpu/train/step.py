@@ -382,10 +382,14 @@ class StepBuilder:
         return grads, metrics, new_model_state
 
     def _apply_updates(self, state, grads, metrics, new_model_state):
-        updates, new_opt_state = self.tx.update(
-            grads, state.opt_state, state.params
-        )
-        new_params = optax.apply_updates(state.params, updates)
+        # named_scope → op_name metadata on every optimizer HLO op, the
+        # handle core/trace_analysis.py uses to attribute trace time to
+        # the optimizer-update category.
+        with jax.named_scope("optimizer_update"):
+            updates, new_opt_state = self.tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
         metrics = dict(metrics)
         metrics["grad_norm"] = coll.global_norm(grads)
         metrics["learning_rate"] = self.schedule(state.step)
@@ -473,7 +477,7 @@ class StepBuilder:
         # pmean would double-count. The explicit-collective mode exists to
         # mirror the reference's SyncReplicasOptimizer pipeline, so we keep
         # the collectives visible and own them.
-        mapped = jax.shard_map(
+        mapped = coll.shard_map(
             self._train_step_replica,
             mesh=self.mesh,
             in_specs=(state_P, batch_P),

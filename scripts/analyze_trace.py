@@ -1,0 +1,71 @@
+"""Break a captured XPlane trace into time-by-category — the post-mortem
+half of the telemetry subsystem (docs/OBSERVABILITY.md).
+
+Takes a trace produced by ProfileHook (train.profile_start/stop) or
+bench.py under BENCH_TRACE, prints the category table (GEMM/conv,
+collectives, infeed, optimizer update, other compute, launch gaps) and
+writes the same numbers as a schema-versioned ``trace_summary`` JSONL
+event so the breakdown joins the run's other telemetry by run id.
+
+Usage:
+
+    python scripts/analyze_trace.py <trace.xplane.pb | trace dir> \
+        [--hlo train_step.hlo.txt] [--json out.jsonl] [--run-id ID] [--top N]
+
+With a directory, the newest ``*.xplane.pb`` under it is analyzed. The
+optimized-HLO text (dumped next to the trace by ProfileHook/bench) is
+auto-discovered when not given; without it, scope-based categories
+(optimizer_update) fall back to other_compute.
+"""
+
+import argparse
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from distributed_tensorflow_framework_tpu.core import trace_analysis as ta  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="*.xplane.pb file, or a directory to search")
+    ap.add_argument("--hlo", default=None,
+                    help="optimized HLO text for scope attribution "
+                         "(default: auto-discover near the trace)")
+    ap.add_argument("--json", default=None,
+                    help="append the trace_summary event to this JSONL file "
+                         "(default: <trace>.summary.jsonl)")
+    ap.add_argument("--run-id", default=None,
+                    help="run id to stamp on the summary event (use the id "
+                         "from the run's events.jsonl to make them joinable)")
+    ap.add_argument("--top", type=int, default=15,
+                    help="number of top ops to list")
+    args = ap.parse_args(argv)
+
+    traces = ta.find_xplane_files(args.trace)
+    if not traces:
+        print(f"no *.xplane.pb under {args.trace!r}", file=sys.stderr)
+        return 2
+    trace = max(traces, key=os.path.getmtime)
+
+    hlo_path = args.hlo or ta.find_hlo_text(trace)
+    hlo_text = None
+    if hlo_path and os.path.exists(hlo_path):
+        with open(hlo_path) as fh:
+            hlo_text = fh.read()
+
+    report = ta.analyze_trace_file(trace, hlo_text, top_n=args.top)
+    print(ta.format_report(report))
+    if hlo_path and hlo_text:
+        print(f"\nhlo: {hlo_path}")
+
+    out = args.json or (trace + ".summary.jsonl")
+    ta.write_summary_event(report, out, run_id=args.run_id)
+    print(f"summary event appended to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,91 @@
+"""bench.py backend bring-up: the BENCH_WAIT bounded retry budget.
+
+All probe/sleep/clock effects are injected, so these pin the retry POLICY
+— legacy fast-fail, budgeted 5-minute re-probing, and the hang-is-final
+rule (VERDICT item 2) — without touching any backend or real time.
+"""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+_BENCH_PATH = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+_spec = importlib.util.spec_from_file_location("bench_under_test", _BENCH_PATH)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _fake_clock():
+    state = {"t": 0.0}
+
+    def monotonic():
+        return state["t"]
+
+    def sleep(s):
+        state["t"] += s
+
+    return state, monotonic, sleep
+
+
+def test_legacy_fast_fail_three_attempts():
+    sleeps = []
+    with pytest.raises(bench.BenchBackendError) as exc:
+        bench._init_backend(
+            probe=lambda t: ("error", "RuntimeError: no tpu"),
+            sleep=sleeps.append, wait_budget_s=0)
+    history = exc.value.probe_history
+    assert [r["attempt"] for r in history] == [1, 2, 3]
+    assert all(r["outcome"] == "error" for r in history)
+    assert sleeps == [5, 10]  # short backoff, no 5-min waits
+
+
+def test_bench_wait_budget_probes_every_interval():
+    state, monotonic, sleep = _fake_clock()
+    with pytest.raises(bench.BenchBackendError) as exc:
+        bench._init_backend(
+            probe=lambda t: ("error", "tunnel down"),
+            sleep=sleep, monotonic=monotonic,
+            wait_budget_s=20 * 60, retry_interval_s=300)
+    history = exc.value.probe_history
+    # Probes at t=0,300,...,1200 — every 5 min across the 20-min budget.
+    assert len(history) == 5
+    assert state["t"] == 1200
+    assert "BENCH_WAIT" in str(exc.value)
+    # The failure carries the full history, not just the last error.
+    assert all(r["error"] == "tunnel down" for r in history)
+
+
+def test_hang_is_final_even_with_budget():
+    state, monotonic, sleep = _fake_clock()
+    with pytest.raises(bench.BenchBackendError) as exc:
+        bench._init_backend(
+            probe=lambda t: ("hang", 4242),
+            sleep=sleep, monotonic=monotonic, wait_budget_s=60 * 60)
+    history = exc.value.probe_history
+    assert len(history) == 1 and history[0]["outcome"] == "hang"
+    assert state["t"] == 0  # no retry sleep: the chip client is exclusive
+    assert "4242" in str(exc.value) and "wedge" in str(exc.value)
+
+
+def test_recovers_after_transient_failure(devices):
+    state, monotonic, sleep = _fake_clock()
+    calls = {"n": 0}
+
+    def flaky(timeout_s):
+        calls["n"] += 1
+        return ("ok", None) if calls["n"] >= 3 else ("error", "booting")
+
+    n, kind = bench._init_backend(
+        probe=flaky, sleep=sleep, monotonic=monotonic, wait_budget_s=30 * 60)
+    assert calls["n"] == 3
+    assert n == len(devices)
+
+
+@pytest.mark.parametrize("raw,want", [
+    ("", 0.0), ("0", 0.0), ("15", 900.0), ("1.5", 90.0),
+    ("y", 3600.0),  # non-numeric truthy -> the default hour
+])
+def test_bench_wait_parsing(monkeypatch, raw, want):
+    monkeypatch.setenv("BENCH_WAIT", raw)
+    assert bench._bench_wait_budget_s() == want

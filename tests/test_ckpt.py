@@ -95,3 +95,55 @@ def test_restore_specific_step(devices, tmp_path):
     t_d = Trainer(cfg_d)
     with pytest.raises(ValueError, match="restoring is disabled"):
         t_d.build()
+
+
+def test_fused_qkv_layout_mismatch_names_the_fix(devices, tmp_path):
+    """Restoring an unfused-attention checkpoint into a fused template must
+    fail fast naming model.fused_qkv and the transplant path, not as an
+    opaque Orbax tree mismatch (ADVICE r5)."""
+    from distributed_tensorflow_framework_tpu.ckpt import CheckpointManager
+    from distributed_tensorflow_framework_tpu.core.config import load_config
+    from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+    from distributed_tensorflow_framework_tpu.data import get_dataset
+    from distributed_tensorflow_framework_tpu.data.infeed import to_global
+    from distributed_tensorflow_framework_tpu.train.step import StepBuilder
+
+    def cfg_for(fused):
+        return load_config(base={
+            "name": "ckpt-qkv",
+            "mesh": {"data": 8},
+            "model": {"name": "bert", "vocab_size": 128, "hidden_size": 32,
+                      "num_layers": 1, "num_heads": 2, "mlp_dim": 64,
+                      "max_seq_len": 32, "dtype": "float32",
+                      "attention_impl": "xla", "fused_qkv": fused},
+            "data": {"name": "synthetic_mlm", "vocab_size": 128,
+                     "global_batch_size": 8, "seq_len": 32},
+            "optimizer": {"name": "adamw", "learning_rate": 1e-4},
+            "train": {"total_steps": 10},
+            "checkpoint": {"directory": str(tmp_path / "ckpt"),
+                           "async_save": False},
+        })
+
+    cfg = cfg_for(False)
+    mesh = create_mesh(cfg.mesh)
+    builder = StepBuilder(cfg, mesh)
+    batch = to_global(next(get_dataset(cfg.data)), mesh)
+    state = builder.init_state(0, batch)
+    mgr = CheckpointManager(cfg.checkpoint)
+    assert mgr.save(1, state)
+    mgr.wait_until_finished()
+
+    cfg2 = cfg_for(True)
+    fused_template = StepBuilder(cfg2, mesh).init_state(1, batch)
+    mgr2 = CheckpointManager(cfg2.checkpoint)
+    with pytest.raises(ValueError, match=r"model\.fused_qkv") as exc:
+        mgr2.restore(fused_template)
+    msg = str(exc.value)
+    assert "transplant" in msg and "MIGRATING" in msg
+    assert "test_fused_qkv_transplant_parity" in msg
+
+    # Matching layout still restores.
+    restored = mgr.restore(builder.init_state(2, batch))
+    assert restored is not None
+    mgr.close()
+    mgr2.close()

@@ -19,7 +19,7 @@ def _shard_map_allreduce(mesh, accumulate_f32):
     from distributed_tensorflow_framework_tpu.parallel import collectives as coll
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+        coll.shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
     def fn(x):
         return coll.allreduce_gradients(
             {"g": x}, ("data",), compute_dtype=jnp.bfloat16,

@@ -1,0 +1,272 @@
+"""Telemetry subsystem (core/telemetry.py + collectives tally + run-health
+hooks): the ONE event schema every emitter shares (docs/OBSERVABILITY.md).
+
+Covers the schema contract (round-trip, version check, reserved-field
+policy), the per-collective byte counters under a real 2-device shard_map
+trace, and the run-health hooks (heartbeat, MoE-collapse detector, NaN
+provenance) on synthetic inputs.
+"""
+
+import json
+import math
+import os
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_framework_tpu.core import telemetry
+from distributed_tensorflow_framework_tpu.core.metrics import MetricWriter
+from distributed_tensorflow_framework_tpu.parallel import collectives as coll
+from distributed_tensorflow_framework_tpu.train import hooks as hooks_lib
+
+
+# ------------------------------------------------------------- schema ----
+
+
+def test_event_roundtrip(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="test-run")
+    w.emit_run_meta(argv=["prog", "--x"], model="lenet5")
+    w.emit(
+        telemetry.KIND_TRAIN_STEP,
+        step=3,
+        metrics={"loss": 1.5},
+        phases={"infeed": 0.4},
+        throughput={"examples_per_sec": 100.0},
+        collectives={"pmean_calls": 1, "pmean_bytes": 8, "total_bytes": 8},
+    )
+    w.close()
+
+    evs = list(telemetry.read_events(path))
+    assert [e["kind"] for e in evs] == [
+        telemetry.KIND_RUN_META, telemetry.KIND_TRAIN_STEP]
+    for e in evs:
+        assert e["schema"] == telemetry.SCHEMA
+        assert e["run_id"] == "test-run"
+        assert telemetry.validate_event(e) == []
+    meta, step_ev = evs
+    assert meta["extra"]["argv"] == "prog --x"
+    assert step_ev["step"] == 3
+    assert step_ev["metrics"] == {"loss": 1.5}
+    assert step_ev["phases"] == {"infeed": 0.4}
+    assert step_ev["collectives"]["total_bytes"] == 8
+
+
+def test_schema_version_is_enforced(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="r")
+    w.emit(telemetry.KIND_TRAIN_STEP, step=1, metrics={"loss": 1.0})
+    w.close()
+    with open(path, "a") as fh:
+        bad = {"schema": "dtf-telemetry/999", "run_id": "r",
+               "kind": "train_step", "t": 0.0}
+        fh.write(json.dumps(bad) + "\n")
+
+    with pytest.raises(ValueError, match="schema"):
+        list(telemetry.read_events(path))
+    # Non-strict readers skip the unknown version instead of dying.
+    lenient = list(telemetry.read_events(path, strict=False))
+    assert len(lenient) == 1 and lenient[0]["step"] == 1
+
+
+def test_validate_event_rejects_unknown_top_level_fields():
+    ev = telemetry.make_event(
+        telemetry.KIND_BENCH, run_id="r", metrics={"value": 1.0})
+    assert telemetry.validate_event(ev) == []
+    ev["mfu"] = 0.5  # belongs under roofline/extra, not top-level
+    errors = telemetry.validate_event(ev)
+    assert errors and "mfu" in errors[0]
+
+
+def test_split_metrics_routes_phases_and_throughput():
+    metrics, phases, throughput = telemetry.split_metrics({
+        "loss": 2.0,
+        "time_infeed_ms": 1.25,
+        "time_dispatch_ms": 0.5,
+        "examples_per_sec": 10.0,
+        "tokens_per_sec": 640.0,
+    })
+    assert metrics == {"loss": 2.0}
+    assert phases == {"infeed": 1.25, "dispatch": 0.5}
+    assert throughput == {"examples_per_sec": 10.0, "tokens_per_sec": 640.0}
+
+
+def test_metric_writer_emits_schema_events(tmp_path):
+    writer = MetricWriter(logdir=str(tmp_path))
+    writer.write(5, {"loss": 0.5, "time_infeed_ms": 1.0,
+                     "examples_per_sec": 42.0},
+                 collectives={"total_bytes": 128})
+    writer.close()
+    evs = list(telemetry.read_events(os.path.join(str(tmp_path),
+                                                  "events.jsonl")))
+    assert len(evs) == 1
+    ev = evs[0]
+    assert telemetry.validate_event(ev) == []
+    assert ev["step"] == 5
+    assert ev["metrics"] == {"loss": 0.5}
+    assert ev["phases"] == {"infeed": 1.0}
+    assert ev["throughput"] == {"examples_per_sec": 42.0}
+    assert ev["collectives"] == {"total_bytes": 128}
+
+
+# --------------------------------------------- collective byte counters ----
+
+
+def test_collective_tally_2dev_shard_map(devices):
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    x = jax.device_put(
+        np.arange(8, dtype=np.float32),
+        jax.sharding.NamedSharding(mesh, P("data")))
+
+    def f(x):
+        y = coll.pmean(x, "data")            # local shard: 4 f32 = 16 B
+        z = coll.all_gather(x, "data")       # local shard: 4 f32 = 16 B
+        return y, z
+
+    mapped = jax.jit(coll.shard_map(
+        f, mesh=mesh, in_specs=P("data"), out_specs=(P(None), P(None)),
+        check_vma=False))
+    with coll.tally() as t:
+        out = mapped(x)
+    jax.block_until_ready(out)
+
+    s = t.summary()
+    assert s["pmean_calls"] == 1 and s["pmean_bytes"] == 16
+    assert s["all_gather_calls"] == 1 and s["all_gather_bytes"] == 16
+    assert s["total_bytes"] == 32
+
+    # Counters record at TRACE time: a second dispatch of the same
+    # executable adds nothing (the numbers describe every step).
+    with coll.tally() as t2:
+        jax.block_until_ready(mapped(x))
+    assert t2.summary() == {"total_bytes": 0}
+
+
+def test_collective_tally_allreduce_gradients(devices):
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    grads = {"a": np.ones((4, 2), np.float32), "b": np.ones((6,), np.float32)}
+    sharding = jax.sharding.NamedSharding(mesh, P())
+    grads = jax.device_put(grads, sharding)
+
+    mapped = jax.jit(coll.shard_map(
+        lambda g: coll.allreduce_gradients(g, ("data",)),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
+    with coll.tally() as t:
+        jax.block_until_ready(mapped(grads))
+    s = t.summary()
+    assert s["allreduce_grads_pmean_calls"] == 2  # one per tree leaf
+    assert s["allreduce_grads_pmean_bytes"] == (8 + 6) * 4
+    assert s["total_bytes"] == (8 + 6) * 4
+
+
+# ------------------------------------------------------- run-health hooks ----
+
+
+def _trainer_stub(tmp_path, **over):
+    """The minimal Trainer surface the hooks touch."""
+    events = str(tmp_path / "events.jsonl")
+    writer = SimpleNamespace(
+        telemetry=telemetry.TelemetryWriter(events, run_id="hook-run"))
+    stub = SimpleNamespace(
+        run_id="hook-run",
+        host_step=0,
+        writer=writer,
+        config=SimpleNamespace(checkpoint=SimpleNamespace(
+            directory=str(tmp_path / "ckpt"))),
+        _events_path=events,
+    )
+    for k, v in over.items():
+        setattr(stub, k, v)
+    return stub
+
+
+def test_heartbeat_hook_writes_atomic_liveness_file(tmp_path):
+    hb_path = str(tmp_path / "heartbeat.json")
+    hook = hooks_lib.HeartbeatHook(hb_path, min_interval_s=0.0)
+    trainer = _trainer_stub(tmp_path)
+
+    hook.on_start(trainer)
+    rec = json.load(open(hb_path))
+    assert rec["status"] == "running" and rec["step"] == 0
+    assert rec["schema"] == telemetry.SCHEMA
+    assert rec["run_id"] == "hook-run"
+
+    hook.after_step(trainer, 3, {"loss": 1.25})
+    trainer.host_step = 3
+    hook.on_end(trainer)
+    rec = json.load(open(hb_path))
+    assert rec["status"] == "finished" and rec["step"] == 3
+    assert rec["last_metrics"] == {"loss": 1.25}
+    assert rec["pid"] == os.getpid()
+    assert not os.path.exists(hb_path + ".tmp")
+
+
+def test_heartbeat_hook_respects_min_interval(tmp_path):
+    hb_path = str(tmp_path / "hb.json")
+    hook = hooks_lib.HeartbeatHook(hb_path, min_interval_s=3600.0)
+    trainer = _trainer_stub(tmp_path)
+    hook.on_start(trainer)
+    t0 = json.load(open(hb_path))["t"]
+    hook.after_step(trainer, 1, {"loss": 1.0})  # within interval: no write
+    assert json.load(open(hb_path))["t"] == t0
+
+
+def test_moe_collapse_hook_fires_on_induced_collapse(tmp_path):
+    hook = hooks_lib.MoECollapseHook(patience=2)
+    trainer = _trainer_stub(tmp_path)
+
+    # Healthy routing: balanced aux loss, no drops — never fires.
+    for step in (1, 2, 3):
+        hook.after_step(trainer, step, {"moe_drop_frac": 0.01,
+                                        "moe_aux_loss": 1.02})
+    assert hook.fired_steps == []
+
+    # Induced collapse fixture: most tokens racing one expert.
+    hook.after_step(trainer, 4, {"moe_drop_frac": 0.7, "moe_aux_loss": 5.0})
+    assert hook.fired_steps == []  # patience not yet met
+    hook.after_step(trainer, 5, {"moe_drop_frac": 0.72, "moe_aux_loss": 5.5})
+    assert hook.fired_steps == [5]
+
+    trainer.writer.telemetry.close()
+    evs = list(telemetry.read_events(trainer._events_path,
+                                     kind=telemetry.KIND_HEALTH))
+    assert len(evs) == 1
+    h = evs[0]["health"]
+    assert h["warning"] == "moe_collapse" and h["streak"] == 2
+    assert h["moe_drop_frac_value"] == pytest.approx(0.72)
+
+
+def test_moe_collapse_streak_resets_on_recovery(tmp_path):
+    hook = hooks_lib.MoECollapseHook(patience=2)
+    trainer = _trainer_stub(tmp_path)
+    hook.after_step(trainer, 1, {"moe_drop_frac": 0.9})
+    hook.after_step(trainer, 2, {"moe_drop_frac": 0.0})  # transient recovered
+    hook.after_step(trainer, 3, {"moe_drop_frac": 0.9})
+    assert hook.fired_steps == []
+
+
+def test_nan_guard_provenance(tmp_path):
+    trainer = _trainer_stub(
+        tmp_path,
+        _ckpt_manager=SimpleNamespace(latest_step=lambda: 7),
+    )
+    hook = hooks_lib.NaNGuardHook()
+    with pytest.raises(FloatingPointError) as exc:
+        hook.after_step(trainer, 9, {"loss": float("nan")})
+    msg = str(exc.value)
+    expected_ckpt = os.path.join(trainer.config.checkpoint.directory, "7")
+    assert "loss" in msg and "step 9" in msg and expected_ckpt in msg
+
+    trainer.writer.telemetry.close()
+    evs = list(telemetry.read_events(trainer._events_path,
+                                     kind=telemetry.KIND_FAILURE))
+    assert len(evs) == 1
+    h = evs[0]["health"]
+    assert h["failure"] == "non_finite_metric"
+    assert h["metric"] == "loss"
+    assert math.isnan(float(h["value"]))
+    assert h["last_good_checkpoint"] == expected_ckpt
+    assert evs[0]["step"] == 9
