@@ -13,6 +13,16 @@ files, latest-checkpoint auto-restore) with Orbax:
 
 All processes call save/restore (Orbax coordinates internally; process 0
 writes metadata) — the multi-host analogue of "chief writes".
+
+Integrity layer (docs/RESILIENCE.md): after every committed save the chief
+hashes the step directory into a ``manifest.json`` commit record
+(ckpt/manifest.py — write-to-tmp + fsync + atomic rename). ``latest_step``
+and ``all_steps`` only report manifested steps, restore re-hashes before
+reading, and a torn/corrupt step is quarantined (renamed ``<step>.corrupt``)
+with automatic fallback to the newest verified older step — a SIGKILL
+racing a save can cost at most one checkpoint interval, never the run.
+Quarantine/rename decisions are chief-only; non-chief processes follow the
+shared filesystem state.
 """
 
 from __future__ import annotations
@@ -25,6 +35,8 @@ import jax
 import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
+from distributed_tensorflow_framework_tpu.ckpt import manifest as mf
+from distributed_tensorflow_framework_tpu.core import faults, telemetry
 from distributed_tensorflow_framework_tpu.core.config import CheckpointConfig
 from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
 from distributed_tensorflow_framework_tpu.train.state import TrainState
@@ -67,11 +79,13 @@ def _attention_layout(key_names: set[str]) -> str | None:
 
 
 class CheckpointManager:
-    def __init__(self, config: CheckpointConfig, *, is_chief: bool = True):
+    def __init__(self, config: CheckpointConfig, *, is_chief: bool = True,
+                 telemetry_writer: telemetry.TelemetryWriter | None = None):
         if not config.directory:
             raise ValueError("CheckpointConfig.directory must be set")
         self.config = config
         self.is_chief = is_chief
+        self._telemetry = telemetry_writer
         path = self._path = os.path.abspath(config.directory)
         os.makedirs(path, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
@@ -81,28 +95,88 @@ class CheckpointManager:
                 enable_async_checkpointing=config.async_save,
             ),
         )
+        # Steps saved by THIS process whose manifest is still owed (async
+        # saves commit in the background; the manifest can only hash a
+        # finished directory).
+        self._pending_manifest: set[int] = set()
+
+    def _emit(self, kind: str, **fields: Any) -> None:
+        if self._telemetry is not None:
+            self._telemetry.emit(kind, **fields)
+
+    # ----------------------------------------------------- commit records --
+    def _finalize_manifests(self) -> None:
+        """Write the integrity manifest for every save that has committed.
+
+        Waiting first is free in steady state (Orbax's next save waits for
+        the previous async commit anyway); afterwards each pending step
+        directory either exists (hash + commit its manifest) or was GC'd
+        by max_to_keep (drop it).
+        """
+        if not self._pending_manifest:
+            return
+        if not self.is_chief:
+            self._pending_manifest.clear()
+            return
+        self._mgr.wait_until_finished()
+        for step in sorted(self._pending_manifest):
+            step_dir = os.path.join(self._path, str(step))
+            if os.path.isdir(step_dir) and mf.read_manifest(step_dir) is None:
+                # A crash_in_save fault here leaves a committed directory
+                # with NO manifest — exactly the torn-"latest" artifact the
+                # restore path must refuse (docs/RESILIENCE.md drill).
+                faults.fire("ckpt_in_save", step=step)
+                mf.write_manifest(step_dir, step)
+                for fault in faults.fire("ckpt_committed", step=step):
+                    if fault.kind == "corrupt_ckpt":
+                        faults.corrupt_checkpoint_dir(step_dir)
+        self._pending_manifest.clear()
 
     def save(self, step: int, state: TrainState, *,
              dataset_state: dict | None = None, force: bool = False) -> bool:
         """``dataset_state`` must be the iterator snapshot aligned with
         ``step`` (see data/infeed.py) — NOT the live dataset's state, which
         the prefetcher has advanced past the training step."""
+        self._finalize_manifests()
         if step in self._mgr.all_steps():
             return False  # already saved (e.g. final save on an interval step)
         args = {"state": ocp.args.StandardSave(_pack(state))}
         if dataset_state is not None:
             args["data_iter"] = ocp.args.JsonSave(dataset_state)
         saved = self._mgr.save(step, args=ocp.args.Composite(**args), force=force)
-        if saved and self.is_chief:
-            log.info("Saved checkpoint at step %d", step)
+        if saved:
+            self._pending_manifest.add(step)
+            if not self.config.async_save:
+                self._finalize_manifests()
+            if self.is_chief:
+                log.info("Saved checkpoint at step %d", step)
         return saved
 
     def latest_step(self) -> int | None:
-        return self._mgr.latest_step()
+        steps = self.all_steps()
+        return max(steps) if steps else None
 
     def all_steps(self) -> list[int]:
-        """Steps with a complete saved checkpoint (post max_to_keep GC)."""
-        return list(self._mgr.all_steps())
+        """Steps with a complete, COMMITTED checkpoint: saved by Orbax and
+        carrying an integrity manifest (post max_to_keep GC). A directory
+        Orbax lists but the manifest layer never committed — a save torn by
+        a kill — is excluded here and quarantined at restore time.
+
+        Back-compat: a directory with checkpoints but no manifests anywhere
+        predates the integrity layer; its steps are trusted as-is (with a
+        warning) rather than bricking every pre-manifest run.
+        """
+        self._finalize_manifests()
+        orbax_steps = sorted(self._mgr.all_steps())
+        committed = set(mf.committed_steps(self._path))
+        if not committed and orbax_steps:
+            log.warning(
+                "checkpoint directory %s has no integrity manifests "
+                "(pre-manifest checkpoints?) — steps %s are trusted "
+                "unverified", self._path, orbax_steps,
+            )
+            return orbax_steps
+        return [s for s in orbax_steps if s in committed]
 
     def restore(self, template: TrainState, *,
                 dataset: HostDataset | None = None,
@@ -116,7 +190,18 @@ class CheckpointManager:
         restored params when newly enabled, dropped when newly disabled —
         instead of failing mid-experiment on a template/tree mismatch.
         """
-        step = step if step is not None else self.latest_step()
+        if step is not None:
+            # Explicitly requested snapshot: fail loudly on corruption (the
+            # caller pinned THIS step; silently reading another would be the
+            # exact fallback restore_step exists to prevent).
+            errors = self._verify(step)
+            if errors:
+                raise ValueError(
+                    f"checkpoint step {step} in {self._path} failed "
+                    f"integrity verification: {'; '.join(errors)}"
+                )
+        else:
+            step = self._verified_latest()
         if step is None:
             return None
         self._check_attention_layout(step, template)
@@ -180,6 +265,75 @@ class CheckpointManager:
         if dataset is not None and restored.get("data_iter") is not None:
             dataset.restore(restored["data_iter"])
         return state
+
+    # ------------------------------------------------ integrity / fallback --
+    def _verify(self, step: int) -> list[str]:
+        """Integrity errors for one step ([] = safe to restore)."""
+        step_dir = os.path.join(self._path, str(step))
+        manifest = mf.read_manifest(step_dir)
+        if manifest is None:
+            if not mf.committed_steps(self._path):
+                # Pre-manifest directory: nothing to verify against.
+                log.warning(
+                    "restoring step %d without integrity verification "
+                    "(no manifests in %s)", step, self._path,
+                )
+                return []
+            return ["no committed manifest (save did not complete)"]
+        if not self.config.verify_restore:
+            return []  # manifest presence (commit record) is still required
+        return mf.verify_step_dir(step_dir, manifest)
+
+    def _verified_latest(self) -> int | None:
+        """Newest step that passes verification, quarantining every newer
+        step that does not — the automatic-fallback half of the integrity
+        contract. Returns None when no restorable checkpoint remains."""
+        self._finalize_manifests()
+        candidates = sorted(self._mgr.all_steps(), reverse=True)
+        if not candidates:
+            return None
+        if not mf.committed_steps(self._path):
+            return candidates[0]  # legacy store; _verify logs the warning
+        newest = candidates[0]
+        quarantined = False
+        chosen = None
+        for s in candidates:
+            errors = self._verify(s)
+            if not errors:
+                chosen = s
+                break
+            log.error(
+                "checkpoint step %d in %s is corrupt/torn: %s",
+                s, self._path, "; ".join(errors[:3]),
+            )
+            reason = ("uncommitted save" if "no committed manifest" in errors[0]
+                      else "integrity verification failed")
+            if self.is_chief:
+                mf.quarantine(self._path, s, reason, errors)
+                quarantined = True
+            self._emit(
+                telemetry.KIND_CKPT_QUARANTINED, step=s,
+                health={"reason": reason, "errors": "; ".join(errors[:3]),
+                        "directory": self._path},
+            )
+        if quarantined:
+            # Orbax caches its step listing; the renames just invalidated it.
+            try:
+                self._mgr.reload()
+            except Exception:
+                log.warning("orbax manager reload after quarantine failed",
+                            exc_info=True)
+        if chosen is not None and chosen != newest:
+            log.warning(
+                "restore falling back from corrupt step %d to verified "
+                "step %d", newest, chosen,
+            )
+            self._emit(
+                telemetry.KIND_RESTORE_FALLBACK, step=chosen,
+                health={"from_step": newest, "to_step": chosen,
+                        "directory": self._path},
+            )
+        return chosen
 
     def _stored_has_ema(self, step: int, *, default: bool) -> bool:
         """Whether the stored state tree carries EMA param leaves.
@@ -254,6 +408,8 @@ class CheckpointManager:
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()
+        self._finalize_manifests()
 
     def close(self) -> None:
+        self._finalize_manifests()
         self._mgr.close()

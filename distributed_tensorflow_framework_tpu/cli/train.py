@@ -18,6 +18,7 @@ import logging
 import os
 import sys
 
+from distributed_tensorflow_framework_tpu.core import supervision
 from distributed_tensorflow_framework_tpu.core.config import load_config
 from distributed_tensorflow_framework_tpu.core.metrics import setup_logging
 
@@ -88,7 +89,20 @@ def main(argv=None) -> int:
         results = trainer.evaluate()
         logging.getLogger(__name__).info("eval results: %s", results)
         return 0
+    # Graceful preemption (docs/RESILIENCE.md): SIGTERM lets the loop
+    # finish its in-flight step and save a checkpoint, then the process
+    # exits GRACEFUL_PREEMPT_RC — the supervisor relaunches immediately
+    # without consuming an attempt. A second SIGTERM kills outright.
+    supervision.install_sigterm_handler()
     final = trainer.train()
+    if trainer.preempted:
+        logging.getLogger(__name__).warning(
+            "preempted gracefully at step %d (checkpoint saved: %s) — "
+            "exiting rc=%d for immediate relaunch",
+            trainer.host_step, bool(trainer.config.checkpoint.directory),
+            supervision.GRACEFUL_PREEMPT_RC,
+        )
+        return supervision.GRACEFUL_PREEMPT_RC
     if trainer.config.train.eval_steps > 0:
         results = trainer.evaluate(step=trainer.host_step)
         logging.getLogger(__name__).info("final eval: %s", results)

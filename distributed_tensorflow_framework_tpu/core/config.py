@@ -264,6 +264,12 @@ class CheckpointConfig:
     max_to_keep: int = 3
     async_save: bool = True
     restore: bool = True  # auto-restore latest on startup (MonitoredTrainingSession contract)
+    # Re-hash every file against the step's integrity manifest before
+    # restoring (ckpt/manifest.py); corrupt/torn steps are quarantined with
+    # automatic fallback to the newest verified older step. Disabling skips
+    # the hashing (huge checkpoints on trusted storage) but still requires
+    # the manifest commit record, so torn SAVES are caught either way.
+    verify_restore: bool = True
     # Restore a SPECIFIC saved step instead of the latest (-1 = latest) —
     # the Saver's restore-any-checkpoint capability, e.g. to branch an
     # experiment off an earlier snapshot. Fails loudly if the step was

@@ -1,0 +1,268 @@
+"""Deterministic fault injection — make every failure mode reproducible.
+
+The recovery contract (kill → relaunch → resume, SURVEY.md §5) is only as
+good as its worst untested path, and real infrastructure faults (preempted
+workers, wedged infeed threads, torn checkpoint writes) arrive on nobody's
+schedule. This registry turns them into config: a comma-separated spec in
+the ``DTF_FAULTS`` env var names fault points threaded through the train
+loop, the checkpoint manager and the host data pipeline, so CI can drill
+SIGKILL-mid-save or a stalled input pipeline on CPU, on demand
+(docs/RESILIENCE.md).
+
+Spec syntax (``DTF_FAULTS=crash_at_step:120,stall_infeed:30s``):
+
+  crash_at_step:N    SIGKILL this process right before step N runs — the
+                     hard preemption drill (no cleanup, no atexit).
+  crash_in_save:N    SIGKILL between the step-N checkpoint's data write and
+                     its manifest commit — leaves an uncommitted directory
+                     that restore must skip (ckpt/manifest.py).
+  corrupt_ckpt:WHAT  after the next checkpoint commits, truncate its largest
+                     payload file — a committed-but-torn checkpoint that
+                     restore must detect by hash, quarantine, and fall back
+                     from. WHAT is a free-form label (e.g. ``params``)
+                     recorded for the logs; with OCDBT storage the
+                     corruption unit is a file, not a named array.
+  stall_infeed:S     one ``next(dataset)`` call sleeps S seconds (suffix
+                     ``s`` optional) — the hung-input drill the heartbeat
+                     watchdog must catch. ``0`` means "hang forever"
+                     (6 hours, far past any staleness budget).
+  nan_grads:N        step N's batch is poisoned to NaN (the train loop
+                     applies it to floating-point inputs), so the loss and
+                     gradients go non-finite and the NaN guard's provenance
+                     path fires end-to-end.
+
+Faults fire at most once per process. When ``DTF_FAULTS_STATE`` names a
+file, firings are also recorded there (before executing — a crash fault
+must not re-fire on relaunch) so a supervised kill → relaunch → resume
+drill injects each fault exactly once across the whole run.
+
+Stdlib-only by design: the module is imported by the data pipeline and the
+supervisor, and an inactive plan (the default) costs one set lookup per
+fault point.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import sys
+import time
+from dataclasses import dataclass, field
+
+log = logging.getLogger(__name__)
+
+ENV_VAR = "DTF_FAULTS"
+STATE_ENV_VAR = "DTF_FAULTS_STATE"
+
+# Fault kind -> the fault point it fires at. Points are the hook names the
+# framework threads through its layers:
+#   step_begin      train/loop.py, before dispatching each step
+#   infeed          data/pipeline.py, each HostDataset.__next__
+#   ckpt_in_save    ckpt/checkpoint.py, after data write / before manifest
+#   ckpt_committed  ckpt/checkpoint.py, after the manifest commit
+KIND_POINTS = {
+    "crash_at_step": "step_begin",
+    "nan_grads": "step_begin",
+    "stall_infeed": "infeed",
+    "crash_in_save": "ckpt_in_save",
+    "corrupt_ckpt": "ckpt_committed",
+}
+_STEP_KINDS = ("crash_at_step", "crash_in_save", "nan_grads")
+_STALL_FOREVER_S = 6 * 3600.0
+
+
+@dataclass
+class Fault:
+    kind: str
+    arg: str = ""
+    step: int | None = None
+    seconds: float | None = None
+    fired: bool = False
+
+    @property
+    def point(self) -> str:
+        return KIND_POINTS[self.kind]
+
+    @property
+    def fault_id(self) -> str:
+        return f"{self.kind}:{self.arg}" if self.arg else self.kind
+
+    def matches(self, point: str, step: int | None) -> bool:
+        if self.fired or point != self.point:
+            return False
+        if self.step is not None and step != self.step:
+            return False
+        return True
+
+
+def _parse_one(entry: str) -> Fault:
+    kind, _, arg = entry.partition(":")
+    kind, arg = kind.strip(), arg.strip()
+    if kind not in KIND_POINTS:
+        raise ValueError(
+            f"unknown fault kind {kind!r} in {ENV_VAR} entry {entry!r}; "
+            f"known kinds: {sorted(KIND_POINTS)}"
+        )
+    fault = Fault(kind=kind, arg=arg)
+    if kind in _STEP_KINDS:
+        try:
+            fault.step = int(arg)
+        except ValueError:
+            raise ValueError(
+                f"fault {kind!r} needs an integer step, got {arg!r}"
+            ) from None
+        if fault.step < 1:
+            raise ValueError(f"fault {kind!r} step must be >= 1, got {arg!r}")
+    elif kind == "stall_infeed":
+        raw = arg[:-1] if arg.endswith("s") else arg
+        try:
+            fault.seconds = float(raw) if raw else 0.0
+        except ValueError:
+            raise ValueError(
+                f"fault stall_infeed needs a duration (e.g. 30s), got {arg!r}"
+            ) from None
+        if fault.seconds == 0.0:
+            fault.seconds = _STALL_FOREVER_S
+    return fault
+
+
+@dataclass
+class FaultPlan:
+    """A parsed fault spec plus per-run fired-state tracking."""
+
+    faults: list[Fault] = field(default_factory=list)
+    state_path: str | None = None
+
+    @classmethod
+    def parse(cls, spec: str, *, state_path: str | None = None) -> "FaultPlan":
+        faults = [
+            _parse_one(entry)
+            for entry in (e.strip() for e in spec.split(","))
+            if entry
+        ]
+        plan = cls(faults=faults, state_path=state_path)
+        plan._mark_already_fired()
+        return plan
+
+    @classmethod
+    def from_env(cls, env: dict | None = None) -> "FaultPlan":
+        env = os.environ if env is None else env
+        return cls.parse(
+            env.get(ENV_VAR, ""), state_path=env.get(STATE_ENV_VAR) or None
+        )
+
+    @property
+    def active(self) -> bool:
+        return bool(self.faults)
+
+    # -- cross-process once-only state -----------------------------------
+    def _fired_ids(self) -> set[str]:
+        if not self.state_path or not os.path.exists(self.state_path):
+            return set()
+        try:
+            with open(self.state_path) as fh:
+                return set(json.load(fh))
+        except (OSError, json.JSONDecodeError):
+            return set()
+
+    def _mark_already_fired(self) -> None:
+        fired = self._fired_ids()
+        for f in self.faults:
+            if f.fault_id in fired:
+                f.fired = True
+
+    def _record_fired(self, fault: Fault) -> None:
+        fault.fired = True
+        if not self.state_path:
+            return
+        ids = self._fired_ids() | {fault.fault_id}
+        tmp = f"{self.state_path}.{os.getpid()}.tmp"
+        # fsync before the crash faults execute: the record must survive
+        # the SIGKILL it is about to cause, or the fault re-fires forever.
+        with open(tmp, "w") as fh:
+            json.dump(sorted(ids), fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.state_path)
+
+    # -- firing ----------------------------------------------------------
+    def fire(self, point: str, *, step: int | None = None) -> list[Fault]:
+        """Execute self-contained faults matching this point (crash, stall)
+        and return the caller-handled ones (nan_grads, corrupt_ckpt) so the
+        call site applies them with its own context."""
+        handled: list[Fault] = []
+        for fault in self.faults:
+            if not fault.matches(point, step):
+                continue
+            self._record_fired(fault)
+            print(
+                f"DTF_FAULTS: firing {fault.fault_id} at point "
+                f"{point!r} (step={step})",
+                file=sys.stderr, flush=True,
+            )
+            if fault.kind in ("crash_at_step", "crash_in_save"):
+                os.kill(os.getpid(), signal.SIGKILL)
+                os._exit(137)  # unreachable on POSIX; belt-and-braces
+            elif fault.kind == "stall_infeed":
+                time.sleep(fault.seconds or 0.0)
+            else:
+                handled.append(fault)
+        return handled
+
+
+# -- process-wide plan ----------------------------------------------------
+_plan: FaultPlan | None = None
+
+
+def active_plan() -> FaultPlan:
+    global _plan
+    if _plan is None:
+        _plan = FaultPlan.from_env()
+        if _plan.active:
+            log.warning(
+                "fault injection ACTIVE: %s",
+                ", ".join(f.fault_id for f in _plan.faults),
+            )
+    return _plan
+
+
+def install(plan: FaultPlan | str | None) -> FaultPlan:
+    """Set (or, with None, clear back to env-lazy) the process fault plan —
+    the test seam; production configuration is the DTF_FAULTS env var."""
+    global _plan
+    _plan = FaultPlan.parse(plan) if isinstance(plan, str) else plan
+    return active_plan()
+
+
+def fire(point: str, *, step: int | None = None) -> list[Fault]:
+    """Fire the process plan at a fault point; cheap no-op when inactive."""
+    plan = active_plan()
+    if not plan.active:
+        return []
+    return plan.fire(point, step=step)
+
+
+def corrupt_checkpoint_dir(step_dir: str) -> str | None:
+    """Truncate the largest payload file in a committed step directory to
+    half its size — a committed-but-torn checkpoint (the corrupt_ckpt
+    fault's effect; also used directly by tests). Returns the path, or
+    None when there is nothing to corrupt."""
+    from distributed_tensorflow_framework_tpu.ckpt import manifest as mf
+
+    best, best_size = None, -1
+    for rel in mf.iter_payload_files(step_dir):
+        path = os.path.join(step_dir, rel)
+        size = os.path.getsize(path)
+        if size > best_size:
+            best, best_size = path, size
+    if best is None:
+        return None
+    with open(best, "r+b") as fh:
+        fh.truncate(best_size // 2)
+    log.warning(
+        "corrupt_ckpt fault: truncated %s from %d to %d bytes",
+        best, best_size, best_size // 2,
+    )
+    return best

@@ -46,8 +46,25 @@ def xla_flag_supported(name: str) -> bool:
     check that never needs to initialize a backend. Unknown layouts
     (no .so found) fail open: the flag is assumed supported, matching
     the old unconditional behavior.
+
+    The scan MUST be ``mmap.find`` (C memmem over the mapping): ``in``
+    against an mmap falls back to byte-wise sequence iteration — ~10 s
+    of interpreter time per probe on a 264 MiB binary, and never a
+    match for a multi-byte needle. Results are memoized per process;
+    supervisor relaunch loops call this on every start.
     """
-    return name in _xla_binary_flag_blob()
+    cached = _FLAG_SUPPORTED.get(name)
+    if cached is None:
+        blob = _xla_binary_flag_blob()
+        if len(blob) == 0:  # no .so located: fail open
+            cached = True
+        else:
+            cached = blob.find(name.encode()) >= 0
+        _FLAG_SUPPORTED[name] = cached
+    return cached
+
+
+_FLAG_SUPPORTED: dict[str, bool] = {}
 
 
 _XLA_BINARY_BLOB = None  # bytes | mmap.mmap once probed

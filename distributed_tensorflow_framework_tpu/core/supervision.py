@@ -1,0 +1,203 @@
+"""Self-healing supervision primitives: preemption, backoff, crash-loop.
+
+The policy half of the resilience subsystem (docs/RESILIENCE.md). The
+mechanisms live where the state lives — heartbeats in train/hooks.py,
+integrity manifests in ckpt/manifest.py, fault injection in core/faults.py
+— while this module holds the pure decision logic the supervisor
+(scripts/train_resilient.py) and the trainer share:
+
+  * the graceful-preemption contract: a SIGTERM'd trainer finishes its
+    in-flight step, saves a checkpoint, and exits ``GRACEFUL_PREEMPT_RC``
+    so the supervisor relaunches immediately without consuming an attempt
+    (preemption is scheduling, not failure);
+  * exponential backoff with jitter between relaunches (TF-Replicator-style
+    supervised workers: a crashing fleet must not relaunch in lockstep);
+  * the crash-loop breaker: a deterministic crash (same exit, same step,
+    no checkpoint progress, attempt after attempt) is a bug, and retrying
+    a bug converts one failure into ``max_attempts`` identical failures —
+    stop instead, with a structured report;
+  * heartbeat staleness reading, pid-scoped so a relaunched child is never
+    condemned by its predecessor's stale file.
+
+Stdlib-only so the supervisor's decision loop is unit-testable without a
+device runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import signal
+import time
+
+log = logging.getLogger(__name__)
+
+# Exit code the trainer uses for "SIGTERM honored: step finished, checkpoint
+# saved, relaunch me whenever". Distinct from 143 (SIGTERM death = operator
+# cancellation, never relaunched) and from any shell 128+N signal code.
+GRACEFUL_PREEMPT_RC = 83
+
+_preempt_requested = False
+_handler_installed = False
+
+
+def preemption_requested() -> bool:
+    return _preempt_requested
+
+
+def reset_preemption() -> None:
+    """Clear the flag (tests; also a relaunch-in-process harness)."""
+    global _preempt_requested
+    _preempt_requested = False
+
+
+def install_sigterm_handler() -> bool:
+    """Arm graceful preemption: the first SIGTERM sets a flag the train
+    loop polls at step boundaries; a second SIGTERM restores the default
+    disposition so a stuck shutdown can still be killed with plain TERM.
+    Returns False (and arms nothing) outside the main thread or where
+    SIGTERM does not exist — callers proceed without graceful handling.
+    """
+    global _handler_installed
+    if _handler_installed:
+        return True
+
+    def _on_sigterm(signum, frame):
+        global _preempt_requested
+        if _preempt_requested:  # second TERM: operator means it
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        _preempt_requested = True
+        log.warning(
+            "SIGTERM received — graceful preemption armed: finishing the "
+            "in-flight step, saving a checkpoint, exiting rc=%d",
+            GRACEFUL_PREEMPT_RC,
+        )
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, AttributeError, OSError):
+        return False
+    _handler_installed = True
+    return True
+
+
+def backoff_seconds(
+    failure_index: int,
+    *,
+    base: float = 5.0,
+    cap: float = 120.0,
+    jitter: float = 0.5,
+    rng: random.Random | None = None,
+) -> float:
+    """Sleep before relaunch ``failure_index`` (1-based): capped exponential
+    ``base * 2^(i-1)`` with ±``jitter`` fractional randomization."""
+    if base <= 0:
+        return 0.0
+    delay = min(cap, base * (2.0 ** max(0, failure_index - 1)))
+    if jitter > 0:
+        r = rng or random
+        delay *= 1.0 + r.uniform(-jitter, jitter)
+    return max(0.0, delay)
+
+
+def read_heartbeat(path: str) -> dict | None:
+    """The heartbeat record, or None when absent/torn. Writers commit via
+    atomic rename (train/hooks.HeartbeatHook), so a partial read here means
+    a non-conforming writer — treated as no heartbeat."""
+    try:
+        with open(path) as fh:
+            record = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return record if isinstance(record, dict) else None
+
+
+def heartbeat_age_s(
+    path: str, *, pid: int | None = None, now: float | None = None
+) -> float | None:
+    """Seconds since the child's last heartbeat, or None when no heartbeat
+    from that child exists yet. ``pid`` scopes the check to the CURRENT
+    child: a predecessor's leftover file reads as "no heartbeat yet", not
+    as instant staleness."""
+    record = read_heartbeat(path)
+    if record is None:
+        return None
+    if pid is not None and record.get("pid") not in (None, pid):
+        return None
+    t = record.get("t")
+    if not isinstance(t, (int, float)):
+        try:
+            t = os.path.getmtime(path)
+        except OSError:
+            return None
+    return max(0.0, (time.time() if now is None else now) - float(t))
+
+
+class CrashLoopBreaker:
+    """Distinguish deterministic crashes from transient infrastructure.
+
+    Each failed attempt is recorded with its exit code, the child's last
+    completed step (heartbeat) and the newest committed checkpoint step.
+    ``threshold`` consecutive attempts with the SAME signature and NO
+    progress on either step counter trip the breaker: the crash will
+    reproduce forever, so the supervisor must stop and report instead of
+    burning the attempt budget. Any progress — a new checkpoint, a further
+    step, a different exit code — resets the streak (transient faults move
+    the run forward between failures). Hangs killed by the watchdog are
+    always transient (``hung=True``): a timeout depends on machine load,
+    not on the program text.
+    """
+
+    def __init__(self, threshold: int = 2):
+        self.threshold = max(0, threshold)
+        self._streak = 0
+        self._last: tuple | None = None
+        self.history: list[dict] = []
+
+    def record(
+        self,
+        *,
+        rc: int,
+        last_step: int | None,
+        ckpt_step: int | None,
+        hung: bool = False,
+    ) -> bool:
+        """Register one failed attempt; True = stop retrying."""
+        signature = (rc, last_step, ckpt_step)
+        if hung or self.threshold == 0:
+            self._streak, self._last = 0, None
+        elif signature == self._last:
+            self._streak += 1
+        else:
+            self._streak, self._last = 1, signature
+        self.history.append({
+            "rc": rc,
+            "last_step": last_step,
+            "ckpt_step": ckpt_step,
+            "hung": hung,
+            "streak": self._streak,
+        })
+        return self.threshold > 0 and self._streak >= self.threshold
+
+    def report(self) -> dict:
+        """Structured post-mortem for the operator / telemetry stream."""
+        last = self.history[-1] if self.history else {}
+        return {
+            "verdict": "deterministic_crash_loop",
+            "streak": self._streak,
+            "threshold": self.threshold,
+            "rc": last.get("rc"),
+            "last_step": last.get("last_step"),
+            "ckpt_step": last.get("ckpt_step"),
+            "attempts_recorded": len(self.history),
+            "hint": (
+                "the same failure reproduced at the same step with no "
+                "checkpoint progress — relaunching cannot fix it; inspect "
+                "the child's last log/telemetry (and any DTF_FAULTS spec) "
+                "before retrying"
+            ),
+        }

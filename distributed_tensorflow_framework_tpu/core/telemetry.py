@@ -54,6 +54,12 @@ KIND_TRACE_SUMMARY = "trace_summary"
 KIND_HEALTH = "health"
 KIND_FAILURE = "failure"
 KIND_RUN_META = "run_meta"
+# Resilience events (docs/RESILIENCE.md): checkpoint recovery activity and
+# the supervisor's relaunch loop, joinable with the run's step telemetry.
+KIND_CKPT_QUARANTINED = "ckpt_quarantined"
+KIND_RESTORE_FALLBACK = "restore_fallback"
+KIND_SUPERVISOR_ATTEMPT = "supervisor_attempt"
+KIND_CRASH_LOOP = "crash_loop"
 
 
 def make_run_id() -> str:
@@ -239,3 +245,127 @@ def read_events(path: str, *, kind: str | None = None,
                 continue
             if kind is None or ev["kind"] == kind:
                 yield ev
+
+
+# Kinds counted as recovery activity by summarize_events — the run-summary
+# surface scripts/analyze_trace.py prints so "how rough was this run?" is
+# answerable from the event stream alone.
+RECOVERY_KINDS = (
+    KIND_CKPT_QUARANTINED, KIND_RESTORE_FALLBACK,
+    KIND_SUPERVISOR_ATTEMPT, KIND_CRASH_LOOP, KIND_FAILURE,
+)
+
+
+def summarize_events(path: str) -> dict:
+    """Aggregate one events.jsonl into a run summary dict.
+
+    Tolerant of torn tails (strict=False): the file is exactly what a
+    SIGKILLed run leaves behind, and that is the run most worth
+    summarizing. Returns event counts by kind, the step span, and a
+    ``recovery`` section: quarantined checkpoint steps, restore fallbacks
+    (from → to), supervisor attempt classifications, preemptions, and any
+    crash-loop verdict.
+    """
+    kinds: dict[str, int] = {}
+    run_ids: list[str] = []
+    first_step = last_step = None
+    quarantined: list[dict] = []
+    fallbacks: list[dict] = []
+    attempts: dict[str, int] = {}
+    preemptions = 0
+    crash_loop: dict | None = None
+    failures: list[dict] = []
+    for ev in read_events(path, strict=False):
+        kind = ev["kind"]
+        kinds[kind] = kinds.get(kind, 0) + 1
+        if ev.get("run_id") and ev["run_id"] not in run_ids:
+            run_ids.append(ev["run_id"])
+        step = ev.get("step")
+        if isinstance(step, int):
+            first_step = step if first_step is None else min(first_step, step)
+            last_step = step if last_step is None else max(last_step, step)
+        health = ev.get("health") or {}
+        extra = ev.get("extra") or {}
+        if kind == KIND_CKPT_QUARANTINED:
+            quarantined.append({"step": step, "reason": health.get("reason")})
+        elif kind == KIND_RESTORE_FALLBACK:
+            fallbacks.append({
+                "from_step": health.get("from_step"),
+                "to_step": health.get("to_step"),
+            })
+        elif kind == KIND_SUPERVISOR_ATTEMPT:
+            cls = str(extra.get("classification", "unknown"))
+            attempts[cls] = attempts.get(cls, 0) + 1
+        elif kind == KIND_CRASH_LOOP:
+            crash_loop = dict(extra) or dict(health)
+        elif kind == KIND_FAILURE:
+            failures.append({"step": step, **health})
+        if health.get("event") == "graceful_preemption":
+            preemptions += 1
+    return {
+        "path": path,
+        "run_ids": run_ids,
+        "event_count": sum(kinds.values()),
+        "kinds": kinds,
+        "first_step": first_step,
+        "last_step": last_step,
+        "recovery": {
+            "quarantined": quarantined,
+            "restore_fallbacks": fallbacks,
+            "supervisor_attempts": attempts,
+            "graceful_preemptions": preemptions,
+            "failures": failures,
+            "crash_loop": crash_loop,
+        },
+    }
+
+
+def format_run_summary(summary: dict) -> str:
+    """Human-readable rendering of ``summarize_events`` output."""
+    lines = [f"run summary: {summary['path']}"]
+    if summary["run_ids"]:
+        lines.append(f"  run ids: {', '.join(summary['run_ids'])}")
+    span = ""
+    if summary["last_step"] is not None:
+        span = f", steps {summary['first_step']}..{summary['last_step']}"
+    lines.append(f"  {summary['event_count']} events{span}")
+    lines.append(
+        "  by kind: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(summary["kinds"].items())
+        )
+    )
+    rec = summary["recovery"]
+    activity = (
+        rec["quarantined"] or rec["restore_fallbacks"]
+        or rec["supervisor_attempts"] or rec["graceful_preemptions"]
+        or rec["failures"] or rec["crash_loop"]
+    )
+    if not activity:
+        lines.append("  recovery activity: none")
+        return "\n".join(lines)
+    lines.append("  recovery activity:")
+    for q in rec["quarantined"]:
+        lines.append(
+            f"    quarantined checkpoint step {q['step']} ({q['reason']})"
+        )
+    for f in rec["restore_fallbacks"]:
+        lines.append(
+            f"    restore fell back: step {f['from_step']} -> {f['to_step']}"
+        )
+    if rec["supervisor_attempts"]:
+        lines.append(
+            "    supervisor attempts: " + ", ".join(
+                f"{k}={v}"
+                for k, v in sorted(rec["supervisor_attempts"].items())
+            )
+        )
+    if rec["graceful_preemptions"]:
+        lines.append(
+            f"    graceful preemptions: {rec['graceful_preemptions']}"
+        )
+    for f in rec["failures"]:
+        lines.append(f"    failure at step {f.get('step')}: "
+                     f"{f.get('failure', 'unknown')}")
+    if rec["crash_loop"]:
+        lines.append(f"    CRASH LOOP: {json.dumps(rec['crash_loop'])}")
+    return "\n".join(lines)

@@ -14,6 +14,8 @@ from typing import Any, Callable, Iterator, Mapping
 
 import numpy as np
 
+from distributed_tensorflow_framework_tpu.core import faults
+
 Batch = Mapping[str, np.ndarray]
 
 
@@ -125,6 +127,10 @@ class HostDataset:
         return self
 
     def __next__(self) -> Batch:
+        # stall_infeed fault point (core/faults.py): a hung input pipeline
+        # — the failure the heartbeat watchdog must catch — is one sleep
+        # here; a no-op set lookup when no plan is installed.
+        faults.fire("infeed")
         if self._iter is None:
             self._iter = self._make_iter(self._state)
         return next(self._iter)

@@ -159,12 +159,18 @@ class CheckpointHook(BaseHook):
 class HeartbeatHook(BaseHook):
     """Liveness file for external watchdogs (scripts/train_resilient.py).
 
-    Atomically rewrites a small JSON file — run_id, step, wall time, the
-    last fetched metrics — every ``min_interval_s`` of wall time. A
-    supervisor distinguishes "slow" from "wedged" by the file's age
-    instead of attaching a debugger to a silent process; the XLA:CPU
-    collective-freeze failure mode (core/platform.py) is exactly the case
-    this detects.
+    Atomically rewrites a small JSON file — run_id, pid, the last COMPLETED
+    step, wall time, the last fetched metrics — every ``min_interval_s`` of
+    wall time. A supervisor distinguishes "slow" from "wedged" by the
+    record's age instead of attaching a debugger to a silent process (the
+    XLA:CPU collective-freeze failure mode, core/platform.py), and asserts
+    forward progress — not just liveness — from ``last_completed_step``.
+
+    Write discipline: pid-suffixed temp file (a dying predecessor's
+    half-written temp can never collide with ours), contents fsync'd, then
+    one atomic ``os.replace`` — readers see the old record or the new one,
+    never a torn file, on every platform where replace is atomic (POSIX
+    and Windows alike).
     """
 
     def __init__(self, path: str, *, min_interval_s: float = 10.0):
@@ -184,7 +190,9 @@ class HeartbeatHook(BaseHook):
             self._write(trainer, step=step, status="running", now=now)
 
     def on_end(self, trainer) -> None:
-        self._write(trainer, step=int(trainer.host_step), status="finished")
+        status = ("preempted" if getattr(trainer, "preempted", False)
+                  else "finished")
+        self._write(trainer, step=int(trainer.host_step), status=status)
 
     def _write(self, trainer, *, step, status, now=None) -> None:
         now = time.time() if now is None else now
@@ -192,15 +200,21 @@ class HeartbeatHook(BaseHook):
             "schema": telemetry.SCHEMA,
             "run_id": getattr(trainer, "run_id", ""),
             "status": status,
+            # "step" kept for readers of the original record shape;
+            # last_completed_step is the explicit progress counter the
+            # watchdog's crash-loop accounting uses.
             "step": step,
+            "last_completed_step": step,
             "t": now,
             "pid": os.getpid(),
             "last_metrics": self._last_metrics,
         }
-        tmp = self.path + ".tmp"
+        tmp = f"{self.path}.{os.getpid()}.tmp"
         os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
         with open(tmp, "w") as fh:
             json.dump(record, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
         os.replace(tmp, self.path)  # atomic: readers never see a torn file
         self._last_write = now
 

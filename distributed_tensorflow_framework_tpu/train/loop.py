@@ -24,7 +24,7 @@ from typing import Any
 import jax
 
 from distributed_tensorflow_framework_tpu.core.config import ExperimentConfig
-from distributed_tensorflow_framework_tpu.core import profiling, telemetry
+from distributed_tensorflow_framework_tpu.core import faults, profiling, supervision, telemetry
 from distributed_tensorflow_framework_tpu.core.mesh import MeshRuntime, initialize_runtime
 from distributed_tensorflow_framework_tpu.core.metrics import MetricWriter, setup_logging
 from distributed_tensorflow_framework_tpu.data import get_dataset
@@ -34,6 +34,19 @@ from distributed_tensorflow_framework_tpu.train import hooks as hooks_lib
 from distributed_tensorflow_framework_tpu.train.step import StepBuilder
 
 log = logging.getLogger(__name__)
+
+
+def _poison_batch(batch: dict) -> dict:
+    """nan_grads fault effect: NaN every floating-point input array so the
+    step's loss and gradients go non-finite and the NaN-provenance path
+    (NaNGuardHook → failure telemetry → abort) is exercised end-to-end."""
+    import jax.numpy as jnp
+
+    return {
+        k: v * jnp.asarray(float("nan"), dtype=v.dtype)
+        if jnp.issubdtype(v.dtype, jnp.floating) else v
+        for k, v in batch.items()
+    }
 
 
 class Trainer:
@@ -56,6 +69,10 @@ class Trainer:
         self.state: Any = None
         self.host_step = 0
         self._ckpt_manager = None
+        # True once a SIGTERM was honored gracefully (in-flight step
+        # finished, checkpoint saved by CheckpointHook.on_end) — the CLI
+        # exits supervision.GRACEFUL_PREEMPT_RC on it.
+        self.preempted = False
         # Per-collective (calls, bytes) recorded while tracing the train
         # step; None until the first dispatch compiles. Shape-static, so
         # one trace describes every step of the executable.
@@ -128,7 +145,8 @@ class Trainer:
             from distributed_tensorflow_framework_tpu.ckpt import CheckpointManager
 
             self._ckpt_manager = CheckpointManager(
-                self.config.checkpoint, is_chief=self.runtime.is_chief
+                self.config.checkpoint, is_chief=self.runtime.is_chief,
+                telemetry_writer=self.writer.telemetry,
             )
             if self.config.checkpoint.restore:
                 want = self.config.checkpoint.restore_step
@@ -240,8 +258,30 @@ class Trainer:
         pending: collections.deque = collections.deque()
         try:
             while self.host_step < cfg.total_steps:
+                if supervision.preemption_requested():
+                    # Graceful preemption (SIGTERM): the previous step is
+                    # complete, hooks' on_end below force-saves a
+                    # checkpoint, and the CLI exits GRACEFUL_PREEMPT_RC so
+                    # the supervisor relaunches without burning an attempt.
+                    self.preempted = True
+                    log.warning(
+                        "preemption requested — stopping at step %d for a "
+                        "final checkpoint", self.host_step,
+                    )
+                    self.writer.telemetry.emit(
+                        telemetry.KIND_HEALTH, step=self.host_step,
+                        health={"event": "graceful_preemption",
+                                "step": self.host_step},
+                    )
+                    break
                 with timer.phase("infeed"):
                     batch, self.data_ckpt_state = next(infeed)
+                # Fault injection (core/faults.py, DTF_FAULTS): crash_at_step
+                # SIGKILLs here; nan_grads poisons this step's batch so the
+                # NaN-provenance path is drilled end-to-end.
+                for fault in faults.fire("step_begin", step=self.host_step + 1):
+                    if fault.kind == "nan_grads":
+                        batch = _poison_batch(batch)
                 if cfg.dispatch_ahead > 0 and len(pending) >= cfg.dispatch_ahead:
                     with timer.phase("backpressure"):
                         float(jax.device_get(

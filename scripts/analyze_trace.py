@@ -16,6 +16,12 @@ With a directory, the newest ``*.xplane.pb`` under it is analyzed. The
 optimized-HLO text (dumped next to the trace by ProfileHook/bench) is
 auto-discovered when not given; without it, scope-based categories
 (optimizer_update) fall back to other_compute.
+
+Given an ``events.jsonl`` (or a run directory containing one), the tool
+instead prints the run summary: event counts, step span and recovery
+activity — quarantined checkpoints, restore fallbacks, supervisor
+attempts, graceful preemptions (docs/RESILIENCE.md). Supervisor events
+(``supervisor_events.jsonl`` next to it) are summarized too when present.
 """
 
 import argparse
@@ -25,7 +31,35 @@ import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+from distributed_tensorflow_framework_tpu.core import telemetry  # noqa: E402
 from distributed_tensorflow_framework_tpu.core import trace_analysis as ta  # noqa: E402
+
+
+def _events_files(target: str) -> list[str]:
+    """events.jsonl paths for a target: the file itself, or any
+    ``*events*.jsonl`` directly inside a run directory."""
+    if os.path.isfile(target) and target.endswith(".jsonl"):
+        return [target]
+    if os.path.isdir(target):
+        return sorted(
+            os.path.join(target, name)
+            for name in os.listdir(target)
+            if name.endswith(".jsonl") and "events" in name
+        )
+    return []
+
+
+def summarize_run(target: str) -> bool:
+    """Print run summaries for every events JSONL under ``target``; False
+    when there is none (caller falls through to trace analysis)."""
+    paths = _events_files(target)
+    if not paths:
+        return False
+    for i, path in enumerate(paths):
+        if i:
+            print()
+        print(telemetry.format_run_summary(telemetry.summarize_events(path)))
+    return True
 
 
 def main(argv=None) -> int:
@@ -44,10 +78,20 @@ def main(argv=None) -> int:
                     help="number of top ops to list")
     args = ap.parse_args(argv)
 
+    # events.jsonl → run summary (recovery activity); a run DIRECTORY gets
+    # both the run summary and, below, its newest trace when one exists.
+    summarized = summarize_run(args.trace)
+    if summarized and os.path.isfile(args.trace):
+        return 0
+
     traces = ta.find_xplane_files(args.trace)
     if not traces:
+        if summarized:
+            return 0
         print(f"no *.xplane.pb under {args.trace!r}", file=sys.stderr)
         return 2
+    if summarized:
+        print()
     trace = max(traces, key=os.path.getmtime)
 
     hlo_path = args.hlo or ta.find_hlo_text(trace)

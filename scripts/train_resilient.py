@@ -1,49 +1,88 @@
 #!/usr/bin/env python
-"""Relaunch-on-failure wrapper: bounded restarts around a training command.
+"""Self-healing supervisor: watchdog + bounded relaunch around training.
 
 The framework's checkpoint contract (auto-restore latest on start, exact
-iterator/RNG resume) makes relaunching the whole process a correct — and
-on some hosts the only — recovery from infrastructure failures:
-preemptions, killed workers, and the intermittent XLA:CPU
+iterator/RNG resume, integrity-manifested saves) makes relaunching the
+whole process a correct recovery from infrastructure failures: preemptions,
+killed workers, wedged infeed threads, and the intermittent XLA:CPU
 collective-rendezvous freeze on oversubscribed virtual-device hosts
-(core/platform.py). This wrapper turns that contract into a one-liner:
+(core/platform.py). This wrapper turns that contract into supervision:
 
-    python scripts/train_resilient.py --max-attempts 25 -- \\
+    python scripts/train_resilient.py --max-attempts 25 \\
+        --heartbeat-timeout 120 -- \\
         python train.py --config configs/bert_base_mlm.yaml \\
         --set checkpoint.directory=/tmp/run_ck \\
         --set checkpoint.save_interval_steps=500
 
-Behavior:
-  * Runs the command after ``--``; exit 0 stops the loop (done).
-  * Any non-zero exit relaunches after ``--retry-sleep`` seconds, up to
-    ``--max-attempts`` total attempts; the final rc is propagated.
-  * For CPU-mesh runs (JAX_PLATFORMS=cpu) it lowers the XLA:CPU
-    collective terminate timeout so a frozen collective dies in minutes
-    instead of hanging a round — the relaunch + auto-restore then makes
-    the freeze a bounded restart. User-provided XLA_FLAGS values win.
-  * Warns when the command line carries no checkpoint.directory: without
-    checkpoints every relaunch restarts from step 0.
-
-The MoE trained-to-metric artifact (RESULTS.md round 4) is the
-reference run for this recovery shape: a freeze mid-run cost one
-bounded restart and the resumed trajectory was bit-exact.
+Behavior (exit-code contract in docs/RESILIENCE.md):
+  * exit 0 stops the loop (done); any other rc is classified first.
+  * 130/143 (SIGINT/SIGTERM death) is operator CANCELLATION — never
+    relaunched. SIGTERM/SIGINT sent to the supervisor itself is forwarded
+    to the child and also treated as cancellation.
+  * GRACEFUL_PREEMPT_RC (83) means the child honored a SIGTERM: step
+    finished, checkpoint saved — relaunched immediately WITHOUT consuming
+    an attempt (preemption is scheduling, not failure).
+  * Heartbeat watchdog: when the run's heartbeat file (written by
+    train/hooks.HeartbeatHook under checkpoint.directory) goes stale past
+    ``--heartbeat-timeout``, the child is SIGKILLed instead of waiting for
+    an XLA collective timeout; the kill counts as a transient hang.
+  * Other failures relaunch after exponential backoff with jitter, up to
+    ``--max-attempts``; the final rc is propagated.
+  * Crash-loop breaker: the same rc at the same step with no checkpoint
+    progress, ``--crash-loop-threshold`` attempts in a row, is a
+    deterministic bug — the loop stops early with a structured report
+    instead of burning the budget (core/supervision.py).
+  * Every attempt is emitted as a ``dtf-telemetry/1`` JSONL event
+    (``supervisor_attempt``) to ``<ckpt_dir>/supervisor_events.jsonl`` so
+    recovery activity joins the run's telemetry
+    (scripts/analyze_trace.py prints it in run summaries).
+  * For CPU-mesh runs (JAX_PLATFORMS=cpu) the XLA:CPU collective terminate
+    timeout is lowered so a frozen collective dies in minutes; user-set
+    XLA_FLAGS win.
+  * Warns when neither the command line nor its --config YAML carries a
+    checkpoint.directory: without checkpoints every relaunch restarts from
+    step 0.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import pathlib
+import signal
 import subprocess
 import sys
 import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+from distributed_tensorflow_framework_tpu.core import (  # noqa: E402
+    supervision,
+    telemetry,
+)
 from distributed_tensorflow_framework_tpu.core.platform import (  # noqa: E402
     FAST_FAIL_COLLECTIVE_FLAGS,
     with_cpu_collective_timeouts,
 )
+
+
+def _load_manifest_module():
+    """ckpt/manifest.py loaded directly from its file: importing it through
+    the ckpt package would pull in jax + orbax (checkpoint.py), a
+    multi-second tax on every supervisor start that the stdlib-only
+    manifest layer exists to avoid."""
+    import importlib.util
+
+    path = (pathlib.Path(__file__).resolve().parent.parent
+            / "distributed_tensorflow_framework_tpu" / "ckpt" / "manifest.py")
+    spec = importlib.util.spec_from_file_location("_dtf_ckpt_manifest", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+latest_committed_step = _load_manifest_module().latest_committed_step
 
 
 def build_env(base: dict | None = None) -> dict:
@@ -57,11 +96,128 @@ def build_env(base: dict | None = None) -> dict:
     return env
 
 
+def find_checkpoint_dir(cmd: list[str]) -> tuple[str | None, bool]:
+    """(checkpoint directory, checkpointing-enabled) for a training command.
+
+    Command-line ``checkpoint.directory=`` values win (last occurrence, the
+    --set override semantics); otherwise the ``--config`` YAML is parsed —
+    not assumed — since a user YAML may leave checkpointing disabled
+    (ADVICE r4). An unreadable/odd YAML gets the benefit of the doubt
+    (enabled=True, directory unknown): the trainer will fail loudly on it,
+    and crying wolf here trains operators to ignore the warning.
+    """
+    import re
+
+    directory: str | None = None
+    explicit = False
+    for arg in cmd:
+        if "checkpoint.directory=" in arg:
+            explicit = True
+            raw = arg.split("checkpoint.directory=", 1)[1]
+            # The override may ride inside a larger token (a `python -c`
+            # program, a shell-quoted --set) — take the value up to the
+            # first quote/whitespace/comma.
+            directory = re.split(r"[\s'\",]", raw, 1)[0]
+    if explicit:
+        return (directory or None), bool(directory)
+    config_path = None
+    for i, arg in enumerate(cmd):
+        if arg == "--config" and i + 1 < len(cmd):
+            config_path = cmd[i + 1]
+        elif arg.startswith("--config="):
+            config_path = arg.split("=", 1)[1]
+    if config_path is None:
+        return None, False
+    try:
+        import yaml
+
+        with open(config_path) as fh:
+            doc = yaml.safe_load(fh) or {}
+        directory = (doc.get("checkpoint") or {}).get("directory") or None
+        return directory, bool(directory)
+    except Exception:
+        return None, True  # benefit of the doubt
+
+
+# -- cancellation forwarding ----------------------------------------------
+_child: subprocess.Popen | None = None
+_cancelled = False
+
+
+def _forward_signal(signum, frame):
+    global _cancelled
+    _cancelled = True
+    if _child is not None and _child.poll() is None:
+        _child.send_signal(signum)
+
+
+def _run_attempt(cmd, env, *, hb_path: str | None, hb_timeout: float,
+                 hb_poll: float, startup_grace: float) -> tuple[int, bool, int]:
+    """Run the child under the heartbeat watchdog; (rc, hung, child pid)."""
+    global _child
+    _child = child = subprocess.Popen(cmd, env=env)
+    start = time.monotonic()
+    hung = False
+    watch = hb_path is not None and hb_timeout > 0
+    while True:
+        try:
+            rc = child.wait(timeout=hb_poll if watch else None)
+            break
+        except subprocess.TimeoutExpired:
+            pass
+        age = supervision.heartbeat_age_s(hb_path, pid=child.pid)
+        stale = age is not None and age > hb_timeout
+        no_start = (age is None and startup_grace > 0
+                    and time.monotonic() - start > startup_grace)
+        if stale or no_start:
+            why = (f"heartbeat stale ({age:.0f}s > {hb_timeout:.0f}s budget)"
+                   if stale else
+                   f"no heartbeat within {startup_grace:.0f}s startup grace")
+            print(f"train_resilient: {why} — killing hung child "
+                  f"pid={child.pid}", file=sys.stderr)
+            child.kill()
+            rc = child.wait()
+            hung = True
+            break
+    _child = None
+    return rc, hung, child.pid
+
+
 def main(argv=None) -> int:
+    global _cancelled
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("--max-attempts", type=int, default=10)
-    parser.add_argument("--retry-sleep", type=float, default=5.0)
+    parser.add_argument("--retry-sleep", type=float, default=5.0,
+                        help="backoff BASE seconds (doubles per consecutive "
+                             "failure, jittered)")
+    parser.add_argument("--backoff-max", type=float, default=120.0,
+                        help="backoff ceiling in seconds")
+    parser.add_argument("--jitter", type=float, default=0.5,
+                        help="fractional backoff jitter (0 disables)")
+    parser.add_argument("--heartbeat-timeout", type=float, default=0.0,
+                        help="kill the child when its heartbeat file is "
+                             "older than this many seconds (0 disables the "
+                             "watchdog)")
+    parser.add_argument("--heartbeat-poll", type=float, default=2.0,
+                        help="watchdog poll interval in seconds")
+    parser.add_argument("--heartbeat-file", default=None,
+                        help="heartbeat path (default: "
+                             "<checkpoint.directory>/heartbeat.json)")
+    parser.add_argument("--startup-grace", type=float, default=0.0,
+                        help="kill the child when NO heartbeat appears "
+                             "within this many seconds of launch (0 "
+                             "disables; compile time counts against it)")
+    parser.add_argument("--crash-loop-threshold", type=int, default=3,
+                        help="stop after this many consecutive identical "
+                             "no-progress failures (0 disables the breaker)")
+    parser.add_argument("--max-preemptions", type=int, default=50,
+                        help="safety bound on graceful-preemption "
+                             "relaunches (they never consume attempts)")
+    parser.add_argument("--events", default=None,
+                        help="supervisor telemetry JSONL (default: "
+                             "<checkpoint.directory>/supervisor_events"
+                             ".jsonl; '-' disables)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command after --")
     args = parser.parse_args(argv)
@@ -72,61 +228,122 @@ def main(argv=None) -> int:
         parser.error("no command given (put it after `--`)")
     if args.max_attempts < 1:
         parser.error("--max-attempts must be >= 1")
-    explicit_off = any(a.rstrip().endswith("checkpoint.directory=")
-                       for a in cmd)
-    has_dir = any("checkpoint.directory=" in a
-                  and not a.rstrip().endswith("checkpoint.directory=")
-                  for a in cmd)
-    # A --config YAML may enable checkpointing itself (all shipped
-    # configs do) — but a user YAML may also leave it disabled, so parse
-    # the YAML instead of assuming (ADVICE r4). Unreadable/odd YAMLs get
-    # the benefit of the doubt (the trainer will fail loudly on them).
-    config_path = None
-    for i, a in enumerate(cmd):
-        if a == "--config" and i + 1 < len(cmd):
-            config_path = cmd[i + 1]
-        elif a.startswith("--config="):
-            config_path = a.split("=", 1)[1]
-    config_has_dir = False
-    if config_path is not None:
-        config_has_dir = True  # assume-on unless we can prove otherwise
-        try:
-            import yaml
-            with open(config_path) as f:
-                doc = yaml.safe_load(f) or {}
-            config_has_dir = bool(
-                (doc.get("checkpoint") or {}).get("directory"))
-        except Exception:
-            pass
-    if explicit_off or (not has_dir and not config_has_dir):
+
+    ckpt_dir, ckpt_enabled = find_checkpoint_dir(cmd)
+    if not ckpt_enabled:
         print("train_resilient: WARNING — no checkpoint.directory in the "
               "command; every relaunch will restart from step 0",
               file=sys.stderr)
+    hb_path = args.heartbeat_file or (
+        os.path.join(ckpt_dir, "heartbeat.json") if ckpt_dir else None)
+    if args.heartbeat_timeout > 0 and hb_path is None:
+        print("train_resilient: WARNING — --heartbeat-timeout set but no "
+              "heartbeat path is known (need checkpoint.directory or "
+              "--heartbeat-file); watchdog disabled", file=sys.stderr)
+
+    events_path = args.events
+    if events_path is None and ckpt_dir:
+        events_path = os.path.join(ckpt_dir, "supervisor_events.jsonl")
+    writer = telemetry.TelemetryWriter(
+        None if events_path in (None, "-") else events_path)
+    writer.emit_run_meta(
+        argv=[sys.argv[0]], supervisor=True, command=" ".join(cmd),
+        max_attempts=args.max_attempts,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        checkpoint_dir=ckpt_dir or "",
+    )
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _forward_signal)
+        except (ValueError, OSError):  # non-main thread (tests importing us)
+            pass
+
     env = build_env()
+    breaker = supervision.CrashLoopBreaker(args.crash_loop_threshold)
     rc = 1
-    for attempt in range(1, args.max_attempts + 1):
+    attempt = failures = preemptions = 0
+    while attempt < args.max_attempts:
+        attempt += 1
         print(f"train_resilient: attempt {attempt}/{args.max_attempts}",
               file=sys.stderr)
-        rc = subprocess.run(cmd, env=env).returncode
+        rc, hung, child_pid = _run_attempt(
+            cmd, env, hb_path=hb_path, hb_timeout=args.heartbeat_timeout,
+            hb_poll=args.heartbeat_poll, startup_grace=args.startup_grace)
         if rc < 0:
             # Child died to a signal (e.g. the XLA terminate timeout's
             # SIGABRT → -6): report the shell's 128+signal convention so
             # outer automation can classify the failure (134 = SIGABRT).
             rc = 128 - rc
-        if rc in (130, 143):
-            # SIGINT/SIGTERM are CANCELLATION, not infrastructure
-            # failure — honor the operator instead of relaunching.
-            print(f"train_resilient: child cancelled (rc={rc}) — "
-                  "not retrying", file=sys.stderr)
-            return rc
+        # Progress accounting for the crash-loop breaker: the heartbeat
+        # record only counts when the just-dead child wrote it (pid match);
+        # a predecessor's stale record would fake forward progress.
+        hb = supervision.read_heartbeat(hb_path) if hb_path else None
+        last_step = None
+        if hb and hb.get("pid") in (None, child_pid):
+            last_step = hb.get("last_completed_step", hb.get("step"))
+        ckpt_step = latest_committed_step(ckpt_dir) if ckpt_dir else None
+
         if rc == 0:
             print(f"train_resilient: done (attempt {attempt})",
                   file=sys.stderr)
+            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                        attempt=attempt, rc=0, classification="done",
+                        last_step=last_step, ckpt_step=ckpt_step)
             return 0
-        print(f"train_resilient: attempt {attempt} exited rc={rc}",
-              file=sys.stderr)
+        if _cancelled or rc in (130, 143):
+            # SIGINT/SIGTERM death — or a signal we forwarded ourselves —
+            # is CANCELLATION, not infrastructure failure: honor the
+            # operator instead of relaunching. (A supervisor-level SIGTERM
+            # also ends the loop when the child preempted gracefully: the
+            # whole tree is being evicted, relaunching would fight the
+            # scheduler.)
+            print(f"train_resilient: child cancelled (rc={rc}) — "
+                  "not retrying", file=sys.stderr)
+            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                        attempt=attempt, rc=rc, classification="cancelled",
+                        last_step=last_step, ckpt_step=ckpt_step)
+            return rc
+        if rc == supervision.GRACEFUL_PREEMPT_RC:
+            preemptions += 1
+            attempt -= 1  # graceful preemption never consumes the budget
+            print(f"train_resilient: graceful preemption (rc={rc}, "
+                  f"#{preemptions}) — relaunching immediately",
+                  file=sys.stderr)
+            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                        attempt=attempt + 1, rc=rc,
+                        classification="preempted", preemptions=preemptions,
+                        last_step=last_step, ckpt_step=ckpt_step)
+            if preemptions >= args.max_preemptions:
+                print("train_resilient: preemption churn exceeded "
+                      f"--max-preemptions={args.max_preemptions} — giving "
+                      "up", file=sys.stderr)
+                return rc
+            continue
+
+        failures += 1
+        classification = "hung" if hung else "crashed"
+        print(f"train_resilient: attempt {attempt} exited rc={rc} "
+              f"({classification}, last_step={last_step}, "
+              f"ckpt_step={ckpt_step})", file=sys.stderr)
+        writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                    attempt=attempt, rc=rc, classification=classification,
+                    hung=hung, last_step=last_step, ckpt_step=ckpt_step)
+        if breaker.record(rc=rc, last_step=last_step, ckpt_step=ckpt_step,
+                          hung=hung):
+            report = breaker.report()
+            print("train_resilient: CRASH LOOP — deterministic failure, "
+                  "not retrying:\n" + json.dumps(report, indent=2),
+                  file=sys.stderr)
+            writer.emit(telemetry.KIND_CRASH_LOOP, **report)
+            return rc
         if attempt < args.max_attempts:
-            time.sleep(args.retry_sleep)
+            delay = supervision.backoff_seconds(
+                failures, base=args.retry_sleep, cap=args.backoff_max,
+                jitter=args.jitter)
+            print(f"train_resilient: backing off {delay:.1f}s",
+                  file=sys.stderr)
+            time.sleep(delay)
     return rc
 
 

@@ -1,0 +1,145 @@
+"""Checkpoint integrity manifests: commit, verify, quarantine, fallback.
+
+The recovery-correctness half of ISSUE 2 (docs/RESILIENCE.md): a torn or
+corrupt "latest" checkpoint must cost at most one checkpoint interval —
+restore detects it by hash, quarantines the directory sideways as
+``<step>.corrupt``, falls back to the newest verified older step, and the
+whole episode lands in the run's telemetry stream.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_tensorflow_framework_tpu.ckpt import manifest as mf
+from distributed_tensorflow_framework_tpu.core import faults, telemetry
+from distributed_tensorflow_framework_tpu.train import Trainer
+from tests.test_train_lenet import lenet_config
+
+
+def _train_two_checkpoints(ckpt_dir):
+    """6 lenet steps saving at 3 and 6 → a two-snapshot directory."""
+    cfg = lenet_config(**{"train.total_steps": 6, "train.log_interval": 3})
+    cfg.checkpoint.directory = ckpt_dir
+    cfg.checkpoint.save_interval_steps = 3
+    cfg.checkpoint.async_save = False
+    t = Trainer(cfg)
+    t.train()
+    assert sorted(t._ckpt_manager.all_steps()) == [3, 6]
+    return t
+
+
+def _resume_trainer(ckpt_dir, **overrides):
+    cfg = lenet_config(**{"train.total_steps": 6, "train.log_interval": 3,
+                          **overrides})
+    cfg.checkpoint.directory = ckpt_dir
+    cfg.checkpoint.async_save = False
+    t = Trainer(cfg)
+    t.build()
+    return t
+
+
+def test_save_commits_manifest(devices, tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    _train_two_checkpoints(ckpt_dir)
+    for step in (3, 6):
+        step_dir = os.path.join(ckpt_dir, str(step))
+        manifest = mf.read_manifest(step_dir)
+        assert manifest is not None, f"step {step} has no commit record"
+        assert manifest["step"] == step
+        assert manifest["file_count"] > 0
+        assert mf.verify_step_dir(step_dir, manifest) == []
+    assert mf.committed_steps(ckpt_dir) == [3, 6]
+    assert mf.latest_committed_step(ckpt_dir) == 6
+
+
+def test_torn_checkpoint_quarantined_with_fallback(devices, tmp_path):
+    """The e2e torn-write drill: newest checkpoint truncated after commit
+    → restore quarantines it, falls back to step 3, and emits
+    ckpt_quarantined + restore_fallback telemetry."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    _train_two_checkpoints(ckpt_dir)
+    hit = faults.corrupt_checkpoint_dir(os.path.join(ckpt_dir, "6"))
+    assert hit is not None
+
+    t = _resume_trainer(ckpt_dir)
+    assert t.host_step == 3, "restore did not fall back to the verified step"
+    corrupt_dir = os.path.join(ckpt_dir, "6" + mf.CORRUPT_SUFFIX)
+    assert os.path.isdir(corrupt_dir), "torn step was not quarantined"
+    assert not os.path.exists(os.path.join(ckpt_dir, "6"))
+    record = json.load(open(os.path.join(corrupt_dir, "quarantine.json")))
+    assert record["step"] == 6
+    assert record["reason"] == "integrity verification failed"
+    assert any("truncated" in e or "hash mismatch" in e
+               for e in record["errors"])
+    # quarantined steps never reappear in step listings
+    assert t._ckpt_manager.all_steps() == [3]
+    assert mf.latest_committed_step(ckpt_dir) == 3
+
+    events = list(telemetry.read_events(
+        os.path.join(ckpt_dir, "events.jsonl"), strict=True))
+    kinds = [e["kind"] for e in events]
+    assert telemetry.KIND_CKPT_QUARANTINED in kinds
+    assert telemetry.KIND_RESTORE_FALLBACK in kinds
+    fb = next(e for e in events
+              if e["kind"] == telemetry.KIND_RESTORE_FALLBACK)
+    assert fb["health"]["from_step"] == 6
+    assert fb["health"]["to_step"] == 3
+    # ...and the run summary surfaces the recovery activity
+    summary = telemetry.summarize_events(
+        os.path.join(ckpt_dir, "events.jsonl"))
+    assert summary["recovery"]["quarantined"][0]["step"] == 6
+    assert summary["recovery"]["restore_fallbacks"] == [
+        {"from_step": 6, "to_step": 3}]
+    text = telemetry.format_run_summary(summary)
+    assert "quarantined checkpoint step 6" in text
+    assert "restore fell back: step 6 -> 3" in text
+
+
+def test_uncommitted_step_skipped(devices, tmp_path):
+    """A step directory without a manifest is an interrupted save (the
+    crash_in_save artifact): quarantined as uncommitted, restore uses the
+    older committed step."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    _train_two_checkpoints(ckpt_dir)
+    os.remove(os.path.join(ckpt_dir, "6", mf.MANIFEST_NAME))
+
+    t = _resume_trainer(ckpt_dir)
+    assert t.host_step == 3
+    corrupt_dir = os.path.join(ckpt_dir, "6" + mf.CORRUPT_SUFFIX)
+    record = json.load(open(os.path.join(corrupt_dir, "quarantine.json")))
+    assert record["reason"] == "uncommitted save"
+
+
+def test_legacy_store_without_manifests_restores(devices, tmp_path):
+    """Pre-manifest checkpoint directories (zero manifests anywhere) must
+    keep restoring — trusted unverified — instead of bricking old runs."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    _train_two_checkpoints(ckpt_dir)
+    for step in (3, 6):
+        os.remove(os.path.join(ckpt_dir, str(step), mf.MANIFEST_NAME))
+    t = _resume_trainer(ckpt_dir)
+    assert t.host_step == 6
+    assert sorted(t._ckpt_manager.all_steps()) == [3, 6]
+
+
+def test_explicit_restore_step_fails_loudly_on_corruption(devices, tmp_path):
+    """checkpoint.restore_step pins ONE snapshot; if that snapshot is
+    corrupt the restore must raise — silently reading another step is the
+    exact fallback restore_step exists to prevent."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    _train_two_checkpoints(ckpt_dir)
+    faults.corrupt_checkpoint_dir(os.path.join(ckpt_dir, "3"))
+    with pytest.raises(ValueError, match="integrity verification"):
+        _resume_trainer(ckpt_dir, **{"checkpoint.restore_step": 3})
+
+
+def test_quarantine_suffix_collision(tmp_path):
+    root = str(tmp_path)
+    for _ in range(2):
+        os.makedirs(os.path.join(root, "5"))
+        assert mf.quarantine(root, 5, "test", ["e"]) is not None
+    assert os.path.isdir(os.path.join(root, "5.corrupt"))
+    assert os.path.isdir(os.path.join(root, "5.corrupt.1"))
+    assert mf.quarantine(root, 5, "gone") is None  # already vanished

@@ -16,9 +16,11 @@ import time
 import pytest
 
 DRIVER = """
+import sys
 import jax; jax.config.update('jax_platforms','cpu')
 from distributed_tensorflow_framework_tpu.cli.train import main
-main(['--set','model.name=lenet5','--set','model.dtype=float32',
+sys.exit(
+ main(['--set','model.name=lenet5','--set','model.dtype=float32',
       '--set','data.name=synthetic_images','--set','data.image_size=28',
       '--set','data.channels=1','--set','data.global_batch_size=64',
       '--set','mesh.data=8',
@@ -27,11 +29,11 @@ main(['--set','model.name=lenet5','--set','model.dtype=float32',
       '--set','train.eval_steps=0',
       '--set','checkpoint.directory={ckpt}',
       '--set','checkpoint.save_interval_steps=20',
-      '--set','checkpoint.async_save=false'])
+      '--set','checkpoint.async_save=false']))
 """
 
 
-def _launch(ckpt_dir: str, steps: int) -> subprocess.Popen:
+def _child_env(env_extra: dict | None = None) -> dict:
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS",)}
     env["JAX_PLATFORMS"] = ""
@@ -41,10 +43,17 @@ def _launch(ckpt_dir: str, steps: int) -> subprocess.Popen:
                             " --xla_force_host_platform_device_count=8").strip()
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    return env
+
+
+def _launch(ckpt_dir: str, steps: int,
+            env_extra: dict | None = None) -> subprocess.Popen:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     return subprocess.Popen(
         [sys.executable, "-c", DRIVER.format(ckpt=ckpt_dir, steps=steps)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env, cwd=repo_root,
+        env=_child_env(env_extra), cwd=repo_root,
     )
 
 
@@ -83,3 +92,124 @@ def test_sigkill_and_relaunch_resumes(tmp_path):
     assert survivor.returncode == 0, out[-3000:]
     assert "Restored checkpoint at step" in out, out[-3000:]
     assert "final train metrics" in out, out[-3000:]
+
+
+# ---------------------------------------------------------- fault drills --
+# DTF_FAULTS-driven, supervised end-to-end drills (docs/RESILIENCE.md).
+# The fast injection-mechanics subset lives in tests/test_faults.py; these
+# run real training children and are tier-2 by their slow marks.
+
+def _final_loss(ckpt_dir: str, step: int) -> float:
+    from distributed_tensorflow_framework_tpu.core import telemetry
+
+    losses = [
+        e["metrics"]["loss"]
+        for e in telemetry.read_events(
+            os.path.join(ckpt_dir, "events.jsonl"),
+            kind="train_step", strict=False)
+        if e.get("step") == step
+    ]
+    assert losses, f"no train_step event at step {step} in {ckpt_dir}"
+    return losses[-1]
+
+
+def _run_supervised(ckpt_dir: str, steps: int, sup_args: list[str],
+                    env_extra: dict, timeout: float) -> subprocess.CompletedProcess:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, "scripts/train_resilient.py", *sup_args, "--",
+           sys.executable, "-c", DRIVER.format(ckpt=ckpt_dir, steps=steps)]
+    return subprocess.run(cmd, env=_child_env(env_extra), cwd=repo_root,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+@pytest.mark.slow
+@pytest.mark.slowest
+def test_supervised_crash_in_save_drill(tmp_path):
+    """The acceptance drill: SIGKILL mid-save (between checkpoint data and
+    manifest commit) under the supervisor → relaunch → torn step
+    quarantined → resume from the last committed step → final loss
+    BIT-EXACT against an uninterrupted run of the same seed."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    ref_dir = str(tmp_path / "ref")
+
+    ref = _launch(ref_dir, 60)
+    out, _ = ref.communicate(timeout=420)
+    assert ref.returncode == 0, out[-3000:]
+
+    r = _run_supervised(
+        ckpt_dir, 60,
+        ["--max-attempts", "3", "--retry-sleep", "0.2", "--jitter", "0"],
+        {"DTF_FAULTS": "crash_in_save:40",
+         "DTF_FAULTS_STATE": str(tmp_path / "faults_state.json")},
+        timeout=560,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "firing crash_in_save:40" in r.stderr, r.stderr[-3000:]
+    assert "exited rc=137" in r.stderr  # SIGKILL mid-save, relaunched
+    assert "done (attempt 2)" in r.stderr
+    # the torn step-40 save was quarantined as uncommitted, then re-saved
+    quarantined = [d for d in os.listdir(ckpt_dir) if d.startswith("40.corrupt")]
+    assert quarantined, os.listdir(ckpt_dir)
+    assert os.path.isdir(os.path.join(ckpt_dir, "40"))  # the re-save
+    # recovery cost at most one checkpoint interval, correctness: zero
+    assert _final_loss(ckpt_dir, 60) == _final_loss(ref_dir, 60)
+
+
+@pytest.mark.slow
+@pytest.mark.slowest
+def test_sigterm_graceful_preemption_round_trip(tmp_path):
+    """SIGTERM → in-flight step finishes → checkpoint committed → exit
+    rc=83 (GRACEFUL_PREEMPT_RC) → relaunch resumes from the preemption
+    step."""
+    import json
+
+    from distributed_tensorflow_framework_tpu.ckpt import manifest as mf
+    from distributed_tensorflow_framework_tpu.core.supervision import (
+        GRACEFUL_PREEMPT_RC,
+    )
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    victim = _launch(ckpt_dir, 4000)
+    try:
+        _wait_for_checkpoint(ckpt_dir)
+    finally:
+        if victim.poll() is None:
+            victim.send_signal(signal.SIGTERM)
+    out, _ = victim.communicate(timeout=240)
+    assert victim.returncode == GRACEFUL_PREEMPT_RC, out[-3000:]
+    assert "preempted gracefully" in out, out[-3000:]
+
+    preempt_step = mf.latest_committed_step(ckpt_dir)
+    assert preempt_step is not None, "no committed checkpoint after preemption"
+    hb = json.load(open(os.path.join(ckpt_dir, "heartbeat.json")))
+    assert hb["status"] == "preempted"
+    assert hb["last_completed_step"] == preempt_step
+
+    survivor = _launch(ckpt_dir, preempt_step + 20)
+    out, _ = survivor.communicate(timeout=420)
+    assert survivor.returncode == 0, out[-3000:]
+    assert f"Restored checkpoint at step {preempt_step}" in out, out[-3000:]
+
+
+@pytest.mark.slow
+@pytest.mark.slowest
+def test_crash_loop_breaker_on_deterministic_crash(tmp_path):
+    """crash_at_step with NO state file re-fires on every relaunch — a
+    deterministic crash. The supervisor's breaker must halt after
+    --crash-loop-threshold identical no-progress failures instead of
+    burning all five attempts."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    r = _run_supervised(
+        ckpt_dir, 60,
+        ["--max-attempts", "5", "--retry-sleep", "0.2", "--jitter", "0",
+         "--crash-loop-threshold", "2",
+         # crash at step 5 < first save: no heartbeat/ckpt progress signal,
+         # so every attempt has the identical (137, None, None) signature
+         "--heartbeat-file", str(tmp_path / "no_heartbeat.json")],
+        {"DTF_FAULTS": "crash_at_step:5"},
+        timeout=560,
+    )
+    assert r.returncode == 137, (r.returncode, r.stderr[-3000:])
+    assert "CRASH LOOP" in r.stderr
+    assert "deterministic_crash_loop" in r.stderr
+    assert "attempt 3/5" not in r.stderr  # halted at the threshold

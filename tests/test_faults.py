@@ -1,0 +1,151 @@
+"""core/faults.py: the DTF_FAULTS injection registry (docs/RESILIENCE.md).
+
+Fast tier-1 coverage: spec parsing, once-only semantics (in-process and
+across simulated relaunches via DTF_FAULTS_STATE), the infeed stall wired
+into HostDataset, batch poisoning, and checkpoint corruption. The
+crash kinds SIGKILL the process, so they get a subprocess each; the full
+supervised drills live in test_fault_tolerance.py / test_supervisor.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_framework_tpu.core import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    yield
+    faults.install(faults.FaultPlan())  # empty plan; no env re-read
+
+
+def test_parse_all_kinds():
+    plan = faults.FaultPlan.parse(
+        "crash_at_step:120, stall_infeed:30s, corrupt_ckpt:params,"
+        "nan_grads:200, crash_in_save:40"
+    )
+    by_kind = {f.kind: f for f in plan.faults}
+    assert set(by_kind) == {"crash_at_step", "stall_infeed", "corrupt_ckpt",
+                            "nan_grads", "crash_in_save"}
+    assert by_kind["crash_at_step"].step == 120
+    assert by_kind["crash_in_save"].step == 40
+    assert by_kind["nan_grads"].step == 200
+    assert by_kind["stall_infeed"].seconds == 30.0
+    assert by_kind["corrupt_ckpt"].arg == "params"
+    assert plan.active
+
+
+def test_parse_empty_and_errors():
+    assert not faults.FaultPlan.parse("").active
+    assert not faults.FaultPlan.parse(" , ,").active
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.FaultPlan.parse("explode:3")
+    with pytest.raises(ValueError, match="integer step"):
+        faults.FaultPlan.parse("crash_at_step:soon")
+    with pytest.raises(ValueError, match=">= 1"):
+        faults.FaultPlan.parse("crash_at_step:0")
+    with pytest.raises(ValueError, match="duration"):
+        faults.FaultPlan.parse("stall_infeed:forever")
+
+
+def test_stall_zero_means_forever():
+    plan = faults.FaultPlan.parse("stall_infeed:0")
+    assert plan.faults[0].seconds >= 3600.0
+
+
+def test_fire_matches_point_and_step():
+    plan = faults.FaultPlan.parse("nan_grads:3")
+    assert plan.fire("step_begin", step=2) == []
+    assert plan.fire("infeed", step=3) == []  # wrong point
+    fired = plan.fire("step_begin", step=3)
+    assert [f.kind for f in fired] == ["nan_grads"]
+    # once per process: same point+step again is a no-op
+    assert plan.fire("step_begin", step=3) == []
+
+
+def test_module_fire_inactive_is_noop():
+    faults.install(faults.FaultPlan())
+    assert faults.fire("step_begin", step=1) == []
+    assert faults.fire("infeed") == []
+
+
+def test_state_file_survives_relaunch(tmp_path):
+    """DTF_FAULTS_STATE makes firings once-only ACROSS relaunches: a plan
+    re-parsed from the same spec (the relaunched child) sees the fault as
+    already fired."""
+    state = str(tmp_path / "faults_state.json")
+    plan1 = faults.FaultPlan.parse("nan_grads:5", state_path=state)
+    assert [f.kind for f in plan1.fire("step_begin", step=5)] == ["nan_grads"]
+    assert json.loads(open(state).read()) == ["nan_grads:5"]
+    plan2 = faults.FaultPlan.parse("nan_grads:5", state_path=state)
+    assert plan2.faults[0].fired
+    assert plan2.fire("step_begin", step=5) == []
+
+
+def test_infeed_stall_fires_in_host_dataset():
+    from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
+
+    def make_iter(state):
+        while True:
+            yield {"x": np.zeros((2,), np.float32)}
+
+    ds = HostDataset(make_iter, element_spec={"x": ((2,), np.float32)})
+    faults.install("stall_infeed:0.2s")
+    t0 = time.monotonic()
+    next(ds)
+    stalled = time.monotonic() - t0
+    assert stalled >= 0.2
+    t0 = time.monotonic()
+    next(ds)  # once-only: second pull is immediate
+    assert time.monotonic() - t0 < 0.2
+
+
+def test_crash_at_step_sigkills_subprocess(tmp_path):
+    """crash_at_step is a real SIGKILL (no cleanup) — drill it end-to-end
+    in a child on the step_begin fault point. The state file must record
+    the firing BEFORE the kill so a relaunch does not re-fire."""
+    state = str(tmp_path / "state.json")
+    prog = (
+        "from distributed_tensorflow_framework_tpu.core import faults\n"
+        "faults.active_plan()\n"
+        "for step in (1, 2, 3):\n"
+        "    faults.fire('step_begin', step=step)\n"
+        "print('SURVIVED', flush=True)\n"
+    )
+    env = dict(os.environ, DTF_FAULTS="crash_at_step:2",
+               DTF_FAULTS_STATE=state)
+    r = subprocess.run([sys.executable, "-c", prog], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == -9, (r.returncode, r.stderr)
+    assert "SURVIVED" not in r.stdout
+    assert json.loads(open(state).read()) == ["crash_at_step:2"]
+    # relaunch with the same env: the recorded firing disarms the fault
+    r2 = subprocess.run([sys.executable, "-c", prog], env=env,
+                        capture_output=True, text=True, timeout=60)
+    assert r2.returncode == 0, r2.stderr
+    assert "SURVIVED" in r2.stdout
+
+
+def test_corrupt_checkpoint_dir_truncates_largest(tmp_path):
+    d = tmp_path / "7"
+    d.mkdir()
+    (d / "small.bin").write_bytes(b"x" * 10)
+    (d / "big.bin").write_bytes(b"y" * 1000)
+    (d / "manifest.json").write_text("{}")  # never the corruption target
+    hit = faults.corrupt_checkpoint_dir(str(d))
+    assert hit == str(d / "big.bin")
+    assert (d / "big.bin").stat().st_size == 500
+    assert (d / "small.bin").stat().st_size == 10
+    assert (d / "manifest.json").read_text() == "{}"
+
+
+def test_corrupt_empty_dir_returns_none(tmp_path):
+    d = tmp_path / "9"
+    d.mkdir()
+    assert faults.corrupt_checkpoint_dir(str(d)) is None

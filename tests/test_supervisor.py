@@ -1,0 +1,170 @@
+"""Supervision: backoff, crash-loop breaker, heartbeat watchdog, rc=83.
+
+Unit tests cover the pure decision logic in core/supervision.py; the
+subprocess tests drive scripts/train_resilient.py with cheap stand-in
+children (no JAX) to pin the supervisor's contract: graceful preemption
+relaunches without consuming the attempt budget, a stalled heartbeat gets
+the child killed within the staleness budget, and a deterministic crash
+loop halts early with a structured report.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distributed_tensorflow_framework_tpu.core import supervision, telemetry
+
+SCRIPT = "scripts/train_resilient.py"
+
+
+def run(args, timeout=120):
+    return subprocess.run(
+        [sys.executable, SCRIPT, *args], env=dict(os.environ),
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+# ---------------------------------------------------------------- units --
+def test_backoff_doubles_and_caps():
+    kw = {"base": 2.0, "cap": 9.0, "jitter": 0.0}
+    assert [supervision.backoff_seconds(i, **kw) for i in (1, 2, 3, 4)] == \
+        [2.0, 4.0, 8.0, 9.0]
+    assert supervision.backoff_seconds(1, base=0.0) == 0.0
+
+
+def test_backoff_jitter_bounds():
+    rng = random.Random(7)
+    for i in range(1, 6):
+        d = supervision.backoff_seconds(i, base=1.0, cap=60.0, jitter=0.5,
+                                        rng=rng)
+        nominal = min(60.0, 2.0 ** (i - 1))
+        assert 0.5 * nominal <= d <= 1.5 * nominal
+
+
+def test_crash_loop_breaker():
+    b = supervision.CrashLoopBreaker(threshold=2)
+    assert not b.record(rc=1, last_step=10, ckpt_step=5)
+    # progress (new ckpt step) resets the streak — transient
+    assert not b.record(rc=1, last_step=10, ckpt_step=10)
+    # identical signature twice in a row trips it
+    assert not b.record(rc=1, last_step=12, ckpt_step=10)
+    assert b.record(rc=1, last_step=12, ckpt_step=10)
+    report = b.report()
+    assert report["verdict"] == "deterministic_crash_loop"
+    assert report["rc"] == 1 and report["streak"] == 2
+    assert report["attempts_recorded"] == 4
+
+
+def test_crash_loop_breaker_hung_is_transient():
+    b = supervision.CrashLoopBreaker(threshold=2)
+    for _ in range(5):  # watchdog kills never accumulate a streak
+        assert not b.record(rc=137, last_step=None, ckpt_step=None, hung=True)
+    # threshold=0 disables entirely
+    b0 = supervision.CrashLoopBreaker(threshold=0)
+    for _ in range(5):
+        assert not b0.record(rc=1, last_step=None, ckpt_step=None)
+
+
+def test_heartbeat_age_pid_scoped(tmp_path):
+    path = str(tmp_path / "heartbeat.json")
+    assert supervision.heartbeat_age_s(path) is None  # no file yet
+    now = time.time()
+    json.dump({"pid": 12345, "t": now - 30.0}, open(path, "w"))
+    age = supervision.heartbeat_age_s(path, pid=12345, now=now)
+    assert age == pytest.approx(30.0)
+    # another child's record reads as "no heartbeat yet", not staleness
+    assert supervision.heartbeat_age_s(path, pid=999, now=now) is None
+    # record without a timestamp falls back to file mtime
+    json.dump({"pid": 12345}, open(path, "w"))
+    assert supervision.heartbeat_age_s(path, pid=12345) < 10.0
+
+
+def test_graceful_rc_is_not_a_signal_code():
+    assert supervision.GRACEFUL_PREEMPT_RC not in (130, 143)
+    assert not 128 <= supervision.GRACEFUL_PREEMPT_RC <= 192
+
+
+# ---------------------------------------------- supervisor loop (e2e) --
+def test_preemption_relaunches_without_consuming_budget(tmp_path):
+    """rc=83 (graceful preemption) relaunches immediately and does NOT
+    count against --max-attempts: with a budget of ONE attempt, a child
+    that preempts once and then succeeds still finishes."""
+    marker = tmp_path / "preempted_once"
+    prog = (
+        "import pathlib, sys\n"
+        "p = pathlib.Path(r'%s')\n"
+        "if p.exists():\n"
+        "    sys.exit(0)\n"
+        "p.write_text('x')\n"
+        "sys.exit(%d)\n" % (marker, supervision.GRACEFUL_PREEMPT_RC)
+    )
+    r = run(["--max-attempts", "1", "--events", "-", "--",
+             sys.executable, "-c", prog])
+    assert r.returncode == 0, r.stderr
+    assert "graceful preemption" in r.stderr
+    assert "done (attempt 1)" in r.stderr
+    assert r.stderr.count("attempt 1/1") == 2  # relaunched, budget intact
+
+
+def test_watchdog_kills_stalled_child(tmp_path):
+    """A child that heartbeats once and then wedges must be SIGKILLed
+    within the staleness budget — not waited on forever."""
+    hb = tmp_path / "heartbeat.json"
+    prog = (
+        "import json, os, time\n"
+        "json.dump({'pid': os.getpid(), 't': time.time(),"
+        " 'last_completed_step': 7}, open(r'%s', 'w'))\n"
+        "time.sleep(120)\n" % hb
+    )
+    t0 = time.monotonic()
+    r = run(["--max-attempts", "1", "--heartbeat-file", str(hb),
+             "--heartbeat-timeout", "1", "--heartbeat-poll", "0.3",
+             "--events", "-", "--", sys.executable, "-c", prog])
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 137, (r.returncode, r.stderr)  # 128 + SIGKILL
+    assert "killing hung child" in r.stderr
+    assert "(hung, last_step=7" in r.stderr
+    assert elapsed < 60, f"watchdog took {elapsed:.0f}s"
+
+
+def test_startup_grace_kills_silent_child(tmp_path):
+    """--startup-grace bounds 'never heartbeated at all' (a child wedged
+    before its first step)."""
+    hb = tmp_path / "never_written.json"
+    r = run(["--max-attempts", "1", "--heartbeat-file", str(hb),
+             "--heartbeat-timeout", "30", "--heartbeat-poll", "0.3",
+             "--startup-grace", "1", "--events", "-", "--",
+             sys.executable, "-c", "import time; time.sleep(120)"])
+    assert r.returncode == 137, (r.returncode, r.stderr)
+    assert "startup grace" in r.stderr
+
+
+def test_crash_loop_breaker_halts_early(tmp_path):
+    """A deterministic crash (same rc, no progress, attempt after attempt)
+    must stop at --crash-loop-threshold with a structured report, not burn
+    the whole attempt budget."""
+    events = tmp_path / "supervisor_events.jsonl"
+    r = run(["--max-attempts", "10", "--retry-sleep", "0.05", "--jitter",
+             "0", "--crash-loop-threshold", "2", "--events", str(events),
+             "--", sys.executable, "-c", "import sys; sys.exit(5)"])
+    assert r.returncode == 5
+    assert "CRASH LOOP" in r.stderr
+    assert "deterministic_crash_loop" in r.stderr
+    assert "attempt 2 exited rc=5" in r.stderr
+    assert "attempt 3/10" not in r.stderr  # halted early
+
+    evs = list(telemetry.read_events(str(events), strict=True))
+    kinds = [e["kind"] for e in evs]
+    assert kinds.count(telemetry.KIND_SUPERVISOR_ATTEMPT) == 2
+    assert telemetry.KIND_CRASH_LOOP in kinds
+    loop_ev = next(e for e in evs if e["kind"] == telemetry.KIND_CRASH_LOOP)
+    assert loop_ev["extra"]["verdict"] == "deterministic_crash_loop"
+    summary = telemetry.summarize_events(str(events))
+    assert summary["recovery"]["supervisor_attempts"] == {"crashed": 2}
+    assert summary["recovery"]["crash_loop"]["verdict"] == \
+        "deterministic_crash_loop"
