@@ -443,14 +443,22 @@ def _probe_device_count(timeout_s: float) -> tuple[str, object]:
     """One SUBPROCESS probe of ``jax.devices()`` under a hard timeout.
 
     Returns ``("ok", None)``, ``("error", last_stderr_line)`` for a probe
-    that exited nonzero, or ``("hang", pid)`` for one that outlived the
-    timeout — the hung child is ABANDONED alive (see _init_backend).
+    that exited nonzero, or ``("hang", <diagnostic>)`` for one that
+    outlived the timeout. A timed-out probe is REAPED — SIGKILL to its
+    whole process group, then waited — never abandoned: an abandoned
+    child holds the exclusive chip client alive, which is precisely what
+    wedges every later dial (the BENCH_r05 failure was a 240 s hang
+    followed by rc=1 with the probe pid still running). Killing the
+    GROUP also takes down any helper the client forked, so nothing keeps
+    the remote handshake open after we give up on it.
     """
+    import signal
     import subprocess
 
-    # start_new_session: the abandoned child must survive this process's
-    # exit / Ctrl-C (a group SIGINT would kill it mid-handshake — the
-    # exact wedge this code exists to avoid).
+    # start_new_session: the child leads its own process group, so the
+    # timeout path can SIGKILL the whole group without touching us, and
+    # an interactive Ctrl-C (group SIGINT) can't kill a healthy probe
+    # mid-handshake.
     proc = subprocess.Popen(
         [sys.executable, "-c",
          "import jax; d = jax.devices(); "
@@ -461,12 +469,15 @@ def _probe_device_count(timeout_s: float) -> tuple[str, object]:
     try:
         _, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        # Leave the child running. Drop our pipe ends so it can't block
-        # on a full pipe once we're gone.
-        for p in (proc.stdout, proc.stderr):
-            if p is not None:
-                p.close()
-        return "hang", proc.pid
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass  # exited in the race window / group already gone
+        try:
+            proc.communicate(timeout=10)  # reap; drain + close the pipes
+        except subprocess.TimeoutExpired:
+            pass  # kernel will reap it; don't block the retry loop
+        return "hang", f"probe exceeded {timeout_s:.0f}s (pid {proc.pid} reaped)"
     if proc.returncode == 0:
         return "ok", None
     return "error", (err.strip().splitlines() or ["no stderr"])[-1]
@@ -489,6 +500,7 @@ def _bench_wait_budget_s() -> float:
 def _init_backend(attempts: int = 3, probe_timeout_s: float = 240.0, *,
                   wait_budget_s: float | None = None,
                   retry_interval_s: float = 300.0,
+                  hang_retry_delay_s: float = 15.0,
                   probe=None, sleep=None, monotonic=None):
     """Bounded, *subprocess-probed* backend bring-up.
 
@@ -502,25 +514,25 @@ def _init_backend(attempts: int = 3, probe_timeout_s: float = 240.0, *,
     here. Returns (n_chips, device_kind) or raises BenchBackendError
     carrying the per-probe history.
 
-    Two retry regimes for fast-FAILING probes:
+    Two retry regimes:
 
-      * default: ``attempts`` tries with short backoff — a broken env
-        fails the dial quickly;
+      * default: ``attempts`` tries with short backoff for fast-failing
+        probes — a broken env fails the dial quickly. A HANG is final
+        here: without a wait budget there is no basis for deciding how
+        long a wedged tunnel is worth waiting on, so the error says how
+        to arm one (BENCH_WAIT).
       * BENCH_WAIT=<minutes> (``wait_budget_s``): re-probe every
         ``retry_interval_s`` (5 min) until the budget is spent — for
         dials raced against a slice that is still being provisioned,
-        where "wait up to an hour" beats "fail in 15 s".
+        where "wait up to an hour" beats "fail in 15 s". Hangs are
+        retried under the same budget as errors: the timed-out probe is
+        reaped (its whole process group SIGKILLed and waited, see
+        _probe_device_count), so a fresh probe never queues behind a
+        zombie chip client, and a slice that comes up 20 minutes late
+        still gets its dial. Each probe's timeout is additionally capped
+        by the remaining budget so the last probe cannot overshoot it.
 
-    A timed-out probe is ABANDONED, never killed, and is NEVER retried
-    (in either regime — a fresh probe would just queue behind the
-    abandoned one's exclusive chip client and burn another timeout):
-    both observed tunnel wedges (round 3, and round 4's BERT ladder)
-    immediately followed a SIGKILL of a client mid-backend-handshake —
-    the remote terminal's libtpu client survives the local kill and
-    holds the chip, wedging every later dial for the rest of the
-    session. A slow-but-alive probe that eventually completes exits
-    harmlessly; an orphaned remote handshake never recovers. For the
-    same reason the timeout is long (4 min): it should only ever fire on
+    The probe timeout is long (4 min) on purpose: it should only fire on
     a truly dead tunnel, not on a bring-up that is merely slow under
     host CPU load.
 
@@ -539,8 +551,14 @@ def _init_backend(attempts: int = 3, probe_timeout_s: float = 240.0, *,
     attempt = 0
     while True:
         attempt += 1
+        timeout_s = probe_timeout_s
+        if wait_budget_s > 0:
+            # Never probe past the budget: the final probe gets whatever
+            # budget remains (floored so a sliver still gets a real try).
+            timeout_s = min(probe_timeout_s,
+                            max(30.0, wait_budget_s - (monotonic() - t0)))
         p0 = monotonic()
-        outcome, payload = probe(probe_timeout_s)
+        outcome, payload = probe(timeout_s)
         history.append({
             "attempt": attempt,
             "t": time.time(),
@@ -552,21 +570,30 @@ def _init_backend(attempts: int = 3, probe_timeout_s: float = 240.0, *,
             import jax
 
             return jax.device_count(), jax.devices()[0].device_kind
-        if outcome == "hang":
+        if outcome == "hang" and wait_budget_s <= 0:
             raise BenchBackendError(
-                f"backend probe still hung after {probe_timeout_s:.0f}s "
-                f"(left alive, pid {payload} — killing it can wedge "
-                f"the tunnel)", history)
-        print(f"bench: backend init attempt {attempt} failed ({payload})",
+                f"backend probe hung ({payload}); probe process group "
+                f"killed and reaped. The backend is wedged or still "
+                f"provisioning — set BENCH_WAIT=<minutes> to keep "
+                f"re-probing under a time budget instead of failing "
+                f"on the first hang", history)
+        print(f"bench: backend init attempt {attempt} "
+              f"{'hung' if outcome == 'hang' else 'failed'} ({payload})",
               file=sys.stderr)
         if wait_budget_s > 0:
             elapsed = monotonic() - t0
-            if elapsed + retry_interval_s > wait_budget_s:
+            # A hang already consumed its whole timeout waiting, so it
+            # re-probes after only a short settle delay (let the killed
+            # group's chip lease lapse); fast failures sleep out the
+            # full retry interval.
+            wait_s = (hang_retry_delay_s if outcome == "hang"
+                      else retry_interval_s)
+            if elapsed + wait_s > wait_budget_s:
                 raise BenchBackendError(
-                    f"backend init failed for {elapsed / 60:.1f} min "
+                    f"backend init {outcome} after {elapsed / 60:.1f} min "
                     f"({attempt} probes, BENCH_WAIT budget "
                     f"{wait_budget_s / 60:.0f} min): {payload}", history)
-            sleep(retry_interval_s)
+            sleep(wait_s)
         else:
             if attempt >= attempts:
                 raise BenchBackendError(str(payload), history)

@@ -1,5 +1,9 @@
 """Checkpoint/resume."""
 
+from distributed_tensorflow_framework_tpu.ckpt.async_saver import (  # noqa: F401
+    AsyncSaver,
+    AsyncSaverError,
+)
 from distributed_tensorflow_framework_tpu.ckpt.checkpoint import (  # noqa: F401
     CheckpointManager,
 )

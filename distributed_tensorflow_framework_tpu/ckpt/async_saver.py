@@ -1,0 +1,175 @@
+"""Background checkpoint commit thread — the async half of ckpt/.
+
+PR 2 made every save strictly more expensive (orbax write + per-file
+sha256 manifest + fsync + atomic commit) and ran all of it on the
+training thread while the device idled. The compiled step is HBM-bound
+at ~95% of peak (PERF_NOTES.md), so the host side of the loop is where
+wall-clock goes to die — and a checkpoint is the single largest host
+stall in steady state.
+
+This module holds the concurrency primitive that takes the save off the
+step loop: a single daemon worker thread that executes one *commit job*
+(orbax write → manifest hash → fsync → atomic commit, assembled by
+ckpt/checkpoint.py) at a time. The training thread pays only the
+device→host snapshot; everything durable happens here.
+
+Correctness barriers — the part that must not be clever:
+
+  * **one in flight, ever**: ``submit`` blocks until the previous commit
+    has fully landed (manifest written, fsync'd). Overlapping saves
+    cannot interleave their orbax step directories or commit manifests
+    out of order, and the manager's ``all_steps`` view stays accurate.
+  * **drain on exit**: ``wait`` blocks until the in-flight commit lands.
+    The trainer's exit paths (final save, SIGTERM graceful preemption →
+    rc 83) call it so a process never exits "successfully" with a torn
+    step directory on disk.
+  * **no silent failure**: an exception in the background job is stored
+    and re-raised on the *next* ``submit``/``wait``/``close`` — a failed
+    checkpoint must surface on the training thread, not vanish into a
+    daemon thread's stderr.
+
+Crash semantics are unchanged from the synchronous pipeline by
+construction: a SIGKILL at any point (including one injected by the
+``crash_in_save`` fault, which now fires *on this thread*) leaves either
+a fully committed step (manifest present) or an uncommitted directory
+(no manifest) that restore quarantines — there is no third state,
+because the manifest write itself is tmp+fsync+rename (ckpt/manifest.py).
+
+Stdlib-only: threading + time; the jax/orbax work lives in the closures
+ckpt/checkpoint.py submits.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+log = logging.getLogger(__name__)
+
+THREAD_NAME = "dtf-ckpt-saver"
+
+
+class AsyncSaverError(RuntimeError):
+    """A background commit failed; carries the step and original error."""
+
+    def __init__(self, step: int | None, cause: BaseException):
+        super().__init__(
+            f"background checkpoint save for step {step} failed: "
+            f"{type(cause).__name__}: {cause}"
+        )
+        self.step = step
+        self.__cause__ = cause
+
+
+class AsyncSaver:
+    """One background worker executing serialized checkpoint-commit jobs.
+
+    The thread is started lazily on the first ``submit`` and is a daemon:
+    a hard crash on the training thread must not hang process exit on a
+    half-finished write (the manifest layer makes that write read as
+    uncommitted — exactly the crash contract).
+    """
+
+    def __init__(self, *, name: str = THREAD_NAME):
+        self._name = name
+        self._cond = threading.Condition()
+        self._job: Callable[[], None] | None = None
+        self._job_step: int | None = None
+        self._busy = False
+        self._error: AsyncSaverError | None = None
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        # Observability counters (tests + telemetry sanity checks).
+        self.submitted = 0
+        self.completed = 0
+
+    # ------------------------------------------------------------ client --
+    def submit(self, job: Callable[[], None], *, step: int | None = None) -> float:
+        """Queue one commit job; returns seconds spent blocked waiting for
+        the previous commit to land (0.0 when the pipe was idle).
+
+        Serialization contract: at most one job queued-or-running. A
+        pending background failure is re-raised here instead of accepting
+        more work on top of a broken pipe.
+        """
+        t0 = time.perf_counter()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("AsyncSaver is closed")
+            self._wait_idle_locked()
+            self._raise_pending_locked()
+            self._ensure_thread_locked()
+            self._job, self._job_step = job, step
+            self.submitted += 1
+            self._cond.notify_all()
+        return time.perf_counter() - t0
+
+    def wait(self) -> None:
+        """Barrier: block until no commit is queued or in flight, then
+        re-raise any background failure. The exit/preemption flush."""
+        with self._cond:
+            self._wait_idle_locked()
+            self._raise_pending_locked()
+
+    @property
+    def idle(self) -> bool:
+        with self._cond:
+            return self._job is None and not self._busy
+
+    def close(self) -> None:
+        """Drain, surface any pending failure, and stop the worker."""
+        with self._cond:
+            self._wait_idle_locked()
+            self._closed = True
+            self._cond.notify_all()
+            error = self._error
+            self._error = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if error is not None:
+            raise error
+
+    # ------------------------------------------------------------ worker --
+    def _wait_idle_locked(self) -> None:
+        while self._job is not None or self._busy:
+            self._cond.wait()
+
+    def _raise_pending_locked(self) -> None:
+        if self._error is not None:
+            error, self._error = self._error, None
+            raise error
+
+    def _ensure_thread_locked(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._worker, name=self._name, daemon=True
+            )
+            self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while self._job is None and not self._closed:
+                    self._cond.wait()
+                if self._job is None and self._closed:
+                    return
+                job, step = self._job, self._job_step
+                self._job, self._job_step = None, None
+                self._busy = True
+            try:
+                job()
+            except BaseException as e:  # surface on the training thread
+                log.error("background checkpoint save for step %s failed",
+                          step, exc_info=True)
+                with self._cond:
+                    # Keep the FIRST failure if several pile up before a
+                    # barrier runs (the first is the root cause).
+                    if self._error is None:
+                        self._error = AsyncSaverError(step, e)
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self.completed += 1
+                    self._cond.notify_all()

@@ -5,8 +5,16 @@ Replaces the reference's ``tf.train.Saver`` under MonitoredTrainingSession
 files, latest-checkpoint auto-restore) with Orbax:
 
   * step-numbered directories + ``latest_step()`` resolution,
-  * async saves (device→host copy happens synchronously, disk write in the
-    background — the train loop doesn't stall),
+  * async save pipeline (``checkpoint.async_save``, docs/PERFORMANCE.md):
+    at a save step the training thread pays only a device→host snapshot
+    of the TrainState; a background saver thread (ckpt/async_saver.py)
+    then performs the orbax write, the manifest hashing, the fsync and
+    the atomic commit — the loop never stalls on disk. A new save waits
+    for the previous commit, and every exit path drains the in-flight
+    commit before the process returns (``wait_until_finished``).
+    ``async_save=false`` runs the identical commit sequence inline on
+    the training thread (the sync fallback — also the path multi-host
+    sharded saves use, since the snapshot is a full host copy).
   * saves MORE than the reference: params, BN stats, optimizer state, step,
     RNG key AND the data-iterator position, so resume is exact
     (SURVEY.md §7 hard part 3 — tested by tests/test_ckpt.py).
@@ -21,14 +29,24 @@ and ``all_steps`` only report manifested steps, restore re-hashes before
 reading, and a torn/corrupt step is quarantined (renamed ``<step>.corrupt``)
 with automatic fallback to the newest verified older step — a SIGKILL
 racing a save can cost at most one checkpoint interval, never the run.
-Quarantine/rename decisions are chief-only; non-chief processes follow the
-shared filesystem state.
+The async pipeline preserves that contract bit-for-bit: the commit
+sequence is the same code, merely executed on the saver thread, so a kill
+at any point still leaves either a manifested step or an uncommitted
+directory restore refuses. Quarantine/rename decisions are chief-only;
+non-chief processes follow the shared filesystem state.
+
+Per-save telemetry (``ckpt_save`` events): ``ckpt_save_blocked_ms`` is the
+wall time the TRAINING thread spent inside ``save`` (wait-for-previous +
+snapshot); ``ckpt_save_total_ms`` is submit→commit-landed. Async saves
+show blocked ≪ total; the sync fallback shows blocked == total.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import time
 from typing import Any
 
 import jax
@@ -36,6 +54,7 @@ import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
 from distributed_tensorflow_framework_tpu.ckpt import manifest as mf
+from distributed_tensorflow_framework_tpu.ckpt.async_saver import AsyncSaver
 from distributed_tensorflow_framework_tpu.core import faults, telemetry
 from distributed_tensorflow_framework_tpu.core.config import CheckpointConfig
 from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
@@ -92,65 +111,106 @@ class CheckpointManager:
             path,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=config.max_to_keep,
-                enable_async_checkpointing=config.async_save,
+                # Orbax's own async layer stays OFF either way: asynchrony
+                # is owned by ckpt/async_saver.py, whose worker runs the
+                # WHOLE commit sequence (orbax write + manifest + fsync)
+                # so the integrity manifest always hashes a finished
+                # directory — no deferred-manifest bookkeeping.
+                enable_async_checkpointing=False,
             ),
         )
-        # Steps saved by THIS process whose manifest is still owed (async
-        # saves commit in the background; the manifest can only hash a
-        # finished directory).
-        self._pending_manifest: set[int] = set()
+        self._saver = AsyncSaver() if config.async_save else None
 
     def _emit(self, kind: str, **fields: Any) -> None:
         if self._telemetry is not None:
             self._telemetry.emit(kind, **fields)
 
     # ----------------------------------------------------- commit records --
-    def _finalize_manifests(self) -> None:
-        """Write the integrity manifest for every save that has committed.
+    def _drain(self) -> None:
+        """Barrier on the in-flight background commit (no-op when sync or
+        idle). Every read of the step listing and every new save funnels
+        through here, so directory views are never taken mid-commit and a
+        background failure surfaces on the training thread."""
+        if self._saver is not None:
+            self._saver.wait()
 
-        Waiting first is free in steady state (Orbax's next save waits for
-        the previous async commit anyway); afterwards each pending step
-        directory either exists (hash + commit its manifest) or was GC'd
-        by max_to_keep (drop it).
-        """
-        if not self._pending_manifest:
-            return
-        if not self.is_chief:
-            self._pending_manifest.clear()
-            return
-        self._mgr.wait_until_finished()
-        for step in sorted(self._pending_manifest):
-            step_dir = os.path.join(self._path, str(step))
-            if os.path.isdir(step_dir) and mf.read_manifest(step_dir) is None:
-                # A crash_in_save fault here leaves a committed directory
-                # with NO manifest — exactly the torn-"latest" artifact the
-                # restore path must refuse (docs/RESILIENCE.md drill).
-                faults.fire("ckpt_in_save", step=step)
-                mf.write_manifest(step_dir, step)
-                for fault in faults.fire("ckpt_committed", step=step):
-                    if fault.kind == "corrupt_ckpt":
-                        faults.corrupt_checkpoint_dir(step_dir)
-        self._pending_manifest.clear()
+    def _write_and_commit(self, step: int, packed_state: Any,
+                          dataset_state: dict | None, *, force: bool,
+                          t_begin: float, blocked_s: float | None) -> bool:
+        """The full durable commit sequence — orbax write, fault points,
+        manifest hash + fsync + atomic rename, telemetry. Runs on the
+        saver thread (async) or inline (sync fallback); identical either
+        way, which is what keeps the crash/quarantine drills bit-exact
+        across the ``async_save`` knob."""
+        args = {"state": ocp.args.StandardSave(packed_state)}
+        if dataset_state is not None:
+            args["data_iter"] = ocp.args.JsonSave(dataset_state)
+        saved = self._mgr.save(step, args=ocp.args.Composite(**args),
+                               force=force)
+        if not saved:
+            return False
+        step_dir = os.path.join(self._path, str(step))
+        if self.is_chief and os.path.isdir(step_dir) \
+                and mf.read_manifest(step_dir) is None:
+            # A crash_in_save fault here leaves a written directory with
+            # NO manifest — exactly the torn-"latest" artifact the restore
+            # path must refuse (docs/RESILIENCE.md drill). In async mode
+            # it fires on the saver thread (SIGKILL still takes the whole
+            # process — core/faults.py).
+            faults.fire("ckpt_in_save", step=step)
+            mf.write_manifest(step_dir, step)
+            for fault in faults.fire("ckpt_committed", step=step):
+                if fault.kind == "corrupt_ckpt":
+                    faults.corrupt_checkpoint_dir(step_dir)
+        total_ms = (time.perf_counter() - t_begin) * 1e3
+        blocked_ms = total_ms if blocked_s is None else blocked_s * 1e3
+        self._emit(
+            telemetry.KIND_CKPT_SAVE, step=step,
+            metrics={"ckpt_save_blocked_ms": round(blocked_ms, 3),
+                     "ckpt_save_total_ms": round(total_ms, 3)},
+            async_save=self._saver is not None,
+        )
+        if self.is_chief:
+            log.info("Saved checkpoint at step %d (%s, blocked %.0f ms / "
+                     "total %.0f ms)", step,
+                     "async" if self._saver is not None else "sync",
+                     blocked_ms, total_ms)
+        return saved
 
     def save(self, step: int, state: TrainState, *,
              dataset_state: dict | None = None, force: bool = False) -> bool:
         """``dataset_state`` must be the iterator snapshot aligned with
         ``step`` (see data/infeed.py) — NOT the live dataset's state, which
-        the prefetcher has advanced past the training step."""
-        self._finalize_manifests()
+        the prefetcher has advanced past the training step.
+
+        Async mode returns as soon as the snapshot is queued; the True
+        return means "accepted for commit", and any commit failure is
+        re-raised at the next save/barrier (ckpt/async_saver.py)."""
+        t0 = time.perf_counter()
+        self._drain()  # a new save waits for the previous commit
         if step in self._mgr.all_steps():
             return False  # already saved (e.g. final save on an interval step)
-        args = {"state": ocp.args.StandardSave(_pack(state))}
-        if dataset_state is not None:
-            args["data_iter"] = ocp.args.JsonSave(dataset_state)
-        saved = self._mgr.save(step, args=ocp.args.Composite(**args), force=force)
-        if saved:
-            self._pending_manifest.add(step)
-            if not self.config.async_save:
-                self._finalize_manifests()
-            if self.is_chief:
-                log.info("Saved checkpoint at step %d", step)
-        return saved
+        if self._saver is None:
+            return self._write_and_commit(
+                step, _pack(state), dataset_state, force=force,
+                t_begin=t0, blocked_s=None)
+        # Async: the training thread pays only the device→host snapshot.
+        # device_get also syncs on the step that produced `state`, so the
+        # snapshot is taken at a well-defined step boundary; the loop may
+        # donate/overwrite the device buffers freely afterwards.
+        host_state = jax.device_get(_pack(state))
+        # The iterator snapshot is a small JSON-able dict the trainer
+        # rebinds each step; deep-copy via JSON so a hook mutating its
+        # live dict can never tear the queued snapshot.
+        ds_state = (None if dataset_state is None
+                    else json.loads(json.dumps(dataset_state)))
+        blocked_s = time.perf_counter() - t0
+        self._saver.submit(
+            lambda: self._write_and_commit(
+                step, host_state, ds_state, force=force,
+                t_begin=t0, blocked_s=blocked_s),
+            step=step)
+        return True
 
     def latest_step(self) -> int | None:
         steps = self.all_steps()
@@ -166,7 +226,7 @@ class CheckpointManager:
         predates the integrity layer; its steps are trusted as-is (with a
         warning) rather than bricking every pre-manifest run.
         """
-        self._finalize_manifests()
+        self._drain()
         orbax_steps = sorted(self._mgr.all_steps())
         committed = set(mf.committed_steps(self._path))
         if not committed and orbax_steps:
@@ -288,7 +348,7 @@ class CheckpointManager:
         """Newest step that passes verification, quarantining every newer
         step that does not — the automatic-fallback half of the integrity
         contract. Returns None when no restorable checkpoint remains."""
-        self._finalize_manifests()
+        self._drain()
         candidates = sorted(self._mgr.all_steps(), reverse=True)
         if not candidates:
             return None
@@ -407,9 +467,20 @@ class CheckpointManager:
         )
 
     def wait_until_finished(self) -> None:
+        """The exit/preemption barrier: returns only once every accepted
+        save has durably committed (manifest written + fsync'd). Called by
+        CheckpointHook.on_end so normal completion AND the SIGTERM
+        graceful-preempt path (rc 83) never exit with a commit in flight."""
+        self._drain()
         self._mgr.wait_until_finished()
-        self._finalize_manifests()
 
     def close(self) -> None:
-        self._finalize_manifests()
-        self._mgr.close()
+        try:
+            self._drain()
+        finally:
+            if self._saver is not None:
+                try:
+                    self._saver.close()
+                except Exception:
+                    log.warning("async saver close failed", exc_info=True)
+            self._mgr.close()

@@ -31,6 +31,15 @@ needs file-level integrity.
 Everything here is stdlib-only on purpose: the supervisor
 (scripts/train_resilient.py) uses ``latest_committed_step`` to measure
 checkpoint progress between relaunches without touching JAX or Orbax.
+
+Threading note (async pipeline, ckpt/async_saver.py): with
+``checkpoint.async_save`` on, ``write_manifest`` runs on the background
+saver thread, immediately after the orbax write for that step finishes
+on the same thread. Nothing here is shared mutable state — every function
+is a pure function of the directory passed in — and the manager
+serializes commits (one in flight, ever), so directory-level views
+(``step_dirs``, ``committed_steps``) stay race-free as long as readers go
+through the manager's drain barrier first.
 """
 
 from __future__ import annotations

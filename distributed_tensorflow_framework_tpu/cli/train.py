@@ -81,6 +81,24 @@ def main(argv=None) -> int:
 
         print(yaml.safe_dump(config.to_dict(), sort_keys=False))
         return 0
+    if config.train.compilation_cache_dir:
+        # Before the Trainer touches a backend: cached executables from the
+        # previous attempt turn the relaunch recompile into a disk read
+        # (the startup telemetry event shows the delta).
+        from distributed_tensorflow_framework_tpu.core.platform import (
+            enable_compilation_cache,
+        )
+
+        if enable_compilation_cache(config.train.compilation_cache_dir):
+            logging.getLogger(__name__).info(
+                "persistent XLA compilation cache: %s",
+                config.train.compilation_cache_dir,
+            )
+        else:
+            logging.getLogger(__name__).warning(
+                "this jax build lacks the persistent compilation cache — "
+                "continuing uncached"
+            )
     from distributed_tensorflow_framework_tpu.train import Trainer
 
     trainer = Trainer(config)
@@ -93,6 +111,9 @@ def main(argv=None) -> int:
     # finish its in-flight step and save a checkpoint, then the process
     # exits GRACEFUL_PREEMPT_RC — the supervisor relaunches immediately
     # without consuming an attempt. A second SIGTERM kills outright.
+    # trainer.train() only returns after the checkpoint manager's exit
+    # barrier, so with async_save on the rc-83 exit below can never race
+    # an in-flight background commit.
     supervision.install_sigterm_handler()
     final = trainer.train()
     if trainer.preempted:

@@ -262,6 +262,13 @@ class CheckpointConfig:
     directory: str = ""
     save_interval_steps: int = 1000
     max_to_keep: int = 3
+    # Commit checkpoints (orbax write + manifest hash + fsync) on a
+    # background saver thread (ckpt/async_saver.py): the step loop pays
+    # only a device→host snapshot of the train state. False = fully
+    # synchronous save on the training thread — required for multi-host
+    # sharded state (the snapshot path assumes fully-addressable arrays)
+    # and useful when debugging save failures (clean stacks). Either way
+    # the manifest commit record and crash semantics are identical.
     async_save: bool = True
     restore: bool = True  # auto-restore latest on startup (MonitoredTrainingSession contract)
     # Re-hash every file against the step's integrity manifest before
@@ -334,6 +341,15 @@ class TrainConfig:
     # 0/0 disables (SURVEY.md §5 tracing).
     profile_start: int = 0
     profile_stop: int = 0
+    # Persistent XLA compilation cache directory ("" = off). Shrinks the
+    # relaunch → first-step latency a supervisor pays on every preemption
+    # (the KIND_STARTUP telemetry event measures it). Default OFF: on the
+    # CPU test backend, reloading cached executables that embed pallas
+    # interpret-mode host callbacks SIGABRTs (stale callback pointers —
+    # see pytest.ini); safe on real TPU backends and for XLA-attention
+    # configs. Applied by cli/train.py via platform.enable_compilation_cache
+    # BEFORE the first backend use.
+    compilation_cache_dir: str = ""
 
 
 @config_dataclass

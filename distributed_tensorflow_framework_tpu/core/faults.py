@@ -36,6 +36,16 @@ file, firings are also recorded there (before executing — a crash fault
 must not re-fire on relaunch) so a supervised kill → relaunch → resume
 drill injects each fault exactly once across the whole run.
 
+Thread model: fault points are not confined to the main thread — with the
+async checkpoint pipeline (``checkpoint.async_save``, ckpt/async_saver.py)
+the ``ckpt_in_save``/``ckpt_committed`` points fire on the background
+saver thread, and ``infeed`` fires on the async-infeed producer thread.
+``fire`` is therefore serialized by a process-wide lock (matching,
+recording and executing are atomic — two threads can never double-fire
+one fault), the diagnostic names the firing thread, and the crash kinds
+use ``os.kill(SIGKILL)``, which takes down the whole process regardless
+of which thread calls it — exactly the semantics the drills need.
+
 Stdlib-only by design: the module is imported by the data pipeline and the
 supervisor, and an inactive plan (the default) costs one set lookup per
 fault point.
@@ -48,6 +58,7 @@ import logging
 import os
 import signal
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -191,21 +202,35 @@ class FaultPlan:
     def fire(self, point: str, *, step: int | None = None) -> list[Fault]:
         """Execute self-contained faults matching this point (crash, stall)
         and return the caller-handled ones (nan_grads, corrupt_ckpt) so the
-        call site applies them with its own context."""
+        call site applies them with its own context. Thread-safe: the
+        match→record→execute sequence runs under the plan lock, so the
+        background saver thread and the training thread can never both
+        claim the same fault."""
+        matched: list[Fault] = []
+        with _FIRE_LOCK:  # match + record atomically; execute after release
+            for fault in self.faults:
+                if not fault.matches(point, step):
+                    continue
+                self._record_fired(fault)
+                matched.append(fault)
         handled: list[Fault] = []
-        for fault in self.faults:
-            if not fault.matches(point, step):
-                continue
-            self._record_fired(fault)
+        for fault in matched:
             print(
                 f"DTF_FAULTS: firing {fault.fault_id} at point "
-                f"{point!r} (step={step})",
+                f"{point!r} (step={step}, "
+                f"thread={threading.current_thread().name})",
                 file=sys.stderr, flush=True,
             )
             if fault.kind in ("crash_at_step", "crash_in_save"):
+                # SIGKILL the PROCESS (not the thread): fired from the
+                # async saver thread this still models a machine-level
+                # kill racing the commit sequence.
                 os.kill(os.getpid(), signal.SIGKILL)
                 os._exit(137)  # unreachable on POSIX; belt-and-braces
             elif fault.kind == "stall_infeed":
+                # The long sleep happens OUTSIDE the lock: a stalled
+                # infeed thread must not also wedge every other thread's
+                # fault points.
                 time.sleep(fault.seconds or 0.0)
             else:
                 handled.append(fault)
@@ -213,6 +238,9 @@ class FaultPlan:
 
 
 # -- process-wide plan ----------------------------------------------------
+# Serializes fire() across threads (training loop, async checkpoint saver,
+# async infeed producer) — see the thread-model note in the module docs.
+_FIRE_LOCK = threading.Lock()
 _plan: FaultPlan | None = None
 
 

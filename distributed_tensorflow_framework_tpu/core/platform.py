@@ -92,6 +92,49 @@ def _xla_binary_flag_blob():
     return _XLA_BINARY_BLOB
 
 
+def enable_compilation_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir`` so a
+    relaunched process reuses the previous run's compiled executables
+    instead of re-lowering + re-compiling the train step — the dominant
+    share of restart → first-step latency (the ``startup`` telemetry
+    event measures it; docs/PERFORMANCE.md has numbers).
+
+    Must run before the first backend use (jax.config updates after
+    compilation has started don't retroactively cache). Returns True when
+    the cache was enabled, False when this jax build lacks the knobs (old
+    releases) — callers log and continue uncached rather than fail.
+
+    CAVEAT (why the config knob defaults off): executables that embed
+    host callbacks — pallas INTERPRET-mode kernels on the CPU backend —
+    SIGABRT when reloaded from cache in a fresh process (the serialized
+    executable holds dead callback pointers; see pytest.ini). Real TPU
+    backends compile pallas to Mosaic, which caches fine.
+    """
+    if not cache_dir:
+        return False
+    import os as _os
+
+    import jax
+
+    _os.makedirs(cache_dir, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except AttributeError:
+        return False
+    # Cache everything, immediately: the defaults skip "fast" compiles
+    # (min time 1 s) and small programs, which on the CPU test backend is
+    # most of them — useless for measuring the restart win.
+    for knob, value in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except AttributeError:
+            pass  # older jax: keep its defaults
+    return True
+
+
 def with_cpu_collective_timeouts(flags: str, table=None) -> str:
     """Append rendezvous-timeout flags to an XLA_FLAGS string, skipping
     any flag the caller already set and any flag this jaxlib's XLA does

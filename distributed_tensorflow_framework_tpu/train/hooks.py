@@ -141,6 +141,14 @@ class LoggingHook(BaseHook):
 
 
 class CheckpointHook(BaseHook):
+    """Interval saver. With ``checkpoint.async_save`` on, ``save`` returns
+    after the device→host snapshot and the commit (orbax write + manifest
+    + fsync) lands on the background saver thread — the step loop is not
+    blocked for the write. ``on_end`` is the flush path: the final
+    force-save plus ``wait_until_finished`` block until every in-flight
+    commit is durable, so both normal completion and SIGTERM graceful
+    preemption (rc 83) exit with nothing half-written."""
+
     def __init__(self, manager, interval: int):
         self.manager = manager
         self.interval = max(1, interval)

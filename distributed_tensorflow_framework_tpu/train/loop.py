@@ -19,6 +19,7 @@ import collections
 import logging
 import os
 import sys
+import time
 from typing import Any
 
 import jax
@@ -52,6 +53,12 @@ def _poison_batch(batch: dict) -> dict:
 class Trainer:
     def __init__(self, config: ExperimentConfig, runtime: MeshRuntime | None = None):
         setup_logging()
+        # Startup-latency clock: construction → first completed step covers
+        # restore + input build + compile, the relaunch cost a supervisor
+        # pays on every preemption (emitted as a KIND_STARTUP event).
+        self._init_t = time.perf_counter()
+        self._startup_emitted = False
+        self._restored_step: int | None = None
         self.config = config
         self.runtime = runtime or initialize_runtime(config.mesh)
         self.mesh = self.runtime.mesh
@@ -165,6 +172,7 @@ class Trainer:
                 if restored is not None:
                     self.state = restored
                     self.host_step = int(jax.device_get(self.state.step))
+                    self._restored_step = self.host_step
                     log.info("Restored checkpoint at step %d", self.host_step)
 
     def default_hooks(self) -> list:
@@ -300,6 +308,19 @@ class Trainer:
                 if cfg.dispatch_ahead > 0:
                     pending.append(metrics)
                 self.host_step += 1
+                if not self._startup_emitted:
+                    # Restart → first-step latency (restore + input build +
+                    # compile): the number the persistent XLA compilation
+                    # cache (core/platform.py) exists to shrink.
+                    self._startup_emitted = True
+                    self.writer.telemetry.emit(
+                        telemetry.KIND_STARTUP, step=self.host_step,
+                        time_to_first_step_s=(
+                            time.perf_counter() - self._init_t),
+                        restored_step=self._restored_step,
+                        compilation_cache_dir=(
+                            self.config.train.compilation_cache_dir or None),
+                    )
                 fetch = (
                     self.host_step % cfg.log_interval == 0
                     or self.host_step >= cfg.total_steps
@@ -326,6 +347,12 @@ class Trainer:
             infeed.close()
         for h in hooks:
             h.on_end(self)
+        if self._ckpt_manager is not None:
+            # Exit/preemption barrier for the async checkpoint pipeline:
+            # CheckpointHook.on_end already flushes, but custom hook lists
+            # may not include it — never return (and never let the CLI exit
+            # rc 83) with a commit still in flight on the saver thread.
+            self._ckpt_manager.wait_until_finished()
         return last_metrics
 
     # ---------------------------------------------------------------- eval --

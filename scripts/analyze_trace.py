@@ -18,10 +18,13 @@ auto-discovered when not given; without it, scope-based categories
 (optimizer_update) fall back to other_compute.
 
 Given an ``events.jsonl`` (or a run directory containing one), the tool
-instead prints the run summary: event counts, step span and recovery
+instead prints the run summary: event counts, step span, recovery
 activity — quarantined checkpoints, restore fallbacks, supervisor
-attempts, graceful preemptions (docs/RESILIENCE.md). Supervisor events
-(``supervisor_events.jsonl`` next to it) are summarized too when present.
+attempts, graceful preemptions (docs/RESILIENCE.md) — plus the
+checkpoint save-stall accounting (loop-blocked vs total save time under
+``checkpoint.async_save``) and restart→first-step startup latency
+(docs/PERFORMANCE.md). Supervisor events (``supervisor_events.jsonl``
+next to it) are summarized too when present.
 """
 
 import argparse

@@ -1,0 +1,283 @@
+"""Async checkpoint pipeline: serialization, barriers, telemetry, crashes.
+
+The perf contract (docs/PERFORMANCE.md): with ``checkpoint.async_save``
+on, a save step costs the training thread only a device→host snapshot —
+the orbax write + manifest commit happen on the background saver thread
+— while the integrity contract of docs/RESILIENCE.md (manifest = commit
+record; no manifest = uncommitted = quarantined) is preserved bit-for-bit.
+
+Layered: pure AsyncSaver threading tests (tier-1, no jax), the tier-1
+telemetry guard (``ckpt_save_blocked_ms`` emitted and < total under async
+mode), and the slow end-to-end drills (bit-exact async resume; SIGKILL
+injected ON the saver thread via the supervised crash_in_save drill).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from distributed_tensorflow_framework_tpu.ckpt import manifest as mf
+from distributed_tensorflow_framework_tpu.ckpt.async_saver import (
+    AsyncSaver,
+    AsyncSaverError,
+)
+from distributed_tensorflow_framework_tpu.core import telemetry
+
+
+# ----------------------------------------------------- AsyncSaver (pure) --
+
+def test_overlapping_saves_serialize():
+    """submit() must block until the previous commit landed: at most one
+    job queued-or-running, executed in submission order."""
+    saver = AsyncSaver()
+    running = threading.Event()
+    release = threading.Event()
+    order = []
+
+    def slow_job():
+        running.set()
+        assert release.wait(timeout=10)
+        order.append("first")
+
+    blocked_1 = saver.submit(slow_job, step=1)
+    assert blocked_1 < 1.0  # pipe was idle — no wait
+    assert running.wait(timeout=10)
+
+    t0 = time.perf_counter()
+    release_timer = threading.Timer(0.2, release.set)
+    release_timer.start()
+    try:
+        blocked_2 = saver.submit(lambda: order.append("second"), step=2)
+    finally:
+        release_timer.cancel()
+    # The second submit waited for the first commit to finish.
+    assert time.perf_counter() - t0 >= 0.15
+    assert blocked_2 >= 0.15
+    assert order[0] == "first"
+    saver.wait()
+    assert order == ["first", "second"]
+    assert saver.submitted == 2 and saver.completed == 2
+    assert saver.idle
+    saver.close()
+
+
+def test_wait_is_a_barrier():
+    saver = AsyncSaver()
+    done = []
+    saver.submit(lambda: (time.sleep(0.1), done.append(1)))
+    saver.wait()
+    assert done == [1]
+    saver.close()
+
+
+def test_background_error_surfaces_on_training_thread():
+    """A failed background commit must re-raise at the next submit/wait,
+    carrying the step and the original cause — never vanish into the
+    daemon thread's stderr."""
+    saver = AsyncSaver()
+
+    def boom():
+        raise OSError("disk full")
+
+    saver.submit(boom, step=7)
+    with pytest.raises(AsyncSaverError) as exc:
+        saver.wait()
+    assert exc.value.step == 7
+    assert isinstance(exc.value.__cause__, OSError)
+    assert "disk full" in str(exc.value)
+    # The error was consumed: the pipe is usable again.
+    saver.submit(lambda: None, step=8)
+    saver.wait()
+    saver.close()
+
+
+def test_close_drains_and_raises_pending_error():
+    saver = AsyncSaver()
+    done = []
+    saver.submit(lambda: done.append(1))
+    saver.close()
+    assert done == [1]
+    with pytest.raises(RuntimeError, match="closed"):
+        saver.submit(lambda: None)
+
+    saver2 = AsyncSaver()
+    saver2.submit(lambda: (_ for _ in ()).throw(ValueError("late")), step=3)
+    # Give the worker a moment so the error is pending (not in-flight)
+    # when close() runs its drain.
+    deadline = time.monotonic() + 10
+    while not saver2.idle and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(AsyncSaverError):
+        saver2.close()
+
+
+# ------------------------------------------- tier-1 telemetry guard (e2e) --
+
+def _train_async(ckpt_dir, total_steps=6, save_interval=3, **overrides):
+    from distributed_tensorflow_framework_tpu.train import Trainer
+    from tests.test_train_lenet import lenet_config
+
+    cfg = lenet_config(**{"train.total_steps": total_steps,
+                          "train.log_interval": 3, **overrides})
+    cfg.checkpoint.directory = ckpt_dir
+    cfg.checkpoint.save_interval_steps = save_interval
+    cfg.checkpoint.async_save = True
+    t = Trainer(cfg)
+    t.train()
+    return t
+
+
+def test_async_save_emits_blocked_below_total(devices, tmp_path):
+    """The acceptance guard: under async_save the run's telemetry carries
+    a ``ckpt_save`` event per save whose loop-blocked time is strictly
+    below the total save time (blocked is a proper prefix of total by
+    construction: total is measured from save() entry through the
+    background commit, blocked stops at submit)."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    t = _train_async(ckpt_dir)
+    assert sorted(t._ckpt_manager.all_steps()) == [3, 6]
+
+    events = list(telemetry.read_events(
+        os.path.join(ckpt_dir, "events.jsonl"),
+        kind=telemetry.KIND_CKPT_SAVE, strict=True))
+    assert {e["step"] for e in events} == {3, 6}
+    for e in events:
+        assert e["extra"]["async_save"] is True
+        blocked = e["metrics"]["ckpt_save_blocked_ms"]
+        total = e["metrics"]["ckpt_save_total_ms"]
+        assert blocked < total, (blocked, total)
+        assert blocked >= 0.0
+
+    # ...and the run summary surfaces the save-stall accounting.
+    summary = telemetry.summarize_events(os.path.join(ckpt_dir, "events.jsonl"))
+    saves = summary["ckpt_saves"]
+    assert saves["count"] == 2 and saves["async_count"] == 2
+    assert saves["blocked_ms_total"] < saves["total_ms_total"]
+    text = telemetry.format_run_summary(summary)
+    assert "checkpoint saves: 2 (2 async)" in text
+    # startup telemetry (restart → first step) rides the same stream
+    assert summary["startups"] and \
+        summary["startups"][0]["time_to_first_step_s"] > 0
+
+
+def test_exit_barrier_flushes_inflight_commit(devices, tmp_path):
+    """train() must not return with a commit still in flight: every saved
+    step carries its manifest by the time the loop hands back control —
+    the property the rc-83 graceful-preemption exit relies on."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    t = _train_async(ckpt_dir)
+    for step in (3, 6):
+        step_dir = os.path.join(ckpt_dir, str(step))
+        manifest = mf.read_manifest(step_dir)
+        assert manifest is not None, f"step {step} uncommitted after train()"
+        assert mf.verify_step_dir(step_dir, manifest) == []
+    assert t._ckpt_manager._saver is not None and t._ckpt_manager._saver.idle
+
+    # An explicit follow-up save + barrier also lands durably.
+    t._ckpt_manager.save(99, t.state, dataset_state=t.data_ckpt_state,
+                         force=True)
+    t._ckpt_manager.wait_until_finished()
+    assert mf.read_manifest(os.path.join(ckpt_dir, "99")) is not None
+    t._ckpt_manager.close()
+
+
+def test_queued_dataset_state_is_snapshotted(devices, tmp_path):
+    """Mutating the live iterator-state dict after save() returns must not
+    tear the queued snapshot (the async path deep-copies it)."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    t = _train_async(ckpt_dir)
+    ds_state = dict(t.data_ckpt_state)
+    t._ckpt_manager.save(50, t.state, dataset_state=ds_state, force=True)
+    ds_state.clear()  # trainer reuses/mutates its dict freely
+    t._ckpt_manager.wait_until_finished()
+    saved = json.load(open(os.path.join(
+        ckpt_dir, "50", "data_iter", "metadata")))
+    assert saved, "queued dataset snapshot was torn by the mutation"
+    t._ckpt_manager.close()
+
+
+# ------------------------------------------------------- slow e2e drills --
+
+@pytest.mark.slow
+def test_async_resume_exactness(devices, tmp_path):
+    """Bit-exact resume with async_save on: params after restore + K more
+    steps equal an uninterrupted run's — the PR 2 contract must survive
+    moving the commit to the saver thread."""
+    import jax
+    import numpy as np
+
+    from distributed_tensorflow_framework_tpu.train import Trainer
+    from tests.test_train_lenet import lenet_config
+
+    cfg = lenet_config(**{"train.total_steps": 8, "train.log_interval": 4})
+    t_full = Trainer(cfg)
+    t_full.train()
+    full_params = jax.device_get(t_full.state.params)
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    _train_async(ckpt_dir, total_steps=4, save_interval=4,
+                 **{"train.log_interval": 4})
+
+    cfg_b = lenet_config(**{"train.total_steps": 8, "train.log_interval": 4})
+    cfg_b.checkpoint.directory = ckpt_dir
+    cfg_b.checkpoint.save_interval_steps = 100
+    cfg_b.checkpoint.async_save = True
+    t_b = Trainer(cfg_b)
+    t_b.build()
+    assert t_b.host_step == 4, "restore did not pick up the async-saved step"
+    t_b.train()
+    resumed = jax.device_get(t_b.state.params)
+    for a, b in zip(jax.tree.leaves(full_params), jax.tree.leaves(resumed)):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+@pytest.mark.slowest
+def test_supervised_crash_in_save_drill_async(tmp_path):
+    """The sync drill's acceptance twin with async_save=true: the SIGKILL
+    fires ON the background saver thread (between orbax data and manifest
+    commit), takes the whole process, the relaunch quarantines the
+    uncommitted step-40 directory, and the final loss is BIT-EXACT
+    against an uninterrupted async run of the same seed."""
+    from tests.test_fault_tolerance import DRIVER, _child_env, _final_loss
+    import subprocess
+    import sys
+
+    driver_async = DRIVER.replace("checkpoint.async_save=false",
+                                  "checkpoint.async_save=true")
+    assert "async_save=true" in driver_async  # template still has the knob
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    ref_dir = str(tmp_path / "ref")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    ref = subprocess.run(
+        [sys.executable, "-c", driver_async.format(ckpt=ref_dir, steps=60)],
+        env=_child_env(), cwd=repo_root, capture_output=True, text=True,
+        timeout=420)
+    assert ref.returncode == 0, ref.stdout[-3000:] + ref.stderr[-2000:]
+
+    cmd = [sys.executable, "scripts/train_resilient.py",
+           "--max-attempts", "3", "--retry-sleep", "0.2", "--jitter", "0",
+           "--", sys.executable, "-c",
+           driver_async.format(ckpt=ckpt_dir, steps=60)]
+    r = subprocess.run(
+        cmd, cwd=repo_root, capture_output=True, text=True, timeout=560,
+        env=_child_env({
+            "DTF_FAULTS": "crash_in_save:40",
+            "DTF_FAULTS_STATE": str(tmp_path / "faults_state.json"),
+        }))
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "firing crash_in_save:40" in r.stderr, r.stderr[-3000:]
+    # The kill fired on the background saver thread, not the train loop.
+    assert "thread=dtf-ckpt-saver" in r.stderr, r.stderr[-3000:]
+    assert "exited rc=137" in r.stderr
+    assert "done (attempt 2)" in r.stderr
+    quarantined = [d for d in os.listdir(ckpt_dir)
+                   if d.startswith("40" + mf.CORRUPT_SUFFIX)]
+    assert quarantined, os.listdir(ckpt_dir)
+    assert os.path.isdir(os.path.join(ckpt_dir, "40"))  # the re-save
+    assert _final_loss(ckpt_dir, 60) == _final_loss(ref_dir, 60)

@@ -1,8 +1,9 @@
 """bench.py backend bring-up: the BENCH_WAIT bounded retry budget.
 
 All probe/sleep/clock effects are injected, so these pin the retry POLICY
-— legacy fast-fail, budgeted 5-minute re-probing, and the hang-is-final
-rule (VERDICT item 2) — without touching any backend or real time.
+— legacy fast-fail, budgeted 5-minute re-probing, and the hang rules
+(final + actionable without a budget; reaped and re-probed under one) —
+without touching any backend or real time.
 """
 
 import importlib.util
@@ -56,16 +57,66 @@ def test_bench_wait_budget_probes_every_interval():
     assert all(r["error"] == "tunnel down" for r in history)
 
 
-def test_hang_is_final_even_with_budget():
+def test_hang_without_budget_is_final_and_actionable():
     state, monotonic, sleep = _fake_clock()
     with pytest.raises(bench.BenchBackendError) as exc:
         bench._init_backend(
-            probe=lambda t: ("hang", 4242),
-            sleep=sleep, monotonic=monotonic, wait_budget_s=60 * 60)
+            probe=lambda t: ("hang", "probe exceeded 240s (pid 4242 reaped)"),
+            sleep=sleep, monotonic=monotonic, wait_budget_s=0)
     history = exc.value.probe_history
     assert len(history) == 1 and history[0]["outcome"] == "hang"
-    assert state["t"] == 0  # no retry sleep: the chip client is exclusive
-    assert "4242" in str(exc.value) and "wedge" in str(exc.value)
+    assert state["t"] == 0  # no blind retry without a time budget
+    # The error must be actionable: it names the knob that arms retries.
+    assert "4242" in str(exc.value) and "BENCH_WAIT" in str(exc.value)
+
+
+def test_hang_is_retried_under_budget(devices):
+    state, monotonic, sleep = _fake_clock()
+    calls = {"n": 0}
+
+    def wedged_then_ok(timeout_s):
+        calls["n"] += 1
+        return ("ok", None) if calls["n"] >= 3 else ("hang", "reaped")
+
+    n, kind = bench._init_backend(
+        probe=wedged_then_ok, sleep=sleep, monotonic=monotonic,
+        wait_budget_s=60 * 60, hang_retry_delay_s=15)
+    assert calls["n"] == 3
+    assert state["t"] == 30  # two short settle delays, no 5-min waits
+    assert n == len(devices)
+
+
+def test_hang_budget_exhausted_raises_with_history():
+    state, monotonic, sleep = _fake_clock()
+    with pytest.raises(bench.BenchBackendError) as exc:
+        bench._init_backend(
+            probe=lambda t: ("hang", "reaped"),
+            sleep=sleep, monotonic=monotonic,
+            wait_budget_s=60, hang_retry_delay_s=15)
+    history = exc.value.probe_history
+    # Probes at t=0,15,30,45,60: re-probed until the budget ran out.
+    assert len(history) == 5
+    assert all(r["outcome"] == "hang" for r in history)
+    assert "BENCH_WAIT" in str(exc.value)
+
+
+def test_probe_timeout_capped_by_remaining_budget():
+    state, monotonic, sleep = _fake_clock()
+    timeouts = []
+
+    def hang(timeout_s):
+        timeouts.append(timeout_s)
+        state["t"] += timeout_s  # a real hang burns its whole timeout
+        return "hang", "reaped"
+
+    with pytest.raises(bench.BenchBackendError):
+        bench._init_backend(
+            probe=hang, sleep=sleep, monotonic=monotonic,
+            probe_timeout_s=240, wait_budget_s=300, hang_retry_delay_s=0)
+    # First probe gets the full 240 s; the second only the 60 s left.
+    assert timeouts[0] == 240
+    assert all(t <= 240 for t in timeouts[1:])
+    assert timeouts[1] == 60
 
 
 def test_recovers_after_transient_failure(devices):

@@ -1,0 +1,91 @@
+"""Pytest-marker audit: subprocess training drills must be tier-2.
+
+Tier-1 (``-m "not slow"``) is the under-15-minute gate every PR runs; a
+subprocess drill that launches real training children (the DRIVER
+template of tests/test_fault_tolerance.py) costs minutes each and belongs
+behind the ``slow`` marker. This audit makes that a checked property
+instead of a review convention, so new drills (e.g. the async crash
+drills) can't silently land in tier-1.
+
+Pure ast — no test collection, no imports of the audited modules.
+"""
+
+import ast
+import pathlib
+
+TESTS_DIR = pathlib.Path(__file__).resolve().parent
+
+# Module-level names that mark a file as a subprocess-training-drill
+# module: the DRIVER template itself, or importing it from the fault
+# tolerance suite.
+_DRIVER_NAME = "DRIVER"
+
+
+def _decorator_marks(fn: ast.FunctionDef) -> set[str]:
+    """Names of pytest.mark.* decorators on a test function."""
+    marks: set[str] = set()
+    for dec in fn.decorator_list:
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        # pytest.mark.<name> is Attribute(Attribute(Name('pytest'),'mark'),name)
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "mark"):
+            marks.add(node.attr)
+    return marks
+
+
+def _defines_or_imports_driver(tree: ast.Module) -> bool:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == _DRIVER_NAME:
+                    return True
+        if isinstance(node, ast.ImportFrom):
+            if any(a.name == _DRIVER_NAME for a in node.names):
+                return True
+    return False
+
+
+def _uses_driver(fn: ast.FunctionDef) -> bool:
+    """Whether the function references DRIVER (directly or via a local
+    ``from ... import DRIVER``) — the signature of launching a real
+    training child."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id == _DRIVER_NAME:
+            return True
+        if isinstance(node, ast.ImportFrom) and \
+                any(a.name == _DRIVER_NAME for a in node.names):
+            return True
+    return False
+
+
+def test_subprocess_drills_carry_slow_marker():
+    offenders = []
+    for path in sorted(TESTS_DIR.glob("test_*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        module_wide = _defines_or_imports_driver(tree)
+        for node in tree.body:
+            if not (isinstance(node, ast.FunctionDef)
+                    and node.name.startswith("test_")):
+                continue
+            if not (module_wide or _uses_driver(node)):
+                continue
+            if "slow" not in _decorator_marks(node):
+                offenders.append(f"{path.name}::{node.name}")
+    assert not offenders, (
+        "subprocess training drills missing @pytest.mark.slow (they launch "
+        f"real training children and must stay out of tier-1): {offenders}"
+    )
+
+
+def test_audit_sees_the_known_drills():
+    """Self-check: the audit must actually recognize the existing drill
+    modules — an audit that matches nothing passes vacuously."""
+    ft = ast.parse((TESTS_DIR / "test_fault_tolerance.py").read_text())
+    assert _defines_or_imports_driver(ft)
+    ac = ast.parse((TESTS_DIR / "test_async_ckpt.py").read_text())
+    drill = next(n for n in ac.body
+                 if isinstance(n, ast.FunctionDef)
+                 and n.name == "test_supervised_crash_in_save_drill_async")
+    assert _uses_driver(drill)
+    assert {"slow", "slowest"} <= _decorator_marks(drill)

@@ -1,0 +1,30 @@
+"""core/platform.enable_compilation_cache: the persistent-cache knob.
+
+Config-plumbing only — no compiles are run with the cache armed, and the
+previous jax.config value is always restored, because on the CPU test
+backend a persistent cache poisons later pallas interpret-mode tests
+(reloaded executables embed dead host-callback pointers; see pytest.ini).
+"""
+
+import jax
+
+from distributed_tensorflow_framework_tpu.core.platform import (
+    enable_compilation_cache,
+)
+
+
+def test_empty_dir_is_off():
+    assert enable_compilation_cache("") is False
+
+
+def test_enable_points_jax_at_the_dir(tmp_path):
+    cache_dir = str(tmp_path / "xla_cache")
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        assert enable_compilation_cache(cache_dir) is True
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+        import os
+
+        assert os.path.isdir(cache_dir)  # created eagerly
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
