@@ -115,7 +115,25 @@ def main(argv=None) -> int:
     # barrier, so with async_save on the rc-83 exit below can never race
     # an in-flight background commit.
     supervision.install_sigterm_handler()
-    final = trainer.train()
+    try:
+        final = trainer.train()
+    except Exception as e:
+        from distributed_tensorflow_framework_tpu.train.anomaly import (
+            PersistentAnomalyError)
+
+        if isinstance(e, PersistentAnomalyError):
+            # The in-process recovery ladder is exhausted: this is a
+            # poisoned data region or deterministic numeric bug, not a
+            # transient. The distinct rc lets the supervisor classify it
+            # WITHOUT feeding the crash-loop breaker (relaunching into the
+            # same region would burn the whole budget for nothing).
+            logging.getLogger(__name__).error(
+                "persistent anomaly — escalating with rc=%d: %s "
+                "(provenance: %s)",
+                supervision.ANOMALY_ESCALATION_RC, e, e.provenance,
+            )
+            return supervision.ANOMALY_ESCALATION_RC
+        raise
     if trainer.preempted:
         logging.getLogger(__name__).warning(
             "preempted gracefully at step %d (checkpoint saved: %s) — "

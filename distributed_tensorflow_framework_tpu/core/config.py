@@ -353,6 +353,50 @@ class TrainConfig:
 
 
 @config_dataclass
+class ResilienceConfig:
+    """In-process recovery ladder (train/anomaly.py, docs/RESILIENCE.md).
+
+    The ladder runs at metric-fetch steps (train.log_interval cadence —
+    metrics are already on host there, so detection costs no extra device
+    syncs): classify the step, and on an anomaly restore the last good
+    in-memory snapshot, skip the offending data, and resume. Only after
+    ``max_rollbacks`` consecutive failed recoveries does the process
+    escalate to the supervisor with ``ANOMALY_ESCALATION_RC``.
+    """
+
+    # Master switch for detection + in-memory rollback. Off, anomalies go
+    # straight to the PR 2 path: NaNGuardHook abort → supervisor relaunch.
+    rollback: bool = True
+    # Device→host state snapshot cadence/retention for the rollback ring.
+    # Snapshots are taken at CLEAN metric-fetch steps, so the effective
+    # cadence is max(snapshot_interval_steps, train.log_interval).
+    snapshot_interval_steps: int = 100
+    snapshot_depth: int = 2
+    # Consecutive rollbacks (no clean fetch between them) before the
+    # ladder declares the anomaly persistent and escalates.
+    max_rollbacks: int = 3
+    # Loss-spike detector: flag when the loss sits more than this many
+    # EWMA standard deviations above its running mean (0 disables). The
+    # EWMA needs min_observations clean fetches before it can fire.
+    loss_spike_zscore: float = 10.0
+    loss_ewma_beta: float = 0.95
+    min_observations: int = 5
+    # Hard grad-norm ceiling (0 disables): a finite but exploding
+    # grad_norm metric is anomalous even before the loss moves.
+    grad_norm_max: float = 0.0
+    # After a rollback, linearly re-warm the learning rate over this many
+    # steps (0 disables). Costs one train-step recompile per rollback —
+    # still far cheaper than the relaunch+restore+recompile it replaces.
+    lr_rewarmup_steps: int = 0
+    # Infeed watchdog (data/infeed.py): deadline on each next(batch) pull
+    # in seconds (0 disables). On InfeedStallError the loop retries with
+    # exponential backoff up to infeed_retries times before escalating.
+    infeed_deadline_s: float = 0.0
+    infeed_retries: int = 3
+    infeed_backoff_s: float = 0.5
+
+
+@config_dataclass
 class ExperimentConfig:
     name: str = "experiment"
     mesh: MeshConfig = field(default_factory=MeshConfig)
@@ -362,6 +406,7 @@ class ExperimentConfig:
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -419,6 +464,20 @@ def load_config(
                 and "num_classes" not in sec):
             sec["num_classes"] = 1000
     cfg = _build(ExperimentConfig, data)
+    res = cfg.resilience
+    if res.snapshot_depth < 1:
+        raise ValueError(
+            f"resilience.snapshot_depth must be >= 1, got {res.snapshot_depth}"
+        )
+    if res.max_rollbacks < 1:
+        raise ValueError(
+            f"resilience.max_rollbacks must be >= 1, got {res.max_rollbacks}"
+        )
+    if not 0.0 < res.loss_ewma_beta < 1.0:
+        raise ValueError(
+            "resilience.loss_ewma_beta must be in (0, 1), got "
+            f"{res.loss_ewma_beta}"
+        )
     # Head-vs-labels cross-check for the built-in classification datasets:
     # a label outside the head's range turns the loss metric into NaN
     # through the integer-label CE gather (fill semantics) while grads

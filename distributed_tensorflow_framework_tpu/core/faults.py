@@ -25,11 +25,26 @@ Spec syntax (``DTF_FAULTS=crash_at_step:120,stall_infeed:30s``):
   stall_infeed:S     one ``next(dataset)`` call sleeps S seconds (suffix
                      ``s`` optional) — the hung-input drill the heartbeat
                      watchdog must catch. ``0`` means "hang forever"
-                     (6 hours, far past any staleness budget).
+                     (6 hours, far past any staleness budget). An optional
+                     third field (``stall_infeed:3s:4``) stalls the Nth
+                     pull of the process instead of the first — the train
+                     loop's infeed watchdog drill needs the stall INSIDE
+                     the step loop, past the build-time sample-batch peek
+                     (pull ordinals are 1-based; the peek is pull 1).
   nan_grads:N        step N's batch is poisoned to NaN (the train loop
                      applies it to floating-point inputs), so the loss and
                      gradients go non-finite and the NaN guard's provenance
                      path fires end-to-end.
+  loss_spike:N       step N's floating-point inputs are scaled by a large
+                     FINITE factor, so the loss/grad-norm jump without
+                     going non-finite — the EWMA z-score detector's drill
+                     (train/anomaly.py).
+  repeat_nan:N:K     like nan_grads but poisons EVERY step in [N, N+K):
+                     after a rollback the replayed region is poisoned
+                     again, so max_rollbacks consecutive recoveries fail
+                     and the escalation rung (ANOMALY_ESCALATION_RC)
+                     fires. Fires up to K times; with DTF_FAULTS_STATE it
+                     is disarmed entirely after the first firing records.
 
 Faults fire at most once per process. When ``DTF_FAULTS_STATE`` names a
 file, firings are also recorded there (before executing — a crash fault
@@ -76,11 +91,13 @@ STATE_ENV_VAR = "DTF_FAULTS_STATE"
 KIND_POINTS = {
     "crash_at_step": "step_begin",
     "nan_grads": "step_begin",
+    "loss_spike": "step_begin",
+    "repeat_nan": "step_begin",
     "stall_infeed": "infeed",
     "crash_in_save": "ckpt_in_save",
     "corrupt_ckpt": "ckpt_committed",
 }
-_STEP_KINDS = ("crash_at_step", "crash_in_save", "nan_grads")
+_STEP_KINDS = ("crash_at_step", "crash_in_save", "nan_grads", "loss_spike")
 _STALL_FOREVER_S = 6 * 3600.0
 
 
@@ -90,6 +107,10 @@ class Fault:
     arg: str = ""
     step: int | None = None
     seconds: float | None = None
+    # A fault may fire at `count` distinct steps ([step, step+count) —
+    # repeat_nan); it is spent once `fires` reaches it.
+    count: int = 1
+    fires: int = 0
     fired: bool = False
 
     @property
@@ -103,8 +124,10 @@ class Fault:
     def matches(self, point: str, step: int | None) -> bool:
         if self.fired or point != self.point:
             return False
-        if self.step is not None and step != self.step:
-            return False
+        if self.step is not None:
+            if step is None or not (
+                    self.step <= step < self.step + self.count):
+                return False
         return True
 
 
@@ -126,8 +149,22 @@ def _parse_one(entry: str) -> Fault:
             ) from None
         if fault.step < 1:
             raise ValueError(f"fault {kind!r} step must be >= 1, got {arg!r}")
+    elif kind == "repeat_nan":
+        head, _, tail = arg.partition(":")
+        try:
+            fault.step, fault.count = int(head), int(tail)
+        except ValueError:
+            raise ValueError(
+                f"fault repeat_nan needs start:count (e.g. repeat_nan:30:5), "
+                f"got {arg!r}"
+            ) from None
+        if fault.step < 1 or fault.count < 1:
+            raise ValueError(
+                f"fault repeat_nan needs step >= 1 and count >= 1, got {arg!r}"
+            )
     elif kind == "stall_infeed":
-        raw = arg[:-1] if arg.endswith("s") else arg
+        dur, _, ordinal = arg.partition(":")
+        raw = dur[:-1] if dur.endswith("s") else dur
         try:
             fault.seconds = float(raw) if raw else 0.0
         except ValueError:
@@ -136,6 +173,20 @@ def _parse_one(entry: str) -> Fault:
             ) from None
         if fault.seconds == 0.0:
             fault.seconds = _STALL_FOREVER_S
+        if ordinal:
+            # stall the Nth dataset pull (matched against the pull ordinal
+            # the data pipeline passes as `step`); without it, the first.
+            try:
+                fault.step = int(ordinal)
+            except ValueError:
+                raise ValueError(
+                    f"fault stall_infeed ordinal must be an integer "
+                    f"(e.g. stall_infeed:3s:4), got {arg!r}"
+                ) from None
+            if fault.step < 1:
+                raise ValueError(
+                    f"fault stall_infeed ordinal must be >= 1, got {arg!r}"
+                )
     return fault
 
 
@@ -185,7 +236,8 @@ class FaultPlan:
                 f.fired = True
 
     def _record_fired(self, fault: Fault) -> None:
-        fault.fired = True
+        fault.fires += 1
+        fault.fired = fault.fires >= fault.count
         if not self.state_path:
             return
         ids = self._fired_ids() | {fault.fault_id}

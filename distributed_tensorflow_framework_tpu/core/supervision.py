@@ -39,6 +39,16 @@ log = logging.getLogger(__name__)
 # cancellation, never relaunched) and from any shell 128+N signal code.
 GRACEFUL_PREEMPT_RC = 83
 
+# Exit code for "the in-process recovery ladder gave up": the anomaly
+# detector fired max_rollbacks consecutive times and every in-memory
+# rollback landed back on a bad step (train/anomaly.py). Distinct from a
+# plain crash so the supervisor can classify it — a persistent anomaly
+# (e.g. a poisoned data region) usually clears on relaunch because the
+# restored checkpoint + skipped batches take a different path through the
+# data, so it must not feed the crash-loop breaker's deterministic-bug
+# streak.
+ANOMALY_ESCALATION_RC = 85
+
 _preempt_requested = False
 _handler_installed = False
 
@@ -165,10 +175,14 @@ class CrashLoopBreaker:
         last_step: int | None,
         ckpt_step: int | None,
         hung: bool = False,
+        transient: bool = False,
     ) -> bool:
-        """Register one failed attempt; True = stop retrying."""
+        """Register one failed attempt; True = stop retrying. ``transient``
+        marks a failure class that never feeds the deterministic-crash
+        streak (like ``hung``) — e.g. ANOMALY_ESCALATION_RC, where the
+        relaunch resumes past the data region that caused it."""
         signature = (rc, last_step, ckpt_step)
-        if hung or self.threshold == 0:
+        if hung or transient or self.threshold == 0:
             self._streak, self._last = 0, None
         elif signature == self._last:
             self._streak += 1
@@ -179,6 +193,7 @@ class CrashLoopBreaker:
             "last_step": last_step,
             "ckpt_step": ckpt_step,
             "hung": hung,
+            "transient": transient,
             "streak": self._streak,
         })
         return self.threshold > 0 and self._streak >= self.threshold

@@ -72,6 +72,14 @@ KIND_CKPT_SAVE = "ckpt_save"
 # cost the persistent XLA compilation cache (core/platform.py) exists to
 # shrink.
 KIND_STARTUP = "startup"
+# In-process recovery ladder (train/anomaly.py, docs/RESILIENCE.md): a
+# detected bad step (non-finite metric, loss spike, grad-norm explosion),
+# the in-memory rollback that answered it, the data range skipped by
+# resuming forward, and infeed-watchdog stalls retried before escalating.
+KIND_ANOMALY = "anomaly_detected"
+KIND_ROLLBACK = "rollback"
+KIND_BATCH_SKIPPED = "batch_skipped"
+KIND_INFEED_STALL = "infeed_stall"
 
 
 def make_run_id() -> str:
@@ -274,6 +282,7 @@ def read_events(path: str, *, kind: str | None = None,
 RECOVERY_KINDS = (
     KIND_CKPT_QUARANTINED, KIND_RESTORE_FALLBACK,
     KIND_SUPERVISOR_ATTEMPT, KIND_CRASH_LOOP, KIND_FAILURE,
+    KIND_ANOMALY, KIND_ROLLBACK, KIND_BATCH_SKIPPED, KIND_INFEED_STALL,
 )
 
 
@@ -299,6 +308,10 @@ def summarize_events(path: str) -> dict:
     preemptions = 0
     crash_loop: dict | None = None
     failures: list[dict] = []
+    anomalies: list[dict] = []
+    rollbacks: list[dict] = []
+    batches_skipped = 0
+    infeed_stalls = 0
     saves = {
         "count": 0, "async_count": 0,
         "blocked_ms_total": 0.0, "total_ms_total": 0.0,
@@ -330,6 +343,18 @@ def summarize_events(path: str) -> dict:
             crash_loop = dict(extra) or dict(health)
         elif kind == KIND_FAILURE:
             failures.append({"step": step, **health})
+        elif kind == KIND_ANOMALY:
+            anomalies.append({"step": step, "anomaly": health.get("anomaly"),
+                              "metric": health.get("metric")})
+        elif kind == KIND_ROLLBACK:
+            rollbacks.append({
+                "from_step": health.get("from_step"),
+                "to_step": health.get("to_step"),
+            })
+        elif kind == KIND_BATCH_SKIPPED:
+            batches_skipped += int(health.get("batches", 1) or 1)
+        elif kind == KIND_INFEED_STALL:
+            infeed_stalls += 1
         elif kind == KIND_CKPT_SAVE:
             m = ev.get("metrics") or {}
             blocked = float(m.get("ckpt_save_blocked_ms", 0.0))
@@ -365,6 +390,10 @@ def summarize_events(path: str) -> dict:
             "graceful_preemptions": preemptions,
             "failures": failures,
             "crash_loop": crash_loop,
+            "anomalies": anomalies,
+            "rollbacks": rollbacks,
+            "batches_skipped": batches_skipped,
+            "infeed_stalls": infeed_stalls,
         },
     }
 
@@ -408,11 +437,26 @@ def format_run_summary(summary: dict) -> str:
         rec["quarantined"] or rec["restore_fallbacks"]
         or rec["supervisor_attempts"] or rec["graceful_preemptions"]
         or rec["failures"] or rec["crash_loop"]
+        or rec.get("anomalies") or rec.get("rollbacks")
+        or rec.get("batches_skipped") or rec.get("infeed_stalls")
     )
     if not activity:
         lines.append("  recovery activity: none")
         return "\n".join(lines)
     lines.append("  recovery activity:")
+    for a in rec.get("anomalies") or []:
+        lines.append(
+            f"    anomaly at step {a.get('step')}: "
+            f"{a.get('anomaly', 'unknown')} ({a.get('metric')})"
+        )
+    for r in rec.get("rollbacks") or []:
+        lines.append(
+            f"    rollback: step {r['from_step']} -> {r['to_step']}"
+        )
+    if rec.get("batches_skipped"):
+        lines.append(f"    batches skipped: {rec['batches_skipped']}")
+    if rec.get("infeed_stalls"):
+        lines.append(f"    infeed stalls retried: {rec['infeed_stalls']}")
     for q in rec["quarantined"]:
         lines.append(
             f"    quarantined checkpoint step {q['step']} ({q['reason']})"

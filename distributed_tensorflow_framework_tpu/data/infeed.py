@@ -5,10 +5,24 @@ SPMD equivalent is `jax.make_array_from_process_local_data`: every host
 contributes its shard and the result is ONE logical array sharded over the
 mesh's data axes (BASELINE.json: "tf.data input pipeline hoisted to the TPU
 host with per-replica infeed").
+
+Watchdog (docs/RESILIENCE.md recovery ladder): with ``deadline_s`` set,
+a batch that does not arrive within the deadline raises a typed
+``InfeedStallError`` instead of wedging the step loop until the
+supervisor's heartbeat watchdog SIGKILLs the process. The stalled pull
+keeps running underneath — the error is a *report*, not a cancellation —
+so the caller can retry (the Trainer does, with backoff) and collect the
+batch once the pipeline unwedges. The ``stall_infeed`` fault
+(core/faults.py) drills exactly this path.
 """
 
 from __future__ import annotations
 
+import collections
+import concurrent.futures
+import logging
+import queue as queue_mod
+import threading
 from typing import Mapping
 
 import jax
@@ -16,6 +30,26 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_framework_tpu.core.mesh import batch_spec
+
+log = logging.getLogger(__name__)
+
+_EOF = object()
+
+
+class InfeedStallError(RuntimeError):
+    """``next(dataset)`` exceeded the infeed watchdog deadline.
+
+    The underlying pull is still in flight: calling ``next()`` on the
+    prefetcher again waits for the SAME batch (no data is skipped or
+    double-pulled). Raised only when ``deadline_s > 0`` was configured
+    (resilience.infeed_deadline_s)."""
+
+    def __init__(self, deadline_s: float):
+        self.deadline_s = deadline_s
+        super().__init__(
+            f"infeed pull exceeded the {deadline_s:g}s watchdog deadline "
+            f"(the pull is still running; retry next() to keep waiting)"
+        )
 
 
 def to_global(batch: Mapping[str, np.ndarray], mesh: Mesh,
@@ -28,8 +62,163 @@ def to_global(batch: Mapping[str, np.ndarray], mesh: Mesh,
     }
 
 
+class _BackgroundInfeed:
+    """Producer-thread prefetcher: host pipeline pull AND device transfer
+    run off the training thread. The consumer sees ``(global_batch,
+    iterator_state_snapshot)`` items in pull order; with a deadline, a
+    slow producer surfaces as InfeedStallError on the consumer side while
+    the producer keeps working."""
+
+    def __init__(self, dataset, mesh: Mesh, spec: P | None, size: int,
+                 deadline_s: float = 0.0):
+        self._dataset = dataset
+        self._deadline_s = deadline_s
+        self._q: queue_mod.Queue = queue_mod.Queue(maxsize=max(size, 1))
+        self._stop = threading.Event()
+        self._done = False
+        snap = getattr(dataset, "state", lambda: {})
+
+        def put(item) -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for host_batch in dataset:
+                    if self._stop.is_set():
+                        return
+                    if not put((to_global(host_batch, mesh, spec), snap())):
+                        return
+            except BaseException as e:  # surface in the consumer
+                put(e)
+                return
+            put(_EOF)
+
+        self._thread = threading.Thread(target=produce, daemon=True,
+                                        name="infeed-prefetch")
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        if self._deadline_s > 0:
+            try:
+                item = self._q.get(timeout=self._deadline_s)
+            except queue_mod.Empty:
+                raise InfeedStallError(self._deadline_s) from None
+        else:
+            item = self._q.get()
+        if item is _EOF:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._done = True
+            raise item
+        return item
+
+    def close(self) -> None:
+        # Consumer done (total_steps reached, early break, error): release
+        # the producer — it must NOT keep pulling from the dataset, which
+        # the caller may restore/reuse next.
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue_mod.Empty:
+                break
+        self._thread.join(timeout=10)
+        if self._thread.is_alive():
+            # Producer stuck inside a blocking dataset pull (e.g. a
+            # stalled filesystem read): it may complete ONE more pull
+            # after we return — restoring/reusing the dataset now races
+            # it. Surface the hazard instead of failing silent.
+            log.warning(
+                "infeed producer thread did not stop within 10s — "
+                "the dataset may see one more pull; avoid reusing it "
+                "until the process-level pipeline unblocks"
+            )
+
+
+class _SyncInfeed:
+    """Same-thread prefetcher with a bounded lookahead buffer. With a
+    deadline, each raw pull runs on a single persistent worker thread so
+    it can be *timed*; a timed-out pull is kept pending and the next
+    ``next()`` resumes waiting on it (never skipped, never re-issued)."""
+
+    def __init__(self, dataset, mesh: Mesh, spec: P | None, size: int,
+                 deadline_s: float = 0.0):
+        self._dataset = dataset
+        self._mesh = mesh
+        self._spec = spec
+        self._size = max(size, 1)
+        self._deadline_s = deadline_s
+        self._snap = getattr(dataset, "state", lambda: {})
+        self._buf: collections.deque = collections.deque()
+        self._primed = False
+        self._eof = False
+        self._pool = None
+        self._pending = None
+        if deadline_s > 0:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="infeed-pull")
+
+    def _pull_raw(self):
+        """One ``next(dataset)`` → host batch or _EOF; stall-guarded when
+        a deadline is configured."""
+        if self._pool is None:
+            return next(self._dataset, _EOF)
+        if self._pending is None:
+            self._pending = self._pool.submit(next, self._dataset, _EOF)
+        try:
+            item = self._pending.result(timeout=self._deadline_s)
+        except concurrent.futures.TimeoutError:
+            raise InfeedStallError(self._deadline_s) from None
+        self._pending = None
+        return item
+
+    def _fill(self, n: int) -> None:
+        for _ in range(n):
+            item = self._pull_raw()
+            if item is _EOF:
+                self._eof = True
+                return
+            self._buf.append(
+                (to_global(item, self._mesh, self._spec), self._snap()))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        want = 1 if self._primed else self._size
+        self._primed = True
+        if not self._eof:
+            try:
+                self._fill(want)
+            except InfeedStallError:
+                if not self._buf:
+                    raise
+                # Buffered batches still cover the consumer; the stalled
+                # pull stays pending and is retried on the next call.
+        if not self._buf:
+            raise StopIteration
+        return self._buf.popleft()
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+
+
 def prefetch_to_device(dataset, mesh: Mesh, *, size: int = 2,
-                       spec: P | None = None, background: bool = False):
+                       spec: P | None = None, background: bool = False,
+                       deadline_s: float = 0.0):
     """Software-pipelined infeed: keep `size` global batches in flight.
 
     The analogue of tf.data's ``prefetch_to_device`` — device transfer of
@@ -40,94 +229,20 @@ def prefetch_to_device(dataset, mesh: Mesh, *, size: int = 2,
     native JPEG path) genuinely overlaps device steps instead of running
     in the gaps between dispatches.
 
-    Yields ``(global_batch, iterator_state_snapshot)``. The snapshot is the
-    dataset's state immediately after the yielded batch was pulled from it —
-    i.e. the state to checkpoint so a restore resumes with the NEXT batch.
-    Because the prefetcher runs ahead of training, ``dataset.state()`` itself
-    is not safe to checkpoint (it reflects the prefetched-ahead position);
+    Returns a closable iterator of ``(global_batch,
+    iterator_state_snapshot)``. The snapshot is the dataset's state
+    immediately after the yielded batch was pulled from it — i.e. the
+    state to checkpoint so a restore resumes with the NEXT batch. Because
+    the prefetcher runs ahead of training, ``dataset.state()`` itself is
+    not safe to checkpoint (it reflects the prefetched-ahead position);
     the snapshot is (resume-exactness, SURVEY.md §7 hard part 3). The
-    dataset is only ever touched from one thread (the producer), so the
-    snapshot/batch pairing is identical in both modes.
+    dataset is only ever touched from one thread (the producer/worker),
+    so the snapshot/batch pairing is identical in both modes.
+
+    ``deadline_s > 0`` arms the infeed watchdog: a pull that exceeds the
+    deadline raises ``InfeedStallError`` from ``next()`` while the pull
+    keeps running underneath — retrying ``next()`` resumes waiting for
+    the same batch (the Trainer's retry-with-backoff rung).
     """
-    snap = getattr(dataset, "state", lambda: {})
-
-    if background:
-        import queue as queue_mod
-        import threading
-
-        q: queue_mod.Queue = queue_mod.Queue(maxsize=max(size, 1))
-        stop = threading.Event()
-        _EOF = object()
-
-        def put(item) -> bool:
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue_mod.Full:
-                    continue
-            return False
-
-        def produce():
-            try:
-                for host_batch in dataset:
-                    if stop.is_set():
-                        return
-                    if not put((to_global(host_batch, mesh, spec), snap())):
-                        return
-            except BaseException as e:  # surface in the consumer
-                put(e)
-                return
-            put(_EOF)
-
-        t = threading.Thread(target=produce, daemon=True,
-                             name="infeed-prefetch")
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is _EOF:
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            # Consumer done (total_steps reached, early break, error):
-            # release the producer — it must NOT keep pulling from the
-            # dataset, which the caller may restore/reuse next.
-            stop.set()
-            while True:
-                try:
-                    q.get_nowait()
-                except queue_mod.Empty:
-                    break
-            t.join(timeout=10)
-            if t.is_alive():
-                # Producer stuck inside a blocking dataset pull (e.g. a
-                # stalled filesystem read): it may complete ONE more pull
-                # after we return — restoring/reusing the dataset now
-                # races it. Surface the hazard instead of failing silent.
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "infeed producer thread did not stop within 10s — "
-                    "the dataset may see one more pull; avoid reusing it "
-                    "until the process-level pipeline unblocks"
-                )
-
-    import collections
-
-    buf: collections.deque = collections.deque()
-
-    def enqueue(n: int) -> None:
-        for _ in range(n):
-            try:
-                host_batch = next(dataset)
-            except StopIteration:
-                return
-            buf.append((to_global(host_batch, mesh, spec), snap()))
-
-    enqueue(size)
-    while buf:
-        yield buf.popleft()
-        enqueue(1)
+    cls = _BackgroundInfeed if background else _SyncInfeed
+    return cls(dataset, mesh, spec, size, deadline_s)

@@ -122,6 +122,10 @@ class HostDataset:
         self._state: dict[str, Any] = dict(initial_state or {})
         self._iter: Iterator[Batch] | None = None
         self.cardinality = cardinality
+        # Process-lifetime pull ordinal (1-based, NOT reset by restore):
+        # lets stall_infeed:S:N target a specific pull — e.g. one past the
+        # Trainer's build-time sample peek, inside the step loop.
+        self._pulls = 0
 
     def __iter__(self):
         return self
@@ -130,7 +134,8 @@ class HostDataset:
         # stall_infeed fault point (core/faults.py): a hung input pipeline
         # — the failure the heartbeat watchdog must catch — is one sleep
         # here; a no-op set lookup when no plan is installed.
-        faults.fire("infeed")
+        self._pulls += 1
+        faults.fire("infeed", step=self._pulls)
         if self._iter is None:
             self._iter = self._make_iter(self._state)
         return next(self._iter)

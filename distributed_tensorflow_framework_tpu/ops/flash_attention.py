@@ -26,11 +26,14 @@ the plain-XLA reference.
 from __future__ import annotations
 
 import functools
+import logging
 import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+log = logging.getLogger(__name__)
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 BLOCK_Q = 128
@@ -73,25 +76,42 @@ MAX_SEQ_VMEM = int(os.environ.get("FLASH_MAX_SEQ_VMEM", "4096"))
 # streaming regime's cost in exactly that S² VPU transcendental work,
 # at the price of full-length (S_k, D) f32 dk/dv VMEM accumulators —
 # hence the MAX gate (4 MB at 8192; beyond ~2·8192 it cannot fit and
-# the two-pass kernels remain the only path). Default ON since the
-# 2026-08-01 v5e window: scripts/verify_fused_bwd.py showed EXACT
-# on-device agreement with the two-pass kernels at seq 8192 (worst rel
-# diff 0.0) and the step A/B measured 36,150 vs 33,526 tok/s (+7.8%)
-# at seq 8192, bs 4 (PERF_NOTES round 5). FLASH_FUSED_BWD=0 restores
-# the two-pass path; env read at import time like the other FLASH_*
-# knobs.
-FUSED_BWD = os.environ.get("FLASH_FUSED_BWD", "1") not in ("", "0")
+# the two-pass kernels remain the only path).
+#
+# Tri-state default: ``None`` (env unset) = auto — ON only on backends
+# where scripts/verify_fused_bwd.py results are RECORDED (the
+# 2026-08-01 v5e window: EXACT on-device agreement with the two-pass
+# kernels at seq 8192, worst rel diff 0.0, and the step A/B measured
+# 36,150 vs 33,526 tok/s, +7.8%, at seq 8192 bs 4 — PERF_NOTES round
+# 5). On any other real TPU generation the fused dk/dv/dbias flush
+# ordering is UNVERIFIED silicon behavior (ADVICE r5): auto keeps the
+# two-pass backward and says so once. FLASH_FUSED_BWD=1/0 forces either
+# way (env read at import time like the other FLASH_* knobs); tests and
+# scripts/verify_fused_bwd.py assign the module global directly — the
+# backward closures consult it at call time through fused_bwd_enabled().
+_FUSED_BWD_ENV = os.environ.get("FLASH_FUSED_BWD")
+FUSED_BWD: bool | None = (
+    None if _FUSED_BWD_ENV is None else _FUSED_BWD_ENV not in ("", "0"))
 FUSED_BWD_MAX = int(os.environ.get("FLASH_FUSED_BWD_MAX", "8192"))
-# The fused one-pass backward also REPLACES the whole-K two-pass backward
-# for mid-length sequences (FUSED_WHOLE_K_MIN ≤ s ≤ MAX_SEQ_VMEM): the
-# whole-K dq/dkv kernel pair pays the same three S² exp evaluations the
-# streaming two-pass does, and the round-4 crossover showed the K-blocked
-# kernels already TIE whole-K at 2048 — so the fused kernel's saved exp
-# is pure win from there up. Below 2048 the K-blocked grid overhead
-# dominates (measured, PERF_NOTES round 3/4) and whole-K two-pass stays.
-# Forward stays whole-K either way (the streaming backward needs only
+# Backend substrings (matched against device_kind, lowercased) with
+# recorded verify_fused_bwd.py + step-A/B results.
+FUSED_BWD_VERIFIED_PLATFORMS = ("v5 lite", "v5e")
+# The fused one-pass backward can also REPLACE the whole-K two-pass
+# backward for mid-length sequences (FUSED_WHOLE_K_MIN ≤ s ≤
+# MAX_SEQ_VMEM): the whole-K dq/dkv kernel pair pays the same three S²
+# exp evaluations the streaming two-pass does, and the round-4 crossover
+# showed the K-blocked kernels already TIE whole-K at 2048 — so the fused
+# kernel's saved exp SHOULD be pure win from there up. But that band's
+# win is EXTRAPOLATED from the 8192 measurement, not measured (the
+# queued wk2048/wk4096 chip A/B — scripts/chip_window_queue.sh item 7 —
+# never ran: tunnel wedged, PERF_NOTES round 5), so the takeover ships
+# DEFAULT-OFF: the threshold parks above MAX_SEQ_VMEM, where the
+# streaming kernels are the only path anyway and the knob is inert.
+# Re-arm with FLASH_FUSED_WHOLE_K_MIN=2048 once the A/B lands. Forward
+# stays whole-K either way (the streaming backward needs only
 # q/k/v/bias/lse/do, all of which the whole-K forward saves).
-FUSED_WHOLE_K_MIN = int(os.environ.get("FLASH_FUSED_WHOLE_K_MIN", "2048"))
+FUSED_WHOLE_K_MIN = int(
+    os.environ.get("FLASH_FUSED_WHOLE_K_MIN", str(MAX_SEQ_VMEM + 1)))
 
 
 def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
@@ -458,6 +478,42 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+_fused_bwd_auto: bool | None = None  # memoized auto-resolution
+
+
+def fused_bwd_enabled() -> bool:
+    """Resolve the FUSED_BWD tri-state at backward-dispatch time.
+
+    A bool in the module global (env knob, test monkeypatch, or
+    scripts/verify_fused_bwd.py's direct assignment) always wins. ``None``
+    = auto: ON only when the default backend is a TPU whose device_kind
+    matches a FUSED_BWD_VERIFIED_PLATFORMS entry; any OTHER real TPU gets
+    the two-pass backward plus a one-line warning (once) — the fused
+    flush ordering is verified per-generation, and silently-wrong
+    gradients are the worst possible failure mode. Non-TPU backends run
+    the kernels in interpret mode where perf is moot: auto stays off,
+    quietly (CPU parity for the fused path is pinned by tests that force
+    the flag)."""
+    global _fused_bwd_auto
+    if FUSED_BWD is not None:
+        return FUSED_BWD
+    if _fused_bwd_auto is None:
+        if jax.default_backend() != "tpu":
+            _fused_bwd_auto = False
+        else:
+            kind = jax.devices()[0].device_kind.lower()
+            _fused_bwd_auto = any(
+                p in kind for p in FUSED_BWD_VERIFIED_PLATFORMS)
+            if not _fused_bwd_auto:
+                log.warning(
+                    "fused flash-attention backward disabled: no recorded "
+                    "verify_fused_bwd.py results for TPU %r — run "
+                    "scripts/verify_fused_bwd.py and set FLASH_FUSED_BWD=1 "
+                    "to enable", kind,
+                )
+    return _fused_bwd_auto
+
+
 def _make_fused(segmented: bool, return_lse: bool):
     """Build the custom-VJP fused attention for one (segmented, lse)
     variant. Unsegmented signature: (q, k, v, bias) — the common path
@@ -485,7 +541,7 @@ def _make_fused(segmented: bool, return_lse: bool):
         def bwd(res, g):
             q, k, v, bias, qseg, kseg, o, lse = res
             do, dlse = g if return_lse else (g, None)
-            use_fused = FUSED_BWD and k.shape[2] <= FUSED_BWD_MAX
+            use_fused = fused_bwd_enabled() and k.shape[2] <= FUSED_BWD_MAX
             dq, dk, dv, dbias = _flash_bwd(
                 q, k, v, bias, qseg, kseg, o, lse, do, dlse=dlse,
                 segmented=True, interpret=_interpret(),
@@ -510,7 +566,7 @@ def _make_fused(segmented: bool, return_lse: bool):
         def bwd(res, g):
             q, k, v, bias, o, lse = res
             do, dlse = g if return_lse else (g, None)
-            use_fused = FUSED_BWD and k.shape[2] <= FUSED_BWD_MAX
+            use_fused = fused_bwd_enabled() and k.shape[2] <= FUSED_BWD_MAX
             dq, dk, dv, dbias = _flash_bwd(
                 q, k, v, bias, o, lse, do, dlse=dlse,
                 segmented=False, interpret=_interpret(),

@@ -43,10 +43,12 @@ from distributed_tensorflow_framework_tpu.ops.flash_attention import (
 # 2048 (70.7 vs 69.6) and 4096 (89.8 vs 84.1, +6.8%). 2048 stands as the
 # measured crossover — the round-3 value survived the 2x kernel speedup
 # because XLA's chain got proportionally cheaper at short chunks too.
-# Those flash timings are TWO-PASS backward numbers; since the round-5
-# FUSED_WHOLE_K_MIN default, chunks ≥ 2048 take the fused one-pass
-# backward, which only widens flash's margin at/above this crossover
-# (the XLA arm and sub-2048 chunks are unaffected).
+# Those flash timings are TWO-PASS backward numbers — the matched
+# regime: the round-5 whole-K fused takeover now ships default-off
+# (ops/flash_attention.py FUSED_WHOLE_K_MIN parks above MAX_SEQ_VMEM
+# until the wk2048/wk4096 chip A/B lands), so chunks in [2048,
+# MAX_SEQ_VMEM] take the measured two-pass path unless the operator
+# re-arms the knob, which would only widen flash's margin here.
 # Module-level so tests can force either path.
 FLASH_CHUNK_MIN = 2048
 

@@ -47,6 +47,14 @@ class NaNGuardHook(BaseHook):
     metric, which step, and the last-good checkpoint to restart from — and
     lands in the run's telemetry as a ``failure`` event, so post-mortems
     don't start from a bare stack trace.
+
+    With the in-process recovery ladder armed (train/anomaly.py) this hook
+    is the ladder's ESCALATION TAIL, not the first responder: a rolled-back
+    anomaly never reaches it (the Trainer suppresses the poisoned metrics),
+    so a non-finite value here means the ladder is exhausted — the abort
+    becomes ``PersistentAnomalyError`` carrying the ladder's provenance,
+    which cli/train.py maps to supervision.ANOMALY_ESCALATION_RC so the
+    supervisor can classify poisoned-data-region vs transient.
     """
 
     def after_step(self, trainer, step, metrics) -> None:
@@ -64,6 +72,17 @@ class NaNGuardHook(BaseHook):
                     f"restart from {ckpt}" if ckpt
                     else "no checkpoint saved — restart from scratch"
                 )
+                rec = getattr(trainer, "recovery", None)
+                if rec is not None and rec.exhausted:
+                    from distributed_tensorflow_framework_tpu.train.anomaly import (
+                        PersistentAnomalyError)
+
+                    raise PersistentAnomalyError(
+                        f"{rec.escalation_message()} Non-finite metric "
+                        f"{name}={v} at step {step}. Last good checkpoint: "
+                        f"{restart}.",
+                        provenance=rec.provenance(),
+                    )
                 raise FloatingPointError(
                     f"Non-finite metric {name}={v} at step {step} — aborting "
                     f"(NaNGuardHook; reference NanTensorHook contract). "

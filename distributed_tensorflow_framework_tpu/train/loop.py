@@ -29,9 +29,12 @@ from distributed_tensorflow_framework_tpu.core import faults, profiling, supervi
 from distributed_tensorflow_framework_tpu.core.mesh import MeshRuntime, initialize_runtime
 from distributed_tensorflow_framework_tpu.core.metrics import MetricWriter, setup_logging
 from distributed_tensorflow_framework_tpu.data import get_dataset
-from distributed_tensorflow_framework_tpu.data.infeed import prefetch_to_device, to_global
+from distributed_tensorflow_framework_tpu.data.infeed import (
+    InfeedStallError, prefetch_to_device, to_global)
 from distributed_tensorflow_framework_tpu.parallel import collectives as coll
+from distributed_tensorflow_framework_tpu.train import anomaly as anomaly_lib
 from distributed_tensorflow_framework_tpu.train import hooks as hooks_lib
+from distributed_tensorflow_framework_tpu.train import schedules
 from distributed_tensorflow_framework_tpu.train.step import StepBuilder
 
 log = logging.getLogger(__name__)
@@ -45,6 +48,20 @@ def _poison_batch(batch: dict) -> dict:
 
     return {
         k: v * jnp.asarray(float("nan"), dtype=v.dtype)
+        if jnp.issubdtype(v.dtype, jnp.floating) else v
+        for k, v in batch.items()
+    }
+
+
+def _scale_batch(batch: dict, factor: float) -> dict:
+    """loss_spike fault effect: blow up the floating-point inputs by a
+    large FINITE factor — the loss jumps orders of magnitude but stays
+    finite, so only the EWMA z-score rung of the detector can catch it
+    (the non-finite check must not)."""
+    import jax.numpy as jnp
+
+    return {
+        k: v * jnp.asarray(factor, dtype=v.dtype)
         if jnp.issubdtype(v.dtype, jnp.floating) else v
         for k, v in batch.items()
     }
@@ -73,6 +90,15 @@ class Trainer:
             is_chief=self.runtime.is_chief,
         )
         self.run_id = self.writer.run_id
+        # In-process recovery ladder (train/anomaly.py): detect → rollback
+        # → re-warmup → escalate. None when resilience.rollback=false —
+        # the loop then behaves exactly as before this rung existed
+        # (NaNGuardHook aborts, supervisor relaunches from checkpoint).
+        self.recovery = (
+            anomaly_lib.RecoveryManager(
+                config.resilience, telemetry_writer=self.writer.telemetry)
+            if config.resilience.rollback else None
+        )
         self.state: Any = None
         self.host_step = 0
         self._ckpt_manager = None
@@ -104,6 +130,9 @@ class Trainer:
         host_batch = next(self.dataset)
         self.dataset.restore(start_state)
         sample = to_global(host_batch, self.mesh)
+        # Kept for post-rollback re-jitting (LR re-warmup rebuilds the
+        # optimizer, which needs a recompile against the same shapes).
+        self._sample = sample
         self.state = self.builder.init_state(self.config.train.seed, sample)
         self.train_step = self.builder.make_train_step(sample)
         # Optimized-HLO capture for trace attribution (ProfileHook dumps
@@ -252,7 +281,14 @@ class Trainer:
         infeed = prefetch_to_device(
             self.dataset, self.mesh, size=self.config.data.prefetch,
             background=self.config.data.async_infeed,
+            deadline_s=self.config.resilience.infeed_deadline_s,
         )
+        if self.recovery is not None:
+            # Baseline snapshot: the ladder must be able to roll back even
+            # if the first anomaly lands before the first clean fetch.
+            self.recovery.take_snapshot(
+                self.host_step, self.state,
+                data_state=self.data_ckpt_state, force=True)
         # Host-side phase timing (core/profiling.py): infeed vs dispatch vs
         # metric-fetch wall time, reported at every log interval — the
         # cheap always-on signal for "is the input pipeline the wall?"
@@ -283,13 +319,16 @@ class Trainer:
                     )
                     break
                 with timer.phase("infeed"):
-                    batch, self.data_ckpt_state = next(infeed)
+                    batch, self.data_ckpt_state = self._next_batch(infeed)
                 # Fault injection (core/faults.py, DTF_FAULTS): crash_at_step
-                # SIGKILLs here; nan_grads poisons this step's batch so the
-                # NaN-provenance path is drilled end-to-end.
+                # SIGKILLs here; nan_grads/repeat_nan poison this step's
+                # batch (NaN provenance / escalation drills) and loss_spike
+                # scales it by a large finite factor (EWMA z-score drill).
                 for fault in faults.fire("step_begin", step=self.host_step + 1):
-                    if fault.kind == "nan_grads":
+                    if fault.kind in ("nan_grads", "repeat_nan"):
                         batch = _poison_batch(batch)
+                    elif fault.kind == "loss_spike":
+                        batch = _scale_batch(batch, 1e4)
                 if cfg.dispatch_ahead > 0 and len(pending) >= cfg.dispatch_ahead:
                     with timer.phase("backpressure"):
                         float(jax.device_get(
@@ -337,10 +376,26 @@ class Trainer:
                         }
                     host_metrics.update(timer.means())
                     timer.reset()
-                    last_metrics = host_metrics
                     pending.clear()
+                    # Recovery ladder rung (train/anomaly.py): a successful
+                    # rollback returns None — the anomalous metrics never
+                    # reach the hooks (no NaNGuard abort, no poisoned
+                    # LoggingHook record) and host_step has been rewound.
+                    host_metrics = self._maybe_recover(host_metrics)
+                    if host_metrics is not None:
+                        last_metrics = host_metrics
                 for h in hooks:
                     h.after_step(self, self.host_step, host_metrics)
+                if self.recovery is not None and self.recovery.exhausted:
+                    # Finite-anomaly escalation (loss spike / grad-norm
+                    # explosion past max_rollbacks): NaNGuardHook only
+                    # fires on non-finite metrics, so the loop itself is
+                    # the escalation tail here — also covers
+                    # train.nan_guard=false runs.
+                    raise anomaly_lib.PersistentAnomalyError(
+                        self.recovery.escalation_message(),
+                        provenance=self.recovery.provenance(),
+                    )
         finally:
             # Stop the background producer (async_infeed): it must not
             # keep pulling from the dataset the caller may reuse/restore.
@@ -354,6 +409,90 @@ class Trainer:
             # rc 83) with a commit still in flight on the saver thread.
             self._ckpt_manager.wait_until_finished()
         return last_metrics
+
+    # ----------------------------------------------------- recovery ladder --
+    def _next_batch(self, infeed):
+        """One infeed pull behind the stall watchdog (data/infeed.py).
+
+        With ``resilience.infeed_deadline_s`` armed, a pull that exceeds
+        the deadline raises ``InfeedStallError``; the retry here waits out
+        the SAME pull (the watchdog reports, it does not cancel) with
+        linear backoff, emitting an ``infeed_stall`` event per attempt.
+        Past ``infeed_retries`` the error propagates — the supervisor's
+        heartbeat watchdog rung takes over.
+        """
+        rcfg = self.config.resilience
+        attempt = 0
+        while True:
+            try:
+                return next(infeed)
+            except InfeedStallError as e:
+                attempt += 1
+                self.writer.telemetry.emit(
+                    telemetry.KIND_INFEED_STALL, step=self.host_step,
+                    health={"deadline_s": e.deadline_s, "attempt": attempt,
+                            "max_retries": rcfg.infeed_retries},
+                )
+                if attempt > rcfg.infeed_retries:
+                    log.error(
+                        "infeed stalled past %d retries — escalating",
+                        rcfg.infeed_retries,
+                    )
+                    raise
+                backoff = rcfg.infeed_backoff_s * attempt
+                log.warning(
+                    "infeed stall (attempt %d/%d, deadline %.1fs) — "
+                    "retrying in %.2fs", attempt, rcfg.infeed_retries,
+                    e.deadline_s, backoff,
+                )
+                time.sleep(backoff)
+
+    def _maybe_recover(self, host_metrics: dict[str, float]) -> dict[str, float] | None:
+        """Classify a fetched-metrics step; roll back if anomalous.
+
+        Returns the metrics unchanged for clean steps (after feeding the
+        EWMA baseline and opportunistically snapshotting), None when a
+        rollback consumed the anomaly (host_step is rewound; the hooks
+        must not see the poisoned metrics), and the ANOMALOUS metrics with
+        ``recovery.exhausted`` set when the ladder is out of rungs — the
+        caller escalates after the hooks run.
+        """
+        rec = self.recovery
+        if rec is None:
+            return host_metrics
+        verdict = rec.classify(self.host_step, host_metrics)
+        if verdict is None:
+            rec.take_snapshot(self.host_step, self.state,
+                              data_state=self.data_ckpt_state)
+            return host_metrics
+        if not rec.can_rollback():
+            rec.exhausted = True
+            return host_metrics
+        self.state, snap = rec.rollback(self.state, from_step=self.host_step)
+        # Skip-batch semantics: host_step rewinds, the data iterator does
+        # NOT — the replayed step range consumes fresh batches and the
+        # poisoned region is never re-fed.
+        self.host_step = snap.step
+        if self.config.resilience.lr_rewarmup_steps > 0:
+            self._rebuild_with_rewarmup(snap.step)
+        return None
+
+    def _rebuild_with_rewarmup(self, resume_step: int) -> None:
+        """Swap the LR schedule for a re-warmed copy and re-jit the step.
+
+        optax schedule state is a bare step counter, so the restored
+        opt_state is structurally identical under the new chain — the
+        rebuild costs one recompile (same shapes, warm XLA cache), not a
+        state migration.
+        """
+        steps = self.config.resilience.lr_rewarmup_steps
+        log.info(
+            "re-warming learning rate over steps [%d, %d) after rollback",
+            resume_step, resume_step + steps,
+        )
+        self.builder.set_schedule_wrapper(
+            lambda sched: schedules.with_rewarmup(sched, resume_step, steps))
+        self.train_step = self.builder.make_train_step(self._sample)
 
     # ---------------------------------------------------------------- eval --
     def _ensure_eval(self):

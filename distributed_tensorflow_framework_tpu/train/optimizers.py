@@ -35,9 +35,17 @@ def _decay_mask(params: Any) -> Any:
 
 
 def make_optimizer(
-    config: OptimizerConfig, total_steps: int
+    config: OptimizerConfig, total_steps: int,
+    schedule_wrapper=None,
 ) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    """Build the optax chain + schedule. ``schedule_wrapper`` (schedule →
+    schedule) post-processes the schedule before the chain captures it —
+    the hook the post-rollback LR re-warmup (train/schedules.with_rewarmup)
+    uses to rebuild the optimizer without changing the opt-state pytree
+    (optax schedule state is a bare step counter, schedule-agnostic)."""
     sched = make_schedule(config, total_steps)
+    if schedule_wrapper is not None:
+        sched = schedule_wrapper(sched)
     chain = []
     if config.grad_clip_norm > 0:
         chain.append(optax.clip_by_global_norm(config.grad_clip_norm))

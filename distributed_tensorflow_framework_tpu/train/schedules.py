@@ -8,6 +8,7 @@ optax schedules usable inside jit.
 
 from __future__ import annotations
 
+import jax.numpy as jnp
 import optax
 
 from distributed_tensorflow_framework_tpu.core.config import OptimizerConfig
@@ -35,4 +36,28 @@ def make_schedule(config: OptimizerConfig, total_steps: int) -> optax.Schedule:
     if config.warmup_steps > 0:
         warmup = optax.linear_schedule(0.0, base, config.warmup_steps)
         sched = optax.join_schedules([warmup, sched], [config.warmup_steps])
+    return sched
+
+
+def with_rewarmup(schedule: optax.Schedule, resume_step: int,
+                  rewarmup_steps: int) -> optax.Schedule:
+    """Post-rollback LR re-warmup (resilience.lr_rewarmup_steps).
+
+    After an in-memory rollback (train/anomaly.py) the restored optimizer
+    slots are a few steps stale relative to the fresh data stream; scaling
+    the base schedule linearly from ~0 back to 1 over
+    ``[resume_step, resume_step + rewarmup_steps)`` eases the re-entry the
+    same way startup warmup eases cold slots. The restored step counter
+    resumes AT ``resume_step`` (earlier steps never evaluate again), and
+    at/after the window's end the base schedule is unchanged — the
+    wrapper only bends the window.
+    """
+    if rewarmup_steps <= 0:
+        return schedule
+
+    def sched(step):
+        frac = (jnp.asarray(step, jnp.float32) - float(resume_step) + 1.0
+                ) / float(rewarmup_steps)
+        return schedule(step) * jnp.clip(frac, 0.0, 1.0)
+
     return sched

@@ -158,6 +158,18 @@ class StepBuilder:
         )
         self._state_specs = None
 
+    def set_schedule_wrapper(self, wrapper) -> None:
+        """Rebuild tx/schedule with ``wrapper`` applied (the post-rollback
+        LR re-warmup, train/schedules.with_rewarmup; None restores the
+        plain schedule). The opt-state pytree stays valid — optax keeps
+        only a schedule-agnostic step counter — but the caller must
+        rebuild its compiled train step afterwards (the old jit captured
+        the old chain)."""
+        self.tx, self.schedule = make_optimizer(
+            self.config.optimizer, self.config.train.total_steps,
+            schedule_wrapper=wrapper,
+        )
+
     # ------------------------------------------------------------- init --
     def _create_state(self, seed_arr: jax.Array, batch: Any) -> TrainState:
         root = jax.random.key(seed_arr[0])

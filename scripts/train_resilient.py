@@ -321,6 +321,36 @@ def main(argv=None) -> int:
                 return rc
             continue
 
+        if rc == supervision.ANOMALY_ESCALATION_RC:
+            # The child's IN-PROCESS recovery ladder (train/anomaly.py)
+            # exhausted max_rollbacks on one incident: a poisoned data
+            # region or deterministic numeric bug, already diagnosed and
+            # telemetered by the child. Relaunching from the checkpoint is
+            # still the right move (the restored iterator has advanced past
+            # part of the region), but this is NOT a crash signature — the
+            # breaker's streak must not accumulate toward "deterministic
+            # bug, stop retrying" on a failure mode the child already
+            # classified. Attempts are still consumed (bounded retries).
+            failures += 1
+            print(f"train_resilient: attempt {attempt} exited rc={rc} "
+                  f"(persistent_anomaly — the child exhausted its in-process "
+                  f"rollback ladder; last_step={last_step}, "
+                  f"ckpt_step={ckpt_step})", file=sys.stderr)
+            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                        attempt=attempt, rc=rc,
+                        classification="persistent_anomaly",
+                        last_step=last_step, ckpt_step=ckpt_step)
+            breaker.record(rc=rc, last_step=last_step, ckpt_step=ckpt_step,
+                           transient=True)
+            if attempt < args.max_attempts:
+                delay = supervision.backoff_seconds(
+                    failures, base=args.retry_sleep, cap=args.backoff_max,
+                    jitter=args.jitter)
+                print(f"train_resilient: backing off {delay:.1f}s",
+                      file=sys.stderr)
+                time.sleep(delay)
+            continue
+
         failures += 1
         classification = "hung" if hung else "crashed"
         print(f"train_resilient: attempt {attempt} exited rc={rc} "

@@ -140,3 +140,37 @@ def test_recovers_after_transient_failure(devices):
 def test_bench_wait_parsing(monkeypatch, raw, want):
     monkeypatch.setenv("BENCH_WAIT", raw)
     assert bench._bench_wait_budget_s() == want
+
+
+# ------------------------------------------------- roofline tagging ----
+# Not a BENCH_WAIT concern, but the same injected-bench-module seam: the
+# accum>1 roofline artifacts must carry the "accum-scaled-upper" tag
+# (accum-scaled flops/bytes make hbm_bw_util an upper bound — untagged,
+# they read as directly comparable roofline positions).
+
+
+def _roofline(chip="v5litepod-8", *, accum_scaled, flops=1.0e12):
+    out = {}
+    result = {"flops_per_step": flops, "bytes_per_step": 2.0e9,
+              "sec_per_step": 0.1}
+    bench._annotate_roofline(out, result, chip, 8,
+                             accum_scaled=accum_scaled)
+    return out
+
+
+def test_accum_scaled_roofline_is_tagged():
+    out = _roofline(accum_scaled=True)
+    assert out["roofline_bound"] == "accum-scaled-upper"
+    # the tag annotates, never replaces, the roofline numbers
+    assert "tflops_per_sec" in out and "arith_intensity" in out
+
+
+def test_unscaled_roofline_carries_no_tag():
+    out = _roofline(accum_scaled=False)
+    assert "roofline_bound" not in out
+    assert "tflops_per_sec" in out
+
+
+def test_roofline_tag_needs_a_cost_model():
+    # No XLA cost model (flops 0/None): nothing to scale, nothing to tag.
+    assert _roofline(accum_scaled=True, flops=0) == {}

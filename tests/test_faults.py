@@ -149,3 +149,70 @@ def test_corrupt_empty_dir_returns_none(tmp_path):
     d = tmp_path / "9"
     d.mkdir()
     assert faults.corrupt_checkpoint_dir(str(d)) is None
+
+
+# ------------------------------------- recovery-ladder fault kinds ----
+# loss_spike:N / repeat_nan:N:K / stall_infeed:S:N feed the in-process
+# recovery ladder (train/anomaly.py); the supervised end-to-end drills
+# live in tests/test_recovery_drills.py.
+
+
+def test_parse_recovery_kinds():
+    plan = faults.FaultPlan.parse(
+        "loss_spike:40, repeat_nan:30:5, stall_infeed:3s:4")
+    by_kind = {f.kind: f for f in plan.faults}
+    assert by_kind["loss_spike"].step == 40
+    assert by_kind["repeat_nan"].step == 30
+    assert by_kind["repeat_nan"].count == 5
+    assert by_kind["stall_infeed"].seconds == 3.0
+    assert by_kind["stall_infeed"].step == 4
+
+
+def test_parse_recovery_kind_errors():
+    with pytest.raises(ValueError, match="start:count"):
+        faults.FaultPlan.parse("repeat_nan:30")
+    with pytest.raises(ValueError, match="count >= 1"):
+        faults.FaultPlan.parse("repeat_nan:30:0")
+    with pytest.raises(ValueError, match="ordinal must be an integer"):
+        faults.FaultPlan.parse("stall_infeed:3s:soon")
+    with pytest.raises(ValueError, match="ordinal must be >= 1"):
+        faults.FaultPlan.parse("stall_infeed:3s:0")
+
+
+def test_repeat_nan_fires_on_every_step_in_range():
+    """repeat_nan:N:K poisons every step in [N, N+K) — including the
+    REPLAYED steps after a rollback lands the loop back before N. That
+    re-poisoning is what drives the ladder to max_rollbacks and the
+    distinct-rc escalation."""
+    plan = faults.FaultPlan.parse("repeat_nan:30:3")
+    assert plan.fire("step_begin", step=29) == []
+    for s in (30, 31):
+        assert [f.kind for f in plan.fire("step_begin", step=s)] == \
+            ["repeat_nan"]
+    # a rollback replays step 30: still inside the window, fires again
+    # (the budget is K total fires, not K distinct steps)
+    assert [f.kind for f in plan.fire("step_begin", step=30)] == \
+        ["repeat_nan"]
+    assert plan.faults[0].fired  # 3 fires consumed the K=3 budget
+    assert plan.fire("step_begin", step=31) == []
+
+
+def test_stall_infeed_ordinal_targets_nth_pull():
+    """The pull ordinal lets a drill stall INSIDE the step loop — pull 1
+    is the Trainer's build-time sample peek, which the watchdog does not
+    guard."""
+    from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
+
+    def make_iter(state):
+        while True:
+            yield {"x": np.zeros((2,), np.float32)}
+
+    ds = HostDataset(make_iter, element_spec={"x": ((2,), np.float32)})
+    faults.install("stall_infeed:0.2s:3")
+    for _ in range(2):  # pulls 1 and 2 are untouched
+        t0 = time.monotonic()
+        next(ds)
+        assert time.monotonic() - t0 < 0.15
+    t0 = time.monotonic()
+    next(ds)  # pull 3 stalls
+    assert time.monotonic() - t0 >= 0.2

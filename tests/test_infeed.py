@@ -69,3 +69,107 @@ def test_background_propagates_errors(devices):
 
     with pytest.raises(RuntimeError, match="boom"):
         next(it)
+
+
+# ------------------------------------------------------- watchdog ----
+# deadline_s > 0 arms the infeed watchdog: a pull that exceeds the
+# deadline raises InfeedStallError from next() while the pull keeps
+# running underneath — retrying resumes the SAME batch (never skipped,
+# never re-issued). Exercised here with a controllable stalling dataset;
+# the end-to-end Trainer retry rung is drilled in test_recovery_drills.py.
+
+import time
+
+import pytest
+
+from distributed_tensorflow_framework_tpu.data.infeed import InfeedStallError
+
+
+class _StallingDataset:
+    """Yields {"x": full(pull_ordinal)} batches; sleeps on chosen pulls."""
+
+    element_spec = {"x": ((8,), "float32")}
+
+    def __init__(self, stall_on=(), stall_s=0.0):
+        self.n = 0
+        self.stall_on = set(stall_on)
+        self.stall_s = stall_s
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self.n += 1
+        if self.n in self.stall_on:
+            time.sleep(self.stall_s)
+        return {"x": np.full((8,), float(self.n), np.float32)}
+
+    def state(self):
+        return {"n": self.n}
+
+
+def _value(item):
+    batch, _snap = item
+    return float(np.asarray(batch["x"])[0])
+
+
+def test_sync_watchdog_raises_and_resumes_same_pull(devices):
+    mesh = create_mesh(MeshConfig(data=8))
+    ds = _StallingDataset(stall_on={1}, stall_s=0.6)
+    it = prefetch_to_device(ds, mesh, size=1, deadline_s=0.1)
+    t0 = time.monotonic()
+    with pytest.raises(InfeedStallError) as ei:
+        next(it)
+    assert time.monotonic() - t0 < 0.5      # report, not a full wait
+    assert ei.value.deadline_s == 0.1
+    # The stalled pull is still in flight; once it completes, retries
+    # deliver batches 1, 2, 3 in order — nothing skipped or re-pulled.
+    time.sleep(0.7)
+    assert [_value(next(it)) for _ in range(3)] == [1.0, 2.0, 3.0]
+    assert ds.n == 3  # exactly the delivered pulls — none re-issued
+    it.close()
+
+
+def test_sync_watchdog_buffer_covers_stall(devices):
+    """A stall with batches still buffered is absorbed, not raised: the
+    lookahead exists precisely to ride out short pipeline hiccups."""
+    mesh = create_mesh(MeshConfig(data=8))
+    ds = _StallingDataset(stall_on={3}, stall_s=0.6)
+    it = prefetch_to_device(ds, mesh, size=2, deadline_s=0.1)
+    assert _value(next(it)) == 1.0          # fills pulls 1+2, pops 1
+    assert _value(next(it)) == 2.0          # pull 3 stalls — swallowed
+    with pytest.raises(InfeedStallError):
+        next(it)                            # buffer empty: now it surfaces
+    time.sleep(0.7)
+    assert _value(next(it)) == 3.0          # same pull, resumed
+    it.close()
+
+
+def test_background_watchdog_raises_then_recovers(devices):
+    mesh = create_mesh(MeshConfig(data=8))
+    ds = _StallingDataset(stall_on={2}, stall_s=0.6)
+    it = prefetch_to_device(ds, mesh, size=1, background=True,
+                            deadline_s=0.1)
+    assert _value(next(it)) == 1.0
+    stalls = 0
+    deadline = time.monotonic() + 5.0
+    while True:
+        try:
+            got = _value(next(it))
+            break
+        except InfeedStallError:
+            stalls += 1
+            assert time.monotonic() < deadline, "stall never cleared"
+    assert got == 2.0 and stalls >= 1
+    assert _value(next(it)) == 3.0
+    it.close()
+
+
+def test_zero_deadline_disables_watchdog(devices):
+    mesh = create_mesh(MeshConfig(data=8))
+    ds = _StallingDataset(stall_on={1}, stall_s=0.3)
+    it = prefetch_to_device(ds, mesh, size=1, deadline_s=0.0)
+    t0 = time.monotonic()
+    assert _value(next(it)) == 1.0          # blocks through the stall
+    assert time.monotonic() - t0 >= 0.3
+    it.close()

@@ -16,9 +16,14 @@ import pathlib
 TESTS_DIR = pathlib.Path(__file__).resolve().parent
 
 # Module-level names that mark a file as a subprocess-training-drill
-# module: the DRIVER template itself, or importing it from the fault
-# tolerance suite.
+# module: the DRIVER template itself, importing it from the fault
+# tolerance suite, or any specialized sibling template named *_DRIVER
+# (e.g. the recovery drills' RECOVERY_DRIVER).
 _DRIVER_NAME = "DRIVER"
+
+
+def _is_driver_name(name: str) -> bool:
+    return name == _DRIVER_NAME or name.endswith("_" + _DRIVER_NAME)
 
 
 def _decorator_marks(fn: ast.FunctionDef) -> set[str]:
@@ -38,10 +43,10 @@ def _defines_or_imports_driver(tree: ast.Module) -> bool:
     for node in tree.body:
         if isinstance(node, ast.Assign):
             for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == _DRIVER_NAME:
+                if isinstance(t, ast.Name) and _is_driver_name(t.id):
                     return True
         if isinstance(node, ast.ImportFrom):
-            if any(a.name == _DRIVER_NAME for a in node.names):
+            if any(_is_driver_name(a.name) for a in node.names):
                 return True
     return False
 
@@ -51,10 +56,10 @@ def _uses_driver(fn: ast.FunctionDef) -> bool:
     ``from ... import DRIVER``) — the signature of launching a real
     training child."""
     for node in ast.walk(fn):
-        if isinstance(node, ast.Name) and node.id == _DRIVER_NAME:
+        if isinstance(node, ast.Name) and _is_driver_name(node.id):
             return True
         if isinstance(node, ast.ImportFrom) and \
-                any(a.name == _DRIVER_NAME for a in node.names):
+                any(_is_driver_name(a.name) for a in node.names):
             return True
     return False
 
@@ -89,3 +94,6 @@ def test_audit_sees_the_known_drills():
                  and n.name == "test_supervised_crash_in_save_drill_async")
     assert _uses_driver(drill)
     assert {"slow", "slowest"} <= _decorator_marks(drill)
+    # Specialized *_DRIVER templates count too (recovery-ladder drills).
+    rd = ast.parse((TESTS_DIR / "test_recovery_drills.py").read_text())
+    assert _defines_or_imports_driver(rd)

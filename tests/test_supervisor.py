@@ -168,3 +168,58 @@ def test_crash_loop_breaker_halts_early(tmp_path):
     assert summary["recovery"]["supervisor_attempts"] == {"crashed": 2}
     assert summary["recovery"]["crash_loop"]["verdict"] == \
         "deterministic_crash_loop"
+
+
+# -------------------------------------- anomaly escalation (rc=85) ----
+
+
+def test_anomaly_rc_is_distinct():
+    rc = supervision.ANOMALY_ESCALATION_RC
+    assert rc != 0
+    assert rc != supervision.GRACEFUL_PREEMPT_RC
+    assert rc not in (130, 143)
+    assert not 128 <= rc <= 192  # never collides with 128+signal codes
+
+
+def test_crash_loop_breaker_transient_never_accumulates():
+    """transient=True (the rc=85 persistent-anomaly path) must never feed
+    the streak: the child already classified the failure, and an identical
+    signature N times over is expected while the run chews through a
+    poisoned data region."""
+    b = supervision.CrashLoopBreaker(threshold=2)
+    for _ in range(5):
+        assert not b.record(rc=85, last_step=30, ckpt_step=20,
+                            transient=True)
+    # a real crash right after still gets its full threshold
+    assert not b.record(rc=1, last_step=30, ckpt_step=20)
+    assert b.record(rc=1, last_step=30, ckpt_step=20)
+
+
+def test_persistent_anomaly_classified_without_burning_breaker(tmp_path):
+    """A child exiting ANOMALY_ESCALATION_RC repeatedly — more times than
+    --crash-loop-threshold — must be classified persistent_anomaly,
+    relaunched with backoff, and NEVER tripped as a crash loop; once the
+    child recovers, the supervisor exits 0."""
+    events = tmp_path / "supervisor_events.jsonl"
+    marker = str(tmp_path / "attempts.txt")
+    prog = (
+        "import os, sys\n"
+        "m = sys.argv[1]\n"
+        "n = int(open(m).read()) if os.path.exists(m) else 0\n"
+        "open(m, 'w').write(str(n + 1))\n"
+        f"sys.exit({supervision.ANOMALY_ESCALATION_RC} if n < 3 else 0)\n"
+    )
+    r = run(["--max-attempts", "10", "--retry-sleep", "0.05", "--jitter",
+             "0", "--crash-loop-threshold", "2", "--events", str(events),
+             "--", sys.executable, "-c", prog, marker])
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "persistent_anomaly" in r.stderr
+    assert "CRASH LOOP" not in r.stderr  # 3 identical rc=85 > threshold=2
+    assert "done (attempt 4)" in r.stderr
+
+    evs = list(telemetry.read_events(str(events), strict=True))
+    assert telemetry.KIND_CRASH_LOOP not in [e["kind"] for e in evs]
+    summary = telemetry.summarize_events(str(events))
+    assert summary["recovery"]["supervisor_attempts"] == {
+        "persistent_anomaly": 3, "done": 1}
+    assert summary["recovery"]["crash_loop"] is None

@@ -270,3 +270,51 @@ def test_nan_guard_provenance(tmp_path):
     assert math.isnan(float(h["value"]))
     assert h["last_good_checkpoint"] == expected_ckpt
     assert evs[0]["step"] == 9
+
+
+# ------------------------------------------- recovery-ladder rollups ----
+
+
+def test_summarize_counts_recovery_ladder_events(tmp_path):
+    """analyze_trace run summaries must account for every ladder rung:
+    anomalies, rollbacks, skipped batches, and infeed stall retries."""
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="ladder")
+    w.emit(telemetry.KIND_ANOMALY, step=30,
+           health={"anomaly": "non_finite_metric", "metric": "grad_norm",
+                   "value": "nan"})
+    w.emit(telemetry.KIND_ROLLBACK, step=30,
+           health={"from_step": 30, "to_step": 20,
+                   "consecutive_rollbacks": 1})
+    w.emit(telemetry.KIND_BATCH_SKIPPED, step=30,
+           health={"from_step": 21, "to_step": 30, "batches": 10})
+    for attempt in (1, 2, 3):
+        w.emit(telemetry.KIND_INFEED_STALL, step=12,
+               health={"deadline_s": 0.5, "attempt": attempt,
+                       "max_retries": 20})
+    w.close()
+
+    s = telemetry.summarize_events(path)
+    rec = s["recovery"]
+    assert rec["anomalies"] == [{"step": 30, "anomaly": "non_finite_metric",
+                                 "metric": "grad_norm"}]
+    assert rec["rollbacks"] == [{"from_step": 30, "to_step": 20}]
+    assert rec["batches_skipped"] == 10
+    assert rec["infeed_stalls"] == 3
+
+    text = telemetry.format_run_summary(s)
+    assert "anomaly at step 30: non_finite_metric (grad_norm)" in text
+    assert "rollback: step 30 -> 20" in text
+    assert "batches skipped: 10" in text
+    assert "infeed stalls retried: 3" in text
+
+
+def test_summarize_without_ladder_events_reports_none(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="quiet")
+    w.emit(telemetry.KIND_TRAIN_STEP, step=1, metrics={"loss": 1.0})
+    w.close()
+    s = telemetry.summarize_events(path)
+    assert s["recovery"]["anomalies"] == []
+    assert s["recovery"]["batches_skipped"] == 0
+    assert "recovery activity: none" in telemetry.format_run_summary(s)
