@@ -287,7 +287,10 @@ def _ragged_mlm_batch(batch_size: int, seq_len: int, pack: int) -> dict:
 def bench_bert(batch_size: int, steps: int = 20, warmup: int = 3,
                *, seq_len: int = 512, attention_impl: str = "pallas",
                remat: bool = False, pack: int = 0,
-               fused_qkv: bool = False, accum: int = 1) -> dict:
+               fused_qkv: bool = False, accum: int = 1,
+               pipeline_stages: int = 0, pipeline_schedule: str = "gpipe",
+               pipeline_microbatches: int = 0,
+               pipeline_virtual_stages: int = 0) -> dict:
     """BERT-base MLM train-step throughput — the transformer side of the
     perf story. Measured on v5e it saturates NEITHER roofline (MFU 17.9%
     base at seq 512): the step is dominated by per-optimizer-step fixed
@@ -297,22 +300,42 @@ def bench_bert(batch_size: int, steps: int = 20, warmup: int = 3,
     Knobs via env in main(): BENCH_ATTN (pallas|xla|ring), BENCH_REMAT=1,
     BENCH_SEQ=<len>, BENCH_BS=<per-chip batch>, BENCH_FUSED_QKV=1, BENCH_PACK
     (0 = dense synthetic rows; 1 = ragged docs unpacked — the padding
-    baseline; n>1 = same doc distribution packed n-to-1)."""
+    baseline; n>1 = same doc distribution packed n-to-1).
+    BENCH_PP=<stages> carves a pipe axis off the mesh (data = chips/stages)
+    for the pipeline-schedule A/B; BENCH_SCHEDULE (gpipe|1f1b|interleaved),
+    BENCH_MICRO=<microbatches>, BENCH_VIRTUAL=<v> pick the schedule
+    (docs/DISTRIBUTED.md)."""
+    import jax
+
     from distributed_tensorflow_framework_tpu.core.config import load_config
     from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
     from distributed_tensorflow_framework_tpu.data import get_dataset
     from distributed_tensorflow_framework_tpu.data.infeed import to_global
     from distributed_tensorflow_framework_tpu.train.step import StepBuilder
 
+    mesh_cfg = {}
+    if pipeline_stages:
+        n = jax.device_count()
+        if n % pipeline_stages:
+            raise ValueError(
+                f"BENCH_PP={pipeline_stages} does not divide the "
+                f"{n}-chip slice")
+        mesh_cfg = {"mesh": {"data": n // pipeline_stages,
+                             "pipe": pipeline_stages}}
     cfg = load_config(
         base={
             "name": "bench-bert",
+            **mesh_cfg,
             # configs/bert_base_mlm.yaml shapes (BASELINE config 5).
             "model": {"name": "bert", "vocab_size": 30522,
                       "hidden_size": 768, "num_layers": 12, "num_heads": 12,
                       "mlp_dim": 3072, "max_seq_len": seq_len,
                       "dtype": "bfloat16", "attention_impl": attention_impl,
-                      "remat": remat, "fused_qkv": fused_qkv},
+                      "remat": remat, "fused_qkv": fused_qkv,
+                      "pipeline_stages": pipeline_stages,
+                      "pipeline_schedule": pipeline_schedule,
+                      "pipeline_microbatches": pipeline_microbatches,
+                      "pipeline_virtual_stages": pipeline_virtual_stages},
             "data": {"name": "synthetic_mlm", "global_batch_size": batch_size,
                      "seq_len": seq_len},
             "optimizer": {"name": "adamw", "learning_rate": 1e-4,
@@ -360,6 +383,15 @@ def bench_bert(batch_size: int, steps: int = 20, warmup: int = 3,
     out["real_tokens_per_sec"] = real_tokens / out["sec_per_step"]
     out["docs_per_sec"] = docs / out["sec_per_step"]
     return out
+
+
+def _pp_bubble(schedule: str, stages: int, micro: int, virtual: int) -> float:
+    """Analytic bubble fraction for the bert pp bench (12 BERT-base
+    layers fixes the interleaved default v = 12/stages)."""
+    from distributed_tensorflow_framework_tpu.parallel import schedule as sched
+
+    v = sched.resolve_virtual(schedule, stages, micro, virtual, 12)
+    return sched.bubble_frac(schedule, stages, micro, v)
 
 
 def _annotate_roofline(out: dict, result: dict, chip: str, n_chips: int,
@@ -684,6 +716,12 @@ def _run(writer) -> int:
         # the fragmentation-lever candidate (models/bert.py).
         fused_qkv = os.environ.get("BENCH_FUSED_QKV", "0") not in ("", "0")
         accum = max(1, int(os.environ.get("BENCH_ACCUM", "1")))
+        # Pipeline-schedule A/B (docs/DISTRIBUTED.md): BENCH_PP carves a
+        # pipe axis; the schedule knobs only mean something with it set.
+        pp = int(os.environ.get("BENCH_PP", "0"))
+        pp_sched = os.environ.get("BENCH_SCHEDULE", "gpipe")
+        pp_micro = int(os.environ.get("BENCH_MICRO", "0"))
+        pp_virtual = int(os.environ.get("BENCH_VIRTUAL", "0"))
         ladder = _ladder_override(
             (64 * n_chips, 32 * n_chips, 16 * n_chips), n_chips)
         # Scale the ladder by accum so each micro-step keeps the ladder's
@@ -697,7 +735,11 @@ def _run(writer) -> int:
         result = _run_ladder(
             lambda bs: bench_bert(bs, seq_len=seq, attention_impl=attn,
                                   remat=remat, pack=pack,
-                                  fused_qkv=fused_qkv, accum=accum),
+                                  fused_qkv=fused_qkv, accum=accum,
+                                  pipeline_stages=pp,
+                                  pipeline_schedule=pp_sched,
+                                  pipeline_microbatches=pp_micro,
+                                  pipeline_virtual_stages=pp_virtual),
             ladder, metric, unit, chip, writer=writer)
         if result is None:
             return 1
@@ -716,6 +758,12 @@ def _run(writer) -> int:
             "remat": remat,
             "pack": pack,
             "grad_accum": accum,
+            **({"pipeline_stages": pp,
+                "pipeline_schedule": pp_sched,
+                "pipeline_microbatches": pp_micro or pp,
+                "pipe_bubble_frac": round(_pp_bubble(
+                    pp_sched, pp, pp_micro or pp, pp_virtual), 4)}
+               if pp else {}),
             "tokens_per_sec_per_chip": round(
                 result["tokens_per_sec"] / n_chips, 1),
             # Useful-token/doc throughput: what packing actually moves —

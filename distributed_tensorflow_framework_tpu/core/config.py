@@ -195,6 +195,28 @@ class ModelConfig:
     # mesh's pipe size) with microbatched GPipe scheduling.
     pipeline_stages: int = 1
     pipeline_microbatches: int = 0  # 0 → defaults to pipeline_stages
+    # Stage schedule (parallel/schedule.py):
+    #   "gpipe"       — circular fill-drain, backward from autodiff.
+    #                   Bubble (S-1)/(M+S-1); activation residency O(M+S).
+    #   "1f1b"        — hand-built one-forward-one-backward backward with
+    #                   per-stage recompute: same analytic bubble as
+    #                   gpipe, activation residency O(S) — the MEMORY
+    #                   schedule (buys more microbatches at a fixed
+    #                   activation budget, ~one extra forward of
+    #                   recompute in the backward pass).
+    #   "interleaved" — v virtual stages per device, round-robin layer
+    #                   assignment: bubble (S-1)/(v·M+S-1) — the
+    #                   THROUGHPUT schedule. Needs microbatches % stages
+    #                   == 0 and num_layers % (stages·v) == 0.
+    # Default "gpipe": zero behavior change for existing runs; the param
+    # tree is schedule-independent, so checkpoints are interchangeable
+    # across schedules.
+    pipeline_schedule: str = "gpipe"
+    # Virtual stages per device for pipeline_schedule="interleaved".
+    # 0 → defaults to num_layers // pipeline_stages (one layer per
+    # virtual chunk — the maximal bubble cut). Must be 0/1 for the other
+    # schedules.
+    pipeline_virtual_stages: int = 0
     # Rematerialize transformer encoder layers in the backward pass
     # (jax.checkpoint via nn.remat): trades ~30% more FLOPs for O(layers)
     # less activation memory — the lever for long-context / big-model
@@ -464,6 +486,11 @@ def load_config(
                 and "num_classes" not in sec):
             sec["num_classes"] = 1000
     cfg = _build(ExperimentConfig, data)
+    if cfg.model.pipeline_schedule not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError(
+            "model.pipeline_schedule must be 'gpipe', '1f1b' or "
+            f"'interleaved', got {cfg.model.pipeline_schedule!r}"
+        )
     res = cfg.resilience
     if res.snapshot_depth < 1:
         raise ValueError(

@@ -80,6 +80,12 @@ KIND_ANOMALY = "anomaly_detected"
 KIND_ROLLBACK = "rollback"
 KIND_BATCH_SKIPPED = "batch_skipped"
 KIND_INFEED_STALL = "infeed_stall"
+# One per pipelined run (docs/DISTRIBUTED.md): the resolved pipeline
+# schedule — name, stages/microbatches/virtual stages, analytic bubble
+# fraction and peak activation residency — so a trace or step-time rollup
+# can be read against the schedule that produced it. The per-step
+# ``pipe_bubble_frac`` metric rides in ordinary train_step events.
+KIND_PIPELINE = "pipeline_schedule"
 
 
 def make_run_id() -> str:
@@ -318,6 +324,8 @@ def summarize_events(path: str) -> dict:
         "blocked_ms_max": 0.0, "total_ms_max": 0.0,
     }
     startups: list[dict] = []
+    pipeline: dict | None = None
+    step_rates: list[float] = []
     for ev in read_events(path, strict=False):
         kind = ev["kind"]
         kinds[kind] = kinds.get(kind, 0) + 1
@@ -372,8 +380,23 @@ def summarize_events(path: str) -> dict:
                 "time_to_first_step_s": extra.get("time_to_first_step_s"),
                 "restored_step": extra.get("restored_step"),
             })
+        elif kind == KIND_PIPELINE:
+            pipeline = dict(extra)
+        elif kind == KIND_TRAIN_STEP:
+            m = ev.get("metrics") or {}
+            if pipeline is not None and "pipe_bubble_frac" in m:
+                pipeline["bubble_frac_logged"] = float(m["pipe_bubble_frac"])
+            rate = (ev.get("throughput") or {}).get("examples_per_sec")
+            if isinstance(rate, (int, float)):
+                step_rates.append(float(rate))
         if health.get("event") == "graceful_preemption":
             preemptions += 1
+    if pipeline is not None and step_rates:
+        # Steady-state throughput: median over the back half of the
+        # logged steps, past the compile/warmup ramp — the measured
+        # number the analytic bubble_frac should explain.
+        tail = sorted(step_rates[len(step_rates) // 2:])
+        pipeline["steady_examples_per_sec"] = tail[len(tail) // 2]
     return {
         "path": path,
         "run_ids": run_ids,
@@ -383,6 +406,7 @@ def summarize_events(path: str) -> dict:
         "last_step": last_step,
         "ckpt_saves": saves,
         "startups": startups,
+        "pipeline": pipeline,
         "recovery": {
             "quarantined": quarantined,
             "restore_fallbacks": fallbacks,
@@ -424,6 +448,22 @@ def format_run_summary(summary: dict) -> str:
                 bmax=saves["blocked_ms_max"], tmax=saves["total_ms_max"],
             )
         )
+    pipe = summary.get("pipeline")
+    if pipe:
+        bits = [
+            f"{pipe.get('schedule', '?')} "
+            f"S={pipe.get('stages', '?')} M={pipe.get('microbatches', '?')}"
+        ]
+        if (pipe.get("virtual_stages") or 1) > 1:
+            bits.append(f"v={pipe['virtual_stages']}")
+        if pipe.get("bubble_frac") is not None:
+            bits.append(f"bubble {float(pipe['bubble_frac']):.4f}")
+        if pipe.get("peak_inflight") is not None:
+            bits.append(f"residency {pipe['peak_inflight']:g} acts")
+        if pipe.get("steady_examples_per_sec") is not None:
+            bits.append(
+                f"steady {float(pipe['steady_examples_per_sec']):.1f} ex/s")
+        lines.append("  pipeline: " + ", ".join(bits))
     for s in summary.get("startups") or []:
         t = s.get("time_to_first_step_s")
         t_str = f"{t:.1f}s" if isinstance(t, (int, float)) else "?"

@@ -182,6 +182,8 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
                 num_microbatches=config.pipeline_microbatches,
                 attention_impl=config.attention_impl,
                 fused_qkv=config.fused_qkv,
+                schedule=config.pipeline_schedule,
+                virtual_stages=config.pipeline_virtual_stages,
             )
         from distributed_tensorflow_framework_tpu.models.bert import BertForMLM
 

@@ -140,11 +140,16 @@ class EncoderLayer(nn.Module):
         )(x, mask, segment_ids)
         attn = nn.Dropout(self.dropout_rate, deterministic=not train)(attn)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x + attn)
-        aux_loss = jnp.zeros((), jnp.float32)
+        # Aux outputs are a type-stable dict either way (zeros for the
+        # dense FFN) so callers — including nn.remat'd instances, whose
+        # return values are the ONLY thing that survives the checkpoint
+        # boundary — never branch on the layer flavor.
+        aux = {k: jnp.zeros((), jnp.float32)
+               for k in ("aux_loss", "zloss", "drop_frac")}
         if self.num_experts > 0:
             from distributed_tensorflow_framework_tpu.models.moe import MoEMlp
 
-            y, aux_loss = MoEMlp(
+            y, aux = MoEMlp(
                 num_experts=self.num_experts, mlp_dim=self.mlp_dim,
                 topk=self.expert_topk, capacity_factor=self.capacity_factor,
                 dispatch_impl=self.moe_dispatch,
@@ -158,7 +163,7 @@ class EncoderLayer(nn.Module):
             y = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=jnp.float32,
                          kernel_init=dense_kernel_init, name="mlp_out")(y)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
-        return nn.LayerNorm(dtype=jnp.float32, name="ln2")(x + y), aux_loss
+        return nn.LayerNorm(dtype=jnp.float32, name="ln2")(x + y), aux
 
 
 class BertEmbed(nn.Module):
@@ -274,6 +279,8 @@ class BertForMLM(nn.Module):
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
         aux_total = jnp.zeros((), jnp.float32)
+        zloss_total = jnp.zeros((), jnp.float32)
+        drop_total = jnp.zeros((), jnp.float32)
         n_moe = 0
         # argnums of EncoderLayer.__call__: 0=self, 1=x, 2=mask, 3=train —
         # train branches Python-side (Dropout determinism) so it must stay
@@ -299,14 +306,22 @@ class BertForMLM(nn.Module):
                 name=f"layer{i}",
             )(x, mask, train, segment_ids)
             if use_moe:
-                aux_total = aux_total + aux
+                aux_total = aux_total + aux["aux_loss"]
+                zloss_total = zloss_total + aux["zloss"]
+                drop_total = drop_total + aux["drop_frac"]
                 n_moe += 1
 
         logits = MLMHead(self.vocab_size, self.hidden_size, self.dtype,
                          name="head")(x, emb_table)
         if self.num_experts > 0:
-            return {
+            out = {
                 "logits": logits,
                 "moe_aux_loss": aux_total / max(n_moe, 1),
+                "moe_drop_frac": drop_total / max(n_moe, 1),
             }
+            if self.moe_zloss_weight:
+                # Only when armed — matches the metric's conditional
+                # presence in the step output (train/step.py).
+                out["moe_zloss"] = zloss_total / max(n_moe, 1)
+            return out
         return logits

@@ -260,7 +260,6 @@ class MoEMlp(nn.Module):
             # small near uniform logits.
             z = jax.scipy.special.logsumexp(gate_logits, axis=-1)  # (B,S)
             zloss = jnp.mean(jnp.square(z))
-            self.sow("intermediates", "moe_zloss", zloss)
 
         wi = self.param("wi", expert_kernel_init, (e, h, self.mlp_dim),
                         jnp.float32)
@@ -293,8 +292,7 @@ class MoEMlp(nn.Module):
             (token_table, table_valid, expert_a, pos_a, combine_w,
              aux_loss) = topk_dispatch_sorted(gate_logits, self.topk,
                                               capacity)
-            self.sow("intermediates", "moe_drop_frac",
-                     1.0 - table_valid.sum() / (b * s * self.topk))
+            drop_frac = 1.0 - table_valid.sum() / (b * s * self.topk)
             # Dispatch: gather each expert's claimed tokens from x —
             # (B,E,C,H), the all_to_all site under dp+ep sharding (tokens
             # move from data shards to expert shards), with no
@@ -308,13 +306,9 @@ class MoEMlp(nn.Module):
                 gate_logits, self.topk, capacity
             )
             # Router overflow diagnostic: fraction of the B·S·topk
-            # assignments dropped by the static capacity. Sown (not
-            # returned) so the layer signature stays stable; retrieve with
-            # ``apply(..., mutable=["intermediates"])`` when debugging a
-            # capacity_factor choice — persistently high drop means the
-            # gate is imbalanced or cf is too tight.
-            self.sow("intermediates", "moe_drop_frac",
-                     1.0 - dispatch.sum() / (b * s * self.topk))
+            # assignments dropped by the static capacity — persistently
+            # high drop means the gate is imbalanced or cf is too tight.
+            drop_frac = 1.0 - dispatch.sum() / (b * s * self.topk)
             # (B,S,E,C) × (B,S,H) → (B,E,C,H): the all_to_all site.
             xe = jnp.einsum("bsec,bsh->bech", dispatch.astype(self.dtype),
                             x.astype(self.dtype))
@@ -347,4 +341,15 @@ class MoEMlp(nn.Module):
         else:
             out = jnp.einsum("bsec,bech->bsh", combine.astype(self.dtype),
                              oe)
-        return out, aux_loss + self.zloss_weight * zloss
+        # Metrics ride the return value as EXPLICIT aux outputs (not sown
+        # intermediates): return values thread through jax.checkpoint —
+        # ``model.remat=true`` keeps moe_drop_frac/moe_zloss observable,
+        # where sown intermediates are silently dropped in replayed
+        # segments. ``aux_loss`` is the loss-side term (balance aux PLUS
+        # the weighted z term — the contract core/config.py documents);
+        # zloss/drop_frac are diagnostics.
+        return out, {
+            "aux_loss": aux_loss + self.zloss_weight * zloss,
+            "zloss": zloss,
+            "drop_frac": drop_frac,
+        }

@@ -125,6 +125,29 @@ class Trainer:
             mesh={k: int(v) for k, v in self.mesh.shape.items()},
             process_count=self.runtime.process_count,
         )
+        stages = int(getattr(self.config.model, "pipeline_stages", 0) or 0)
+        if stages > 0:
+            # One record of the resolved schedule so step-time rollups
+            # (telemetry.summarize_events) read against the right bubble.
+            from distributed_tensorflow_framework_tpu.parallel import (
+                schedule as pipe_sched,
+            )
+
+            name = self.config.model.pipeline_schedule
+            micro = (self.config.model.pipeline_microbatches or stages)
+            virtual = pipe_sched.resolve_virtual(
+                name, stages, micro,
+                self.config.model.pipeline_virtual_stages,
+                self.config.model.num_layers)
+            self.writer.telemetry.emit(
+                telemetry.KIND_PIPELINE,
+                schedule=name, stages=stages, microbatches=micro,
+                virtual_stages=virtual,
+                bubble_frac=pipe_sched.bubble_frac(
+                    name, stages, micro, virtual),
+                peak_inflight=pipe_sched.peak_inflight(
+                    name, stages, micro, virtual),
+            )
         # Peek one batch for shapes, then restore the stream to the start.
         start_state = self.dataset.state()
         host_batch = next(self.dataset)

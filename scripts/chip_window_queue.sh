@@ -71,4 +71,14 @@ if run wk-verify-4096 python scripts/verify_fused_bwd.py 4096; then
   run wk4096-two   env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=4096 BENCH_BS=8 FLASH_FUSED_WHOLE_K_MIN=1000000000 python bench.py
 fi
 
+# 8. Pipeline-schedule A/B on a dp+pp mesh (docs/DISTRIBUTED.md): same
+#    mesh and microbatch budget — gpipe (bubble 3/11 at S=4,M=8) vs 1F1B
+#    (same analytic bubble, O(S) activation residency) vs interleaved
+#    (v=12/4=3 → bubble 3/27). Re-probe the tunnel with the stock bench
+#    first so a backend that died mid-window fails cheap, not mid-A/B.
+run pp-sanity python bench.py
+run pp-gpipe       env BENCH_WORKLOAD=bert BENCH_PP=4 BENCH_MICRO=8 BENCH_SCHEDULE=gpipe python bench.py
+run pp-1f1b        env BENCH_WORKLOAD=bert BENCH_PP=4 BENCH_MICRO=8 BENCH_SCHEDULE=1f1b python bench.py
+run pp-interleaved env BENCH_WORKLOAD=bert BENCH_PP=4 BENCH_MICRO=8 BENCH_SCHEDULE=interleaved python bench.py
+
 echo "=== chip queue done $(date -u +%FT%TZ) ==="

@@ -50,7 +50,10 @@ def test_moe_mlp_forward_shape():
     vars_ = layer.init(jax.random.key(0), x)
     out, aux = layer.apply(vars_, x)
     assert out.shape == x.shape
-    assert np.isfinite(float(aux))
+    # Aux is an explicit output dict (loss term + diagnostics) — the
+    # remat-safe metric contract (models/moe.py).
+    assert set(aux) == {"aux_loss", "zloss", "drop_frac"}
+    assert np.isfinite(float(aux["aux_loss"]))
     assert vars_["params"]["wi"].shape == (4, 32, 64)
     assert vars_["params"]["wo"].shape == (4, 64, 32)
 
@@ -126,7 +129,7 @@ def test_top1_router_gets_task_gradient():
     vars_ = layer.init(jax.random.key(0), x)
 
     def task_loss(params):
-        out, _ = layer.apply({"params": params}, x)
+        out, _aux = layer.apply({"params": params}, x)
         return (out ** 2).sum()
 
     g = jax.grad(task_loss)(vars_["params"])
@@ -196,18 +199,17 @@ def test_sorted_moe_layer_parity_with_dense(topk):
     dense, sorted_ = build("dense"), build("sorted")
     vars_ = dense.init(jax.random.key(0), x)
 
-    (out_d, aux_d), int_d = dense.apply(vars_, x, mutable=["intermediates"])
-    (out_s, aux_s), int_s = sorted_.apply(vars_, x,
-                                          mutable=["intermediates"])
+    out_d, aux_d = dense.apply(vars_, x)
+    out_s, aux_s = sorted_.apply(vars_, x)
     np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_d),
                                rtol=1e-5, atol=1e-5)
-    assert np.isclose(float(aux_s), float(aux_d), atol=1e-6)
-    assert np.isclose(float(jax.tree.leaves(int_s["intermediates"])[0]),
-                      float(jax.tree.leaves(int_d["intermediates"])[0]))
+    assert np.isclose(float(aux_s["aux_loss"]), float(aux_d["aux_loss"]),
+                      atol=1e-6)
+    assert np.isclose(float(aux_s["drop_frac"]), float(aux_d["drop_frac"]))
 
     def loss(params, layer):
         out, aux = layer.apply({"params": params}, x)
-        return jnp.sum(out ** 2) + 0.01 * aux
+        return jnp.sum(out ** 2) + 0.01 * aux["aux_loss"]
 
     g_d = jax.grad(loss)(vars_["params"], dense)
     g_s = jax.grad(loss)(vars_["params"], sorted_)
@@ -220,9 +222,8 @@ def test_sorted_moe_layer_parity_with_dense(topk):
 
 
 def test_drop_frac_diagnostic(devices):
-    """The sown router-overflow diagnostic: zero drops at generous
-    capacity, positive at a starved one, retrievable via mutable
-    intermediates (and absent from a plain apply)."""
+    """The router-overflow diagnostic rides the explicit aux dict: zero
+    drops at generous capacity, positive at a starved one."""
     from distributed_tensorflow_framework_tpu.models.moe import MoEMlp
 
     x = jnp.asarray(
@@ -232,15 +233,9 @@ def test_drop_frac_diagnostic(devices):
         m = MoEMlp(num_experts=4, mlp_dim=16, topk=1,
                    capacity_factor=capacity_factor, dtype=jnp.float32)
         vs = m.init(jax.random.key(0), x)
-        (out, aux), inter = m.apply(
-            vs, x, mutable=["intermediates"])
-        leaves = jax.tree.leaves(inter["intermediates"])
-        assert len(leaves) == 1
-        # Plain apply keeps the stable two-tuple return — the sow never
-        # leaks into the call signature.
-        out2, aux2 = m.apply(vs, x)
-        assert out2.shape == out.shape
-        return float(leaves[0])
+        out, aux = m.apply(vs, x)
+        assert out.shape == x.shape
+        return float(aux["drop_frac"])
 
     assert drop_frac(4.0) == 0.0          # room for every token
     assert drop_frac(0.25) > 0.2          # starved capacity drops plenty
@@ -288,8 +283,10 @@ def test_router_zloss_knob():
     _, aux_off = base.apply(vars_, x)
     _, aux_on = armed.apply(vars_, x)
     # Same params → the difference IS 0.1 * zloss, and zloss > 0.
-    zloss = (float(aux_on) - float(aux_off)) / 0.1
+    zloss = (float(aux_on["aux_loss"]) - float(aux_off["aux_loss"])) / 0.1
     assert zloss > 0.0
+    # The armed layer also reports the raw z term in the aux dict.
+    np.testing.assert_allclose(float(aux_on["zloss"]), zloss, rtol=1e-5)
     # Verify against a direct recomputation of the definition.
     gate_k = vars_["params"]["gate"]["kernel"]
     logits = x.astype(jnp.float32) @ gate_k
@@ -303,7 +300,54 @@ def test_router_zloss_knob():
     big["params"]["gate"]["kernel"] = gate_k * 3.0
     _, aux_big = armed.apply(big, x)
     _, aux_big_off = base.apply(big, x)
-    assert (float(aux_big) - float(aux_big_off)) > 0.1 * zloss
+    assert (float(aux_big["aux_loss"])
+            - float(aux_big_off["aux_loss"])) > 0.1 * zloss
+
+
+def test_moe_metrics_survive_remat():
+    """moe_drop_frac / moe_zloss must stay observable with model.remat=true:
+    they are explicit model outputs threaded through jax.checkpoint, not
+    sown intermediates (which die in replayed segments)."""
+    from distributed_tensorflow_framework_tpu.models.bert import BertForMLM
+
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(1, 64, (2, 16)), jnp.int32)
+
+    def build(remat):
+        return BertForMLM(
+            vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+            mlp_dim=32, max_seq_len=16, dropout_rate=0.0, dtype=jnp.float32,
+            num_experts=4, moe_every=2, capacity_factor=0.5,  # forces drops
+            moe_zloss_weight=0.1, remat=remat)
+
+    plain, remat = build(False), build(True)
+    vars_ = plain.init({"params": jax.random.key(0)}, ids)
+    out_p = plain.apply(vars_, ids, train=False)
+    out_r = remat.apply(vars_, ids, train=False)
+
+    for key in ("logits", "moe_aux_loss", "moe_drop_frac", "moe_zloss"):
+        assert key in out_r, f"{key} missing under remat"
+        np.testing.assert_allclose(
+            np.asarray(out_r[key]), np.asarray(out_p[key]),
+            rtol=1e-6, atol=1e-6, err_msg=f"remat changed {key}")
+    # Correctness, not just presence: the starved capacity really drops.
+    assert 0.0 < float(out_r["moe_drop_frac"]) <= 1.0
+    assert float(out_r["moe_zloss"]) > 0.0
+
+    # Gradients flow identically through the remat'd metric outputs.
+    def loss(params, model):
+        out = model.apply({"params": params}, ids, train=False)
+        from distributed_tensorflow_framework_tpu.train import losses
+        return losses.mlm_loss(out["logits"], ids)[0] + 0.01 * out["moe_aux_loss"]
+
+    g_p = jax.grad(loss)(vars_["params"], plain)
+    g_r = jax.grad(loss)(vars_["params"], remat)
+    for (kp, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(g_p),
+            jax.tree_util.tree_leaves_with_path(g_r)):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-5, atol=1e-6,
+            err_msg=f"remat grad mismatch at {kp}")
 
 
 def test_vocab_mismatch_rejected(moe_cfg):

@@ -318,3 +318,45 @@ def test_summarize_without_ladder_events_reports_none(tmp_path):
     assert s["recovery"]["anomalies"] == []
     assert s["recovery"]["batches_skipped"] == 0
     assert "recovery activity: none" in telemetry.format_run_summary(s)
+
+
+def test_summarize_pipeline_schedule_rollup(tmp_path):
+    """A pipeline_schedule event plus train_step events roll up into the
+    pipeline section: schedule identity, analytic bubble, the per-step
+    logged bubble, and steady-state throughput (median of the back half
+    of logged rates, past the compile ramp)."""
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="pp")
+    w.emit(telemetry.KIND_PIPELINE, schedule="1f1b", stages=4,
+           microbatches=8, virtual_stages=1,
+           bubble_frac=3 / 11, peak_inflight=7.0)
+    rates = [2.0, 9.0, 13.0, 14.0, 13.9, 14.1]  # slow compile-step head
+    for i, r in enumerate(rates):
+        w.emit(telemetry.KIND_TRAIN_STEP, step=i * 10,
+               metrics={"loss": 5.0, "pipe_bubble_frac": 3 / 11},
+               throughput={"examples_per_sec": r})
+    w.close()
+
+    pipe = telemetry.summarize_events(path)["pipeline"]
+    assert pipe["schedule"] == "1f1b"
+    assert pipe["stages"] == 4
+    assert pipe["bubble_frac"] == pytest.approx(3 / 11)
+    assert pipe["bubble_frac_logged"] == pytest.approx(3 / 11)
+    assert pipe["steady_examples_per_sec"] == pytest.approx(14.0)
+
+    text = telemetry.format_run_summary(
+        telemetry.summarize_events(path))
+    assert "pipeline: 1f1b S=4 M=8" in text
+    assert "bubble 0.2727" in text
+    assert "residency 7 acts" in text
+    assert "steady 14.0 ex/s" in text
+
+
+def test_summarize_without_pipeline_events(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="nopipe")
+    w.emit(telemetry.KIND_TRAIN_STEP, step=1, metrics={"loss": 1.0})
+    w.close()
+    s = telemetry.summarize_events(path)
+    assert s["pipeline"] is None
+    assert "pipeline:" not in telemetry.format_run_summary(s)
