@@ -124,6 +124,15 @@ def _compile_and_time(builder, state, batch, steps: int, warmup: int) -> dict:
     }
 
 
+def _mesh_axes(mesh) -> dict:
+    """Mesh tag for bench records: non-trivial axis sizes ({data:1} when
+    fully trivial) — so artifacts from different topologies are never read
+    as comparable rates (ISSUE 6: throughput at {data:8} vs {fsdp:2,pipe:4}
+    is a different experiment, not a regression)."""
+    axes = {a: int(s) for a, s in mesh.shape.items() if int(s) > 1}
+    return axes or {"data": 1}
+
+
 def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
                    model_overrides: dict | None = None) -> dict:
     import numpy as np
@@ -191,6 +200,7 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
     state = builder.init_state(0, batch)
     out = _compile_and_time(builder, state, batch, steps, warmup)
     out["images_per_sec"] = batch_size / out["sec_per_step"]
+    out["mesh_axes"] = _mesh_axes(mesh)
     return out
 
 
@@ -240,6 +250,7 @@ def bench_inception(batch_size: int, steps: int = 20, warmup: int = 3) -> dict:
     state = builder.init_state(0, batch)
     out = _compile_and_time(builder, state, batch, steps, warmup)
     out["images_per_sec"] = batch_size / out["sec_per_step"]
+    out["mesh_axes"] = _mesh_axes(mesh)
     return out
 
 
@@ -382,6 +393,7 @@ def bench_bert(batch_size: int, steps: int = 20, warmup: int = 3,
     out["tokens_per_sec"] = batch_size * seq_len / out["sec_per_step"]
     out["real_tokens_per_sec"] = real_tokens / out["sec_per_step"]
     out["docs_per_sec"] = docs / out["sec_per_step"]
+    out["mesh_axes"] = _mesh_axes(mesh)
     return out
 
 
@@ -753,6 +765,7 @@ def _run(writer) -> int:
             "baseline_kind": "none",
             "chip": chip,
             "num_chips": n_chips,
+            "mesh_axes": result.get("mesh_axes"),
             "seq_len": seq,
             "attention_impl": attn,
             "remat": remat,
@@ -799,6 +812,7 @@ def _run(writer) -> int:
             "baseline_kind": "none",
             "chip": chip,
             "num_chips": n_chips,
+            "mesh_axes": result.get("mesh_axes"),
             "run_id": writer.run_id,
         }
         _annotate_roofline(out, result, chip, n_chips)
@@ -827,6 +841,7 @@ def _run(writer) -> int:
         "baseline_value": TARGET_PER_CHIP,
         "chip": chip,
         "num_chips": n_chips,
+        "mesh_axes": result.get("mesh_axes"),
         "run_id": writer.run_id,
     }
     _annotate_roofline(out, result, chip, n_chips)

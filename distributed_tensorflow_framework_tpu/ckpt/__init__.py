@@ -7,3 +7,6 @@ from distributed_tensorflow_framework_tpu.ckpt.async_saver import (  # noqa: F40
 from distributed_tensorflow_framework_tpu.ckpt.checkpoint import (  # noqa: F401
     CheckpointManager,
 )
+from distributed_tensorflow_framework_tpu.ckpt.reshard import (  # noqa: F401
+    MeshTopologyError,
+)

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
 from distributed_tensorflow_framework_tpu.ckpt import manifest as mf
+from distributed_tensorflow_framework_tpu.ckpt import reshard
 from distributed_tensorflow_framework_tpu.ckpt.async_saver import AsyncSaver
 from distributed_tensorflow_framework_tpu.core import faults, telemetry
 from distributed_tensorflow_framework_tpu.core.config import CheckpointConfig
@@ -99,11 +100,18 @@ def _attention_layout(key_names: set[str]) -> str | None:
 
 class CheckpointManager:
     def __init__(self, config: CheckpointConfig, *, is_chief: bool = True,
-                 telemetry_writer: telemetry.TelemetryWriter | None = None):
+                 telemetry_writer: telemetry.TelemetryWriter | None = None,
+                 mesh=None, process_count: int | None = None):
+        """``mesh``/``process_count`` identify the topology this manager
+        saves under (recorded in every manifest commit record,
+        ckpt/reshard.py); when omitted they are derived from the state's
+        own shardings at save time."""
         if not config.directory:
             raise ValueError("CheckpointConfig.directory must be set")
         self.config = config
         self.is_chief = is_chief
+        self._mesh = mesh
+        self._process_count = process_count
         self._telemetry = telemetry_writer
         path = self._path = os.path.abspath(config.directory)
         os.makedirs(path, exist_ok=True)
@@ -136,7 +144,8 @@ class CheckpointManager:
 
     def _write_and_commit(self, step: int, packed_state: Any,
                           dataset_state: dict | None, *, force: bool,
-                          t_begin: float, blocked_s: float | None) -> bool:
+                          t_begin: float, blocked_s: float | None,
+                          topology: dict | None = None) -> bool:
         """The full durable commit sequence — orbax write, fault points,
         manifest hash + fsync + atomic rename, telemetry. Runs on the
         saver thread (async) or inline (sync fallback); identical either
@@ -158,7 +167,9 @@ class CheckpointManager:
             # it fires on the saver thread (SIGKILL still takes the whole
             # process — core/faults.py).
             faults.fire("ckpt_in_save", step=step)
-            mf.write_manifest(step_dir, step)
+            mf.write_manifest(
+                step_dir, step,
+                extra={reshard.MESH_RECORD_KEY: topology} if topology else None)
             for fault in faults.fire("ckpt_committed", step=step):
                 if fault.kind == "corrupt_ckpt":
                     faults.corrupt_checkpoint_dir(step_dir)
@@ -190,10 +201,15 @@ class CheckpointManager:
         self._drain()  # a new save waits for the previous commit
         if step in self._mgr.all_steps():
             return False  # already saved (e.g. final save on an interval step)
+        # Topology record for the manifest (ckpt/reshard.py): computed from
+        # the LIVE sharded state, before any device→host snapshot (the host
+        # copy no longer carries NamedShardings).
+        topology = reshard.state_topology(
+            state, mesh=self._mesh, process_count=self._process_count)
         if self._saver is None:
             return self._write_and_commit(
                 step, _pack(state), dataset_state, force=force,
-                t_begin=t0, blocked_s=None)
+                t_begin=t0, blocked_s=None, topology=topology)
         # Async: the training thread pays only the device→host snapshot.
         # device_get also syncs on the step that produced `state`, so the
         # snapshot is taken at a well-defined step boundary; the loop may
@@ -208,7 +224,7 @@ class CheckpointManager:
         self._saver.submit(
             lambda: self._write_and_commit(
                 step, host_state, ds_state, force=force,
-                t_begin=t0, blocked_s=blocked_s),
+                t_begin=t0, blocked_s=blocked_s, topology=topology),
             step=step)
         return True
 
@@ -265,6 +281,17 @@ class CheckpointManager:
         if step is None:
             return None
         self._check_attention_layout(step, template)
+        # Topology gate (ckpt/reshard.py): same mesh → normal restore;
+        # different mesh → typed MeshTopologyError unless
+        # checkpoint.allow_reshard, in which case orbax restores into the
+        # template's (new-mesh) shardings and the plan is validated +
+        # telemetered below. Runs AFTER integrity verification — a torn
+        # step must quarantine, not "reshard".
+        saved_topo = (mf.read_manifest(os.path.join(self._path, str(step)))
+                      or {}).get(reshard.MESH_RECORD_KEY)
+        reshard_plan = reshard.check_restore_topology(
+            saved_topo, template, allow_reshard=self.config.allow_reshard,
+            directory=self._path, step=step)
 
         want_ema = bool(jax.tree.leaves(template.ema_params))
 
@@ -314,6 +341,27 @@ class CheckpointManager:
             stored_ema = not stored_ema
             tmpl = tmpl_for(stored_ema)
             restored = attempt(tmpl)
+        if reshard_plan is not None:
+            # Cross-mesh load succeeded mechanically; confirm it moved
+            # bytes without reshaping them, then record the reshard in the
+            # run's event stream (analyze_trace.py surfaces it).
+            leaf_count = reshard.validate_restored(
+                _pack(tmpl), restored["state"], step=step)
+            self._emit(
+                telemetry.KIND_CKPT_RESHARDED, step=step,
+                from_axes=reshard_plan["from_axes"],
+                to_axes=reshard_plan["to_axes"],
+                leaf_count=leaf_count,
+                from_spec_digest=reshard_plan["from_spec_digest"],
+                to_spec_digest=reshard_plan["to_spec_digest"],
+                respec_agreement=reshard_plan["respec_agreement"],
+            )
+            log.warning(
+                "restored checkpoint step %d RESHARDED %s -> %s "
+                "(%d leaves validated)", step,
+                reshard.describe_axes(reshard_plan["from_axes"]),
+                reshard.describe_axes(reshard_plan["to_axes"]), leaf_count,
+            )
         state = _unpack(restored["state"], tmpl)
         if want_ema and not stored_ema:
             # Real copies, not aliases: params and ema_params both live in

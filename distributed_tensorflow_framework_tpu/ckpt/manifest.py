@@ -80,7 +80,11 @@ def iter_payload_files(step_dir: str):
             yield rel
 
 
-def build_manifest(step_dir: str, step: int) -> dict:
+def build_manifest(step_dir: str, step: int,
+                   extra: dict | None = None) -> dict:
+    """``extra`` merges additional commit-record fields (e.g. the saver's
+    mesh topology, ckpt/reshard.py) without touching the reserved keys —
+    readers of legacy manifests simply see them absent."""
     files = {}
     for rel in iter_payload_files(step_dir):
         path = os.path.join(step_dir, rel)
@@ -88,16 +92,22 @@ def build_manifest(step_dir: str, step: int) -> dict:
             "sha256": file_sha256(path),
             "bytes": os.path.getsize(path),
         }
-    return {
+    manifest = {
         "schema": MANIFEST_SCHEMA,
         "step": int(step),
         "created_t": time.time(),
         "file_count": len(files),
         "files": files,
     }
+    for key, value in (extra or {}).items():
+        if key in manifest:
+            raise ValueError(f"extra manifest field {key!r} is reserved")
+        manifest[key] = value
+    return manifest
 
 
-def write_manifest(step_dir: str, step: int) -> str:
+def write_manifest(step_dir: str, step: int,
+                   extra: dict | None = None) -> str:
     """Hash the step directory and atomically commit its manifest.
 
     tmp + fsync + rename, then fsync the directory so the rename itself is
@@ -105,7 +115,7 @@ def write_manifest(step_dir: str, step: int) -> str:
     break (a kill before the rename leaves NO manifest → the step reads as
     uncommitted, never as half-committed).
     """
-    manifest = build_manifest(step_dir, step)
+    manifest = build_manifest(step_dir, step, extra)
     path = os.path.join(step_dir, MANIFEST_NAME)
     tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w") as fh:

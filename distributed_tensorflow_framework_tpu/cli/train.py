@@ -75,7 +75,21 @@ def main(argv=None) -> int:
     setup_logging()
     _honor_platform_env()
     args = parse_args(argv)
-    config = load_config(args.config, overrides=args.overrides)
+    overrides = list(args.overrides)
+    # Elastic refit (core/supervision.py): the supervisor passes the
+    # fitted mesh / rescaled batch through the environment because the
+    # child command line may be opaque to it (e.g. a `python -c` driver
+    # with a hardcoded argv). Env overrides append AFTER the CLI's so
+    # the refit wins.
+    elastic = os.environ.get(supervision.ELASTIC_OVERRIDES_ENV, "")
+    if elastic:
+        extra = [e.strip() for e in elastic.split(",") if e.strip()]
+        logging.getLogger(__name__).warning(
+            "applying elastic overrides from %s: %s",
+            supervision.ELASTIC_OVERRIDES_ENV, " ".join(extra),
+        )
+        overrides += extra
+    config = load_config(args.config, overrides=overrides)
     if args.print_config:
         import yaml
 
@@ -99,10 +113,32 @@ def main(argv=None) -> int:
                 "this jax build lacks the persistent compilation cache — "
                 "continuing uncached"
             )
+    from distributed_tensorflow_framework_tpu.core.mesh import MeshSizeError
     from distributed_tensorflow_framework_tpu.train import Trainer
 
-    trainer = Trainer(config)
-    trainer.build()
+    try:
+        trainer = Trainer(config)
+        trainer.build()
+    except MeshSizeError as e:
+        # The configured mesh no longer fits the visible device set —
+        # a slice was lost (or regained). Leave a device report for the
+        # supervisor and exit the distinct elastic rc: the supervisor
+        # refits the mesh axes (supervision.fit_axis_sizes), rescales
+        # the batch, and relaunches with checkpoint.allow_reshard on —
+        # WITHOUT consuming a restart-budget attempt (rc contract in
+        # scripts/train_resilient.py; docs/RESILIENCE.md).
+        logging.getLogger(__name__).error(
+            "mesh does not fit the visible device set — exiting rc=%d "
+            "for an elastic refit: %s", supervision.ELASTIC_RESHARD_RC, e,
+        )
+        if config.checkpoint.directory:
+            supervision.write_device_report(
+                config.checkpoint.directory,
+                visible_devices=e.available,
+                needed=e.needed,
+                mesh=e.sizes,
+            )
+        return supervision.ELASTIC_RESHARD_RC
     if args.eval_only:
         results = trainer.evaluate()
         logging.getLogger(__name__).info("eval results: %s", results)

@@ -304,6 +304,14 @@ class CheckpointConfig:
     # experiment off an earlier snapshot. Fails loudly if the step was
     # never saved (or was GC'd by max_to_keep).
     restore_step: int = -1
+    # Allow restoring a checkpoint saved under a DIFFERENT mesh topology:
+    # partition specs are re-derived against the current mesh and the
+    # state is resharded on load (ckpt/reshard.py, docs/RESILIENCE.md
+    # "losing a slice"). Off by default so an accidental mesh.* change
+    # fails fast with MeshTopologyError instead of silently rescattering
+    # a production run; the elastic supervisor turns it on when it
+    # shrinks/grows the mesh (scripts/train_resilient.py, rc 84).
+    allow_reshard: bool = False
 
 
 @config_dataclass

@@ -45,6 +45,16 @@ Spec syntax (``DTF_FAULTS=crash_at_step:120,stall_infeed:30s``):
                      and the escalation rung (ANOMALY_ESCALATION_RC)
                      fires. Fires up to K times; with DTF_FAULTS_STATE it
                      is disarmed entirely after the first firing records.
+  drop_devices:N:S   before the supervisor's Sth relaunch (1-based attempt
+                     ordinal; default 1), shrink the child's visible
+                     device set to N devices — the "lost a slice" drill.
+                     Fired by scripts/train_resilient.py at its
+                     ``relaunch`` point, never inside the trainer; the
+                     supervisor masks the child's host-device count and
+                     the child's mesh construction then fails with a
+                     typed MeshSizeError → exit code 84 → elastic refit
+                     (core/supervision.py). N may also be LARGER than the
+                     current count: growth drills take the same path.
 
 Faults fire at most once per process. When ``DTF_FAULTS_STATE`` names a
 file, firings are also recorded there (before executing — a crash fault
@@ -88,6 +98,8 @@ STATE_ENV_VAR = "DTF_FAULTS_STATE"
 #   infeed          data/pipeline.py, each HostDataset.__next__
 #   ckpt_in_save    ckpt/checkpoint.py, after data write / before manifest
 #   ckpt_committed  ckpt/checkpoint.py, after the manifest commit
+#   relaunch        scripts/train_resilient.py, before launching attempt N
+#                   (`step` carries the 1-based attempt ordinal)
 KIND_POINTS = {
     "crash_at_step": "step_begin",
     "nan_grads": "step_begin",
@@ -96,6 +108,7 @@ KIND_POINTS = {
     "stall_infeed": "infeed",
     "crash_in_save": "ckpt_in_save",
     "corrupt_ckpt": "ckpt_committed",
+    "drop_devices": "relaunch",
 }
 _STEP_KINDS = ("crash_at_step", "crash_in_save", "nan_grads", "loss_spike")
 _STALL_FOREVER_S = 6 * 3600.0
@@ -107,6 +120,8 @@ class Fault:
     arg: str = ""
     step: int | None = None
     seconds: float | None = None
+    # drop_devices: the device count the child set is masked to.
+    devices: int | None = None
     # A fault may fire at `count` distinct steps ([step, step+count) —
     # repeat_nan); it is spent once `fires` reaches it.
     count: int = 1
@@ -161,6 +176,21 @@ def _parse_one(entry: str) -> Fault:
         if fault.step < 1 or fault.count < 1:
             raise ValueError(
                 f"fault repeat_nan needs step >= 1 and count >= 1, got {arg!r}"
+            )
+    elif kind == "drop_devices":
+        head, _, tail = arg.partition(":")
+        try:
+            fault.devices = int(head)
+            fault.step = int(tail) if tail else 1
+        except ValueError:
+            raise ValueError(
+                f"fault drop_devices needs devices[:attempt] (e.g. "
+                f"drop_devices:4:2), got {arg!r}"
+            ) from None
+        if fault.devices < 1 or fault.step < 1:
+            raise ValueError(
+                f"fault drop_devices needs devices >= 1 and attempt >= 1, "
+                f"got {arg!r}"
             )
     elif kind == "stall_infeed":
         dur, _, ordinal = arg.partition(":")

@@ -35,6 +35,25 @@ log = logging.getLogger(__name__)
 MESH_AXES = ("data", "fsdp", "expert", "pipe", "seq", "model")
 
 
+class MeshSizeError(ValueError):
+    """The configured mesh does not fit the visible device set.
+
+    Typed (vs a bare ValueError) so cli/train.py can map it to the
+    supervisor's elastic-reshard exit code (``ELASTIC_RESHARD_RC`` = 84,
+    core/supervision.py): when a slice drops out between relaunches this
+    is a topology change to adapt to, not a crash to back off from.
+    """
+
+    def __init__(self, sizes: dict[str, int], needed: int, available: int):
+        self.sizes = dict(sizes)
+        self.needed = int(needed)
+        self.available = int(available)
+        super().__init__(
+            f"Mesh {self.sizes} needs {self.needed} devices but "
+            f"{self.available} are available"
+        )
+
+
 def initialize_distributed() -> None:
     """Initialize multi-host JAX if a cluster environment is detected.
 
@@ -82,17 +101,27 @@ def _resolve_axis_sizes(config: MeshConfig, n: int) -> dict[str, int]:
         raise ValueError(f"At most one mesh axis may be -1, got {free}")
     if free:
         if n % fixed_prod:
-            raise ValueError(
-                f"{n} devices not divisible by fixed axes {fixed} "
-                f"(product {fixed_prod})"
-            )
+            raise MeshSizeError(sizes, fixed_prod, n)
         sizes[free[0]] = n // fixed_prod
     total = int(np.prod(list(sizes.values())))
     if total != n:
-        raise ValueError(
-            f"Mesh {sizes} needs {total} devices but {n} are available"
-        )
+        raise MeshSizeError(sizes, total, n)
     return sizes
+
+
+def fit_mesh(
+    config: MeshConfig | dict[str, int], n_devices: int
+) -> dict[str, int]:
+    """Largest valid axis sizes fitting ``n_devices`` — the elastic
+    supervisor's mesh-rewrite primitive. Non-``data`` axes only shrink to
+    divisors of their configured size (preserving divisibility of stage/
+    shard splits), ``data`` absorbs the rest; axis ORDER is MESH_AXES.
+    Pure arithmetic delegated to core/supervision.fit_axis_sizes so the
+    jax-free supervisor computes the identical answer."""
+    from distributed_tensorflow_framework_tpu.core import supervision
+
+    sizes = config.axis_sizes() if isinstance(config, MeshConfig) else config
+    return supervision.fit_axis_sizes(dict(sizes), n_devices)
 
 
 def hybrid_mesh_shapes(

@@ -17,7 +17,14 @@ integrity manifests in ckpt/manifest.py, fault injection in core/faults.py
     a bug converts one failure into ``max_attempts`` identical failures —
     stop instead, with a structured report;
   * heartbeat staleness reading, pid-scoped so a relaunched child is never
-    condemned by its predecessor's stale file.
+    condemned by its predecessor's stale file;
+  * the elastic-reshard contract: a child that finds the visible device
+    set no longer matches its configured mesh exits
+    ``ELASTIC_RESHARD_RC`` after writing a device report; the supervisor
+    fits the largest valid mesh onto what remains (``fit_axis_sizes``),
+    re-scales batch/grad-accum so the effective batch is preserved
+    (``rescale_for_devices``) and relaunches — losing a slice is
+    scheduling, not failure (docs/RESILIENCE.md "losing a slice").
 
 Stdlib-only so the supervisor's decision loop is unit-testable without a
 device runtime.
@@ -48,6 +55,31 @@ GRACEFUL_PREEMPT_RC = 83
 # data, so it must not feed the crash-loop breaker's deterministic-bug
 # streak.
 ANOMALY_ESCALATION_RC = 85
+
+# Exit code for "the visible device set no longer matches the configured
+# mesh": the trainer could not even build its mesh because devices
+# disappeared (or came back) between attempts. Distinct from a crash so
+# the supervisor can classify it as a TOPOLOGY change — it refits the mesh
+# (fit_axis_sizes), rewrites the child's config and relaunches without
+# feeding the crash-loop breaker or consuming an attempt: losing a slice
+# is infrastructure scheduling, exactly like graceful preemption.
+ELASTIC_RESHARD_RC = 84
+
+# Mirror of core/mesh.MESH_AXES (that module imports jax; this one must
+# stay stdlib-importable for the supervisor). test_reshard.py pins the two
+# tuples equal so they cannot drift.
+MESH_AXIS_ORDER = ("data", "fsdp", "expert", "pipe", "seq", "model")
+
+# Filename of the device report an rc-84 child leaves in the checkpoint
+# directory (cli/train.py) — the supervisor's per-attempt probe of the
+# visible device set, readable without importing jax.
+DEVICE_REPORT_NAME = "devices.json"
+
+# Env var carrying the supervisor's refit to the relaunched child as
+# comma-separated ``key.path=value`` config overrides (applied by
+# cli/train.py AFTER its own --set overrides, so the refit wins even when
+# the child command line hardcodes mesh sizes).
+ELASTIC_OVERRIDES_ENV = "DTF_ELASTIC_OVERRIDES"
 
 _preempt_requested = False
 _handler_installed = False
@@ -145,6 +177,138 @@ def heartbeat_age_s(
         except OSError:
             return None
     return max(0.0, (time.time() if now is None else now) - float(t))
+
+
+# -- elastic resharding (rc 84) -------------------------------------------
+def _divisors(n: int) -> list[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def fit_axis_sizes(sizes: dict[str, int], n_devices: int) -> dict[str, int]:
+    """Largest valid mesh over ``n_devices`` preserving axis order and
+    divisibility.
+
+    Every non-``data`` axis keeps its original size or shrinks to a
+    divisor of it (a ``pipe:4`` stage split or ``fsdp`` shard count that
+    divided the model still divides it), while ``data`` absorbs whatever
+    remains — it may shrink OR grow, matching its "all remaining devices"
+    semantics. All ``n_devices`` are always used (the all-ones fallback
+    makes ``data = n`` feasible for any n). Among feasible meshes the one
+    keeping the most non-data structure wins: maximize the non-data
+    product, tie-break toward preserving the innermost axes (model-ward),
+    whose sizes are baked into the model config (tensor-parallel degree,
+    pipeline stages).
+    """
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    sizes = {a: (1 if v == -1 else int(v)) for a, v in sizes.items()}
+    for a, v in sizes.items():
+        if v < 1:
+            raise ValueError(f"axis {a!r} has invalid size {v}")
+    non_data = [a for a in MESH_AXIS_ORDER if a != "data" and a in sizes]
+    best: tuple | None = None
+    best_fit: dict[str, int] | None = None
+
+    def search(i: int, chosen: dict[str, int], prod: int) -> None:
+        nonlocal best, best_fit
+        if i == len(non_data):
+            if n_devices % prod:
+                return
+            fit = dict(sizes)
+            fit.update(chosen)
+            if "data" in sizes:
+                fit["data"] = n_devices // prod
+            elif prod != n_devices:
+                return
+            # Innermost-first preference: reversed MESH_AXIS_ORDER puts
+            # model/seq sizes earliest in the tie-break tuple.
+            key = (prod, tuple(chosen[a] for a in reversed(non_data)))
+            if best is None or key > best:
+                best, best_fit = key, fit
+            return
+        axis = non_data[i]
+        for d in _divisors(sizes[axis]):
+            if prod * d <= n_devices:
+                search(i + 1, {**chosen, axis: d}, prod * d)
+
+    search(0, {}, 1)
+    if best_fit is None:
+        raise ValueError(
+            f"no mesh over {n_devices} devices fits axis sizes {sizes} "
+            f"(non-data axes cannot shrink to a divisor combination "
+            f"dividing {n_devices})"
+        )
+    return best_fit
+
+
+def rescale_for_devices(
+    global_batch: int, grad_accum: int, old_dp: int, new_dp: int
+) -> tuple[int, int, bool]:
+    """(new_global_batch, new_grad_accum, effective_preserved) for a
+    data-parallel resize ``old_dp -> new_dp``.
+
+    Policy: keep the PER-DEVICE batch constant (the shrunken mesh must not
+    OOM; the grown mesh should not under-fill) and move the difference
+    into grad accumulation, so the effective batch
+    ``global_batch * grad_accum`` — and with it the LR schedule — is
+    unchanged. When the per-device-preserving rescale is not integral,
+    fall back to keeping ``global_batch`` (effective batch still
+    preserved, per-device size changes); when even that is not divisible
+    by ``new_dp``, return the inputs unchanged with ``False`` — the
+    caller warns and lets config validation decide.
+    """
+    if old_dp == new_dp or old_dp < 1 or new_dp < 1:
+        return global_batch, grad_accum, old_dp == new_dp
+    if global_batch % old_dp == 0 and (grad_accum * old_dp) % new_dp == 0:
+        return (global_batch * new_dp // old_dp,
+                grad_accum * old_dp // new_dp, True)
+    if global_batch % new_dp == 0:
+        return global_batch, grad_accum, True
+    return global_batch, grad_accum, False
+
+
+def write_device_report(ckpt_dir: str, *, visible_devices: int,
+                        needed: int, mesh: dict) -> str:
+    """Commit the rc-84 child's device report (atomic rename, so the
+    supervisor never reads a torn one). Creates the directory if the run
+    died before its first checkpoint."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, DEVICE_REPORT_NAME)
+    record = {
+        "visible_devices": int(visible_devices),
+        "needed": int(needed),
+        "mesh": {a: int(v) for a, v in (mesh or {}).items()},
+        "t": time.time(),
+        "pid": os.getpid(),
+    }
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(record, fh)
+    os.replace(tmp, path)
+    return path
+
+
+def read_device_report(ckpt_dir: str) -> dict | None:
+    """The rc-84 child's device report, or None when absent/torn."""
+    try:
+        with open(os.path.join(ckpt_dir, DEVICE_REPORT_NAME)) as fh:
+            report = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return report if isinstance(report, dict) else None
+
+
+def mask_host_device_count(xla_flags: str, n: int) -> str:
+    """XLA_FLAGS with the virtual-CPU device count forced to ``n`` — how
+    the ``drop_devices`` fault makes a CPU drill lose a slice (on real
+    TPUs devices drop by themselves; this is the injectable stand-in)."""
+    import re
+
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" in xla_flags:
+        return re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, xla_flags)
+    return (xla_flags + " " + flag).strip()
 
 
 class CrashLoopBreaker:

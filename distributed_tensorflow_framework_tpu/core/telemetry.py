@@ -86,6 +86,13 @@ KIND_INFEED_STALL = "infeed_stall"
 # can be read against the schedule that produced it. The per-step
 # ``pipe_bubble_frac`` metric rides in ordinary train_step events.
 KIND_PIPELINE = "pipeline_schedule"
+# Elastic resharding (docs/RESILIENCE.md "losing a slice"):
+# ``mesh_resized`` is the supervisor refitting the mesh to a shrunken/
+# grown device set before a relaunch (scripts/train_resilient.py, rc 84);
+# ``ckpt_resharded`` is the checkpoint layer restoring state saved under
+# one mesh onto another (ckpt/reshard.py, checkpoint.allow_reshard).
+KIND_MESH_RESIZED = "mesh_resized"
+KIND_CKPT_RESHARDED = "ckpt_resharded"
 
 
 def make_run_id() -> str:
@@ -289,6 +296,7 @@ RECOVERY_KINDS = (
     KIND_CKPT_QUARANTINED, KIND_RESTORE_FALLBACK,
     KIND_SUPERVISOR_ATTEMPT, KIND_CRASH_LOOP, KIND_FAILURE,
     KIND_ANOMALY, KIND_ROLLBACK, KIND_BATCH_SKIPPED, KIND_INFEED_STALL,
+    KIND_MESH_RESIZED, KIND_CKPT_RESHARDED,
 )
 
 
@@ -326,6 +334,14 @@ def summarize_events(path: str) -> dict:
     startups: list[dict] = []
     pipeline: dict | None = None
     step_rates: list[float] = []
+    meta: dict | None = None
+    evals = {"count": 0, "last_step": None}
+    bench = {"count": 0, "workloads": []}
+    bench_probes = 0
+    trace_summaries = 0
+    health_events: dict[str, int] = {}
+    mesh_resizes: list[dict] = []
+    ckpt_reshards: list[dict] = []
     for ev in read_events(path, strict=False):
         kind = ev["kind"]
         kinds[kind] = kinds.get(kind, 0) + 1
@@ -382,6 +398,39 @@ def summarize_events(path: str) -> dict:
             })
         elif kind == KIND_PIPELINE:
             pipeline = dict(extra)
+        elif kind == KIND_RUN_META and meta is None:
+            meta = {k: extra.get(k) for k in (
+                "config_name", "model", "dataset", "mesh",
+                "global_batch_size", "process_count") if k in extra}
+        elif kind == KIND_EVAL:
+            evals["count"] += 1
+            if isinstance(step, int):
+                evals["last_step"] = step
+        elif kind == KIND_BENCH:
+            bench["count"] += 1
+            wl = extra.get("workload")
+            if wl and wl not in bench["workloads"]:
+                bench["workloads"].append(wl)
+        elif kind == KIND_BENCH_PROBE:
+            bench_probes += 1
+        elif kind == KIND_TRACE_SUMMARY:
+            trace_summaries += 1
+        elif kind == KIND_HEALTH:
+            name = str(health.get("event", "unknown"))
+            health_events[name] = health_events.get(name, 0) + 1
+        elif kind == KIND_MESH_RESIZED:
+            mesh_resizes.append({
+                "from_axes": extra.get("from_axes"),
+                "to_axes": extra.get("to_axes"),
+                "visible_devices": extra.get("visible_devices"),
+            })
+        elif kind == KIND_CKPT_RESHARDED:
+            ckpt_reshards.append({
+                "step": step,
+                "from_axes": extra.get("from_axes"),
+                "to_axes": extra.get("to_axes"),
+                "leaf_count": extra.get("leaf_count"),
+            })
         elif kind == KIND_TRAIN_STEP:
             m = ev.get("metrics") or {}
             if pipeline is not None and "pipe_bubble_frac" in m:
@@ -404,6 +453,12 @@ def summarize_events(path: str) -> dict:
         "kinds": kinds,
         "first_step": first_step,
         "last_step": last_step,
+        "meta": meta,
+        "evals": evals,
+        "bench": bench,
+        "bench_probes": bench_probes,
+        "trace_summaries": trace_summaries,
+        "health_events": health_events,
         "ckpt_saves": saves,
         "startups": startups,
         "pipeline": pipeline,
@@ -418,8 +473,18 @@ def summarize_events(path: str) -> dict:
             "rollbacks": rollbacks,
             "batches_skipped": batches_skipped,
             "infeed_stalls": infeed_stalls,
+            "mesh_resizes": mesh_resizes,
+            "ckpt_reshards": ckpt_reshards,
         },
     }
+
+
+def _fmt_axes(axes: dict | None) -> str:
+    """``{'data': 8}`` -> ``{data:8}`` (size-1 axes elided)."""
+    if not axes:
+        return "{?}"
+    parts = [f"{a}:{int(v)}" for a, v in axes.items() if int(v) != 1]
+    return "{" + ", ".join(parts) + "}" if parts else "{1 device}"
 
 
 def format_run_summary(summary: dict) -> str:
@@ -436,6 +501,31 @@ def format_run_summary(summary: dict) -> str:
             f"{k}={v}" for k, v in sorted(summary["kinds"].items())
         )
     )
+    meta = summary.get("meta")
+    if meta:  # first KIND_RUN_META event of the run
+        lines.append(
+            "  run: " + ", ".join(f"{k}={v}" for k, v in meta.items())
+        )
+    evals = summary.get("evals") or {}
+    if evals.get("count"):  # KIND_EVAL rollup
+        lines.append(
+            f"  evals: {evals['count']} (last at step {evals['last_step']})"
+        )
+    bench = summary.get("bench") or {}
+    if bench.get("count"):  # KIND_BENCH rollup
+        wl = ", ".join(bench.get("workloads") or []) or "?"
+        lines.append(f"  bench results: {bench['count']} ({wl})")
+    if summary.get("bench_probes"):  # KIND_BENCH_PROBE rollup
+        lines.append(f"  backend probes: {summary['bench_probes']}")
+    if summary.get("trace_summaries"):  # KIND_TRACE_SUMMARY rollup
+        lines.append(f"  trace summaries: {summary['trace_summaries']}")
+    if summary.get("health_events"):  # KIND_HEALTH rollup
+        lines.append(
+            "  health events: " + ", ".join(
+                f"{k}={v}"
+                for k, v in sorted(summary["health_events"].items())
+            )
+        )
     saves = summary.get("ckpt_saves") or {}
     if saves.get("count"):
         lines.append(
@@ -479,6 +569,7 @@ def format_run_summary(summary: dict) -> str:
         or rec["failures"] or rec["crash_loop"]
         or rec.get("anomalies") or rec.get("rollbacks")
         or rec.get("batches_skipped") or rec.get("infeed_stalls")
+        or rec.get("mesh_resizes") or rec.get("ckpt_reshards")
     )
     if not activity:
         lines.append("  recovery activity: none")
@@ -497,6 +588,18 @@ def format_run_summary(summary: dict) -> str:
         lines.append(f"    batches skipped: {rec['batches_skipped']}")
     if rec.get("infeed_stalls"):
         lines.append(f"    infeed stalls retried: {rec['infeed_stalls']}")
+    for m in rec.get("mesh_resizes") or []:  # KIND_MESH_RESIZED
+        lines.append(
+            f"    mesh resized: {_fmt_axes(m.get('from_axes'))} -> "
+            f"{_fmt_axes(m.get('to_axes'))} "
+            f"({m.get('visible_devices', '?')} devices visible)"
+        )
+    for r in rec.get("ckpt_reshards") or []:  # KIND_CKPT_RESHARDED
+        lines.append(
+            f"    checkpoint resharded at step {r.get('step')}: "
+            f"{_fmt_axes(r.get('from_axes'))} -> {_fmt_axes(r.get('to_axes'))}"
+            f" ({r.get('leaf_count', '?')} leaves)"
+        )
     for q in rec["quarantined"]:
         lines.append(
             f"    quarantined checkpoint step {q['step']} ({q['reason']})"

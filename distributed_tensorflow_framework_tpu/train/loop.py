@@ -206,6 +206,8 @@ class Trainer:
             self._ckpt_manager = CheckpointManager(
                 self.config.checkpoint, is_chief=self.runtime.is_chief,
                 telemetry_writer=self.writer.telemetry,
+                mesh=self.mesh,
+                process_count=self.runtime.process_count,
             )
             if self.config.checkpoint.restore:
                 want = self.config.checkpoint.restore_step

@@ -22,6 +22,19 @@ Behavior (exit-code contract in docs/RESILIENCE.md):
   * GRACEFUL_PREEMPT_RC (83) means the child honored a SIGTERM: step
     finished, checkpoint saved — relaunched immediately WITHOUT consuming
     an attempt (preemption is scheduling, not failure).
+  * ELASTIC_RESHARD_RC (84) means the child's configured mesh no longer
+    fits the visible device set (a slice was lost — or came back). The
+    supervisor reads the child's device report, fits the largest valid
+    mesh onto what remains (supervision.fit_axis_sizes), rescales
+    batch/grad-accum so the EFFECTIVE batch and LR schedule are
+    preserved (supervision.rescale_for_devices), and relaunches with
+    ``checkpoint.allow_reshard=true`` — the restore resharding the
+    checkpoint onto the new mesh (ckpt/reshard.py). Like preemption this
+    consumes NO attempt and never feeds the crash-loop breaker; it is
+    bounded separately by ``--max-reshards``. The refit reaches the
+    child via the DTF_ELASTIC_OVERRIDES env var (cli/train.py applies it
+    after its own --set overrides). A ``mesh_resized`` telemetry event
+    records each transition.
   * Heartbeat watchdog: when the run's heartbeat file (written by
     train/hooks.HeartbeatHook under checkpoint.directory) goes stale past
     ``--heartbeat-timeout``, the child is SIGKILLed instead of waiting for
@@ -58,6 +71,7 @@ import time
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from distributed_tensorflow_framework_tpu.core import (  # noqa: E402
+    faults,
     supervision,
     telemetry,
 )
@@ -139,6 +153,58 @@ def find_checkpoint_dir(cmd: list[str]) -> tuple[str | None, bool]:
         return None, True  # benefit of the doubt
 
 
+def parse_training_params(cmd: list[str]) -> tuple[dict, int, int]:
+    """(mesh axis sizes, global batch, grad accum) as the child sees them.
+
+    Same philosophy as ``find_checkpoint_dir``: regex over the raw command
+    tokens (an override may ride inside a ``python -c`` program string),
+    with the ``--config`` YAML as fallback and the config-dataclass
+    defaults (``data=-1``, batch 64, accum 1) underneath. Command-line
+    values win over YAML; the LAST occurrence of an override wins, like
+    --set semantics.
+    """
+    import re
+
+    sizes = {a: (-1 if a == "data" else 1)
+             for a in supervision.MESH_AXIS_ORDER}
+    batch, accum = 64, 1
+    config_path = None
+    for i, tok in enumerate(cmd):
+        if tok == "--config" and i + 1 < len(cmd):
+            config_path = cmd[i + 1]
+        elif tok.startswith("--config="):
+            config_path = tok.split("=", 1)[1]
+    if config_path:
+        try:
+            import yaml
+
+            with open(config_path) as fh:
+                doc = yaml.safe_load(fh) or {}
+            for a, v in (doc.get("mesh") or {}).items():
+                if a in sizes:
+                    sizes[a] = int(v)
+            batch = int((doc.get("data") or {}).get(
+                "global_batch_size", batch))
+            accum = int((doc.get("train") or {}).get(
+                "grad_accum_steps", accum))
+        except Exception:
+            pass
+    text = " ".join(cmd)
+    for a in sizes:
+        for m in re.finditer(rf"mesh\.{a}=(-?\d+)", text):
+            sizes[a] = int(m.group(1))
+    for m in re.finditer(r"data\.global_batch_size=(\d+)", text):
+        batch = int(m.group(1))
+    for m in re.finditer(r"train\.grad_accum_steps=(\d+)", text):
+        accum = int(m.group(1))
+    return sizes, batch, accum
+
+
+def _fmt_axes(axes: dict) -> str:
+    parts = [f"{a}:{v}" for a, v in axes.items() if int(v) != 1]
+    return "{" + ", ".join(parts) + "}" if parts else "{1 device}"
+
+
 # -- cancellation forwarding ----------------------------------------------
 _child: subprocess.Popen | None = None
 _cancelled = False
@@ -214,6 +280,10 @@ def main(argv=None) -> int:
     parser.add_argument("--max-preemptions", type=int, default=50,
                         help="safety bound on graceful-preemption "
                              "relaunches (they never consume attempts)")
+    parser.add_argument("--max-reshards", type=int, default=8,
+                        help="safety bound on elastic mesh-refit "
+                             "relaunches, rc 84 (they never consume "
+                             "attempts)")
     parser.add_argument("--events", default=None,
                         help="supervisor telemetry JSONL (default: "
                              "<checkpoint.directory>/supervisor_events"
@@ -262,9 +332,31 @@ def main(argv=None) -> int:
     env = build_env()
     breaker = supervision.CrashLoopBreaker(args.crash_loop_threshold)
     rc = 1
-    attempt = failures = preemptions = 0
+    attempt = failures = preemptions = reshards = 0
+    # Elastic state: what the child's mesh/batch currently are (command
+    # line + any refit overrides already applied), and the device count
+    # a drop_devices drill has masked the child to (None = unmasked).
+    cur_sizes, cur_batch, cur_accum = parse_training_params(cmd)
+    masked_devices: int | None = None
     while attempt < args.max_attempts:
         attempt += 1
+        # The supervisor-side fault point: drop_devices drills fire here,
+        # keyed on the 1-based attempt ordinal, and shrink/grow the
+        # child's visible device set (CPU stand-in for losing a slice —
+        # on real TPUs the devices disappear by themselves).
+        for fault in faults.fire("relaunch", step=attempt):
+            if fault.kind != "drop_devices":
+                continue
+            masked_devices = fault.devices
+            if env.get("JAX_PLATFORMS", "").split(",")[0] != "cpu":
+                print("train_resilient: WARNING — drop_devices masks the "
+                      "virtual-CPU host device count; JAX_PLATFORMS is "
+                      "not cpu, the mask may have no effect",
+                      file=sys.stderr)
+            env["XLA_FLAGS"] = supervision.mask_host_device_count(
+                env.get("XLA_FLAGS", ""), masked_devices)
+            print(f"train_resilient: drop_devices drill — child device "
+                  f"set masked to {masked_devices}", file=sys.stderr)
         print(f"train_resilient: attempt {attempt}/{args.max_attempts}",
               file=sys.stderr)
         rc, hung, child_pid = _run_attempt(
@@ -318,6 +410,82 @@ def main(argv=None) -> int:
                 print("train_resilient: preemption churn exceeded "
                       f"--max-preemptions={args.max_preemptions} — giving "
                       "up", file=sys.stderr)
+                return rc
+            continue
+
+        if rc == supervision.ELASTIC_RESHARD_RC:
+            # The child could not build its mesh on the devices it saw —
+            # a topology change, not a failure. Refit and relaunch
+            # without consuming an attempt or feeding the breaker.
+            report = supervision.read_device_report(ckpt_dir) if ckpt_dir \
+                else None
+            visible = (report or {}).get("visible_devices") or masked_devices
+            if not visible:
+                failures += 1
+                print(f"train_resilient: attempt {attempt} exited rc={rc} "
+                      "(elastic) but left no device report — treating as a "
+                      "plain failure", file=sys.stderr)
+                writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                            attempt=attempt, rc=rc,
+                            classification="elastic_no_report",
+                            last_step=last_step, ckpt_step=ckpt_step)
+                if breaker.record(rc=rc, last_step=last_step,
+                                  ckpt_step=ckpt_step):
+                    print("train_resilient: CRASH LOOP — not retrying",
+                          file=sys.stderr)
+                    return rc
+                continue
+            reshards += 1
+            attempt -= 1  # topology changes never consume the budget
+            breaker.record(rc=rc, last_step=last_step, ckpt_step=ckpt_step,
+                           transient=True)
+            try:
+                fitted = supervision.fit_axis_sizes(cur_sizes, int(visible))
+            except ValueError as e:
+                print(f"train_resilient: no mesh fits {visible} devices "
+                      f"({e}) — giving up", file=sys.stderr)
+                return rc
+            old_dp = cur_sizes.get("data", 1)
+            new_batch, new_accum, preserved = (cur_batch, cur_accum, False)
+            if old_dp > 0:
+                new_batch, new_accum, preserved = \
+                    supervision.rescale_for_devices(
+                        cur_batch, cur_accum, old_dp, fitted.get("data", 1))
+            if not preserved:
+                print("train_resilient: WARNING — could not preserve the "
+                      f"effective batch across {_fmt_axes(cur_sizes)} -> "
+                      f"{_fmt_axes(fitted)}; keeping "
+                      f"global_batch={cur_batch}, accum={cur_accum}",
+                      file=sys.stderr)
+                new_batch, new_accum = cur_batch, cur_accum
+            overrides = [f"mesh.{a}={v}" for a, v in fitted.items()]
+            overrides.append("checkpoint.allow_reshard=true")
+            if preserved:
+                overrides += [f"data.global_batch_size={new_batch}",
+                              f"train.grad_accum_steps={new_accum}"]
+            env[supervision.ELASTIC_OVERRIDES_ENV] = ",".join(overrides)
+            print(f"train_resilient: elastic reshard #{reshards} (rc={rc}) "
+                  f"— mesh {_fmt_axes(cur_sizes)} -> {_fmt_axes(fitted)} on "
+                  f"{visible} devices, global_batch {cur_batch} -> "
+                  f"{new_batch}, grad_accum {cur_accum} -> {new_accum} — "
+                  "relaunching immediately", file=sys.stderr)
+            writer.emit(telemetry.KIND_MESH_RESIZED,
+                        attempt=attempt + 1, rc=rc, reshards=reshards,
+                        from_axes=dict(cur_sizes), to_axes=dict(fitted),
+                        visible_devices=int(visible),
+                        global_batch=new_batch, grad_accum=new_accum,
+                        effective_batch_preserved=preserved,
+                        overrides=" ".join(overrides),
+                        last_step=last_step, ckpt_step=ckpt_step)
+            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                        attempt=attempt + 1, rc=rc,
+                        classification="elastic_reshard", reshards=reshards,
+                        last_step=last_step, ckpt_step=ckpt_step)
+            cur_sizes, cur_batch, cur_accum = fitted, new_batch, new_accum
+            if reshards >= args.max_reshards:
+                print("train_resilient: topology churn exceeded "
+                      f"--max-reshards={args.max_reshards} — giving up",
+                      file=sys.stderr)
                 return rc
             continue
 

@@ -1,0 +1,138 @@
+"""Elastic reshard acceptance drill (ISSUE 6 tentpole, docs/RESILIENCE.md).
+
+Losing a SLICE, not just a process: a supervised ``{data:8}`` run is
+killed mid-training, and on relaunch a ``drop_devices`` drill masks the
+child's visible device set to 4 — the CPU stand-in for a slice going
+away. The child cannot build its mesh, exits ELASTIC_RESHARD_RC (84),
+and the supervisor refits the mesh to ``{data:4}``, rescales the batch
+to preserve the effective batch (64×1@dp8 → 32×2@dp4), and relaunches
+with ``checkpoint.allow_reshard=true`` — all without consuming a retry
+attempt or feeding the crash-loop breaker. The relaunched child restores
+the step-20 checkpoint across the mesh change and finishes.
+
+The fast reshard mechanics (fit_axis_sizes, rescale_for_devices,
+cross-mesh bit-exact parity) live in tests/test_reshard.py; this module
+is the end-to-end drill and is tier-2 by its slow marks.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import pytest
+
+ELASTIC_DRIVER = """
+import sys
+import jax; jax.config.update('jax_platforms','cpu')
+from distributed_tensorflow_framework_tpu.cli.train import main
+sys.exit(
+ main(['--set','model.name=lenet5','--set','model.dtype=float32',
+      '--set','data.name=synthetic_images','--set','data.image_size=28',
+      '--set','data.channels=1','--set','data.global_batch_size=64',
+      '--set','mesh.data=8',
+      '--set','optimizer.name=sgd_momentum','--set','optimizer.learning_rate=0.01',
+      '--set','train.total_steps={steps}','--set','train.log_interval=20',
+      '--set','train.eval_steps=0',
+      '--set','checkpoint.directory={ckpt}',
+      '--set','checkpoint.save_interval_steps=20',
+      '--set','checkpoint.async_save=false']))
+"""
+
+
+def _child_env(env_extra: dict) -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8").strip()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra)
+    return env
+
+
+def _events(path: str, kind: str) -> list[dict]:
+    from distributed_tensorflow_framework_tpu.core import telemetry
+
+    return list(telemetry.read_events(path, kind=kind, strict=False))
+
+
+@pytest.mark.slow
+@pytest.mark.slowest
+def test_supervised_slice_loss_reshards_and_resumes(tmp_path):
+    """Kill at step 30, drop 8→4 devices on the relaunch: the run must
+    finish via one rc-84 elastic reshard, restore the step-20 checkpoint
+    onto the {data:4} mesh, and preserve the effective batch."""
+    from distributed_tensorflow_framework_tpu.core import telemetry
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, "scripts/train_resilient.py",
+           "--max-attempts", "3", "--retry-sleep", "0.2", "--jitter", "0",
+           "--", sys.executable, "-c",
+           ELASTIC_DRIVER.format(ckpt=ckpt_dir, steps=60)]
+    r = subprocess.run(
+        cmd, cwd=repo_root, capture_output=True, text=True, timeout=900,
+        env=_child_env({
+            # crash_at_step kills attempt 1 at step 30 (after the step-20
+            # save); drop_devices:4:2 fires at the SECOND relaunch point
+            # and masks the child to 4 devices. The state file makes both
+            # one-shot, so the post-reshard child runs clean.
+            "DTF_FAULTS": "crash_at_step:30,drop_devices:4:2",
+            "DTF_FAULTS_STATE": str(tmp_path / "faults_state.json"),
+        }))
+
+    assert r.returncode == 0, r.stderr[-4000:]
+    # Attempt 1 died to the injected SIGKILL (a real failure, budgeted)...
+    assert "exited rc=137" in r.stderr, r.stderr[-4000:]
+    # ...then the relaunch saw 4 devices and took the elastic path:
+    assert "child device set masked to 4" in r.stderr
+    assert ("elastic reshard #1 (rc=84) — mesh {data:8} -> {data:4} on "
+            "4 devices, global_batch 64 -> 32, grad_accum 1 -> 2"
+            ) in r.stderr, r.stderr[-4000:]
+    # The reshard consumed NO attempt and never tripped the breaker.
+    assert "done (attempt 2)" in r.stderr, r.stderr[-4000:]
+    assert "attempt 3/3" not in r.stderr
+    assert "CRASH LOOP" not in r.stderr
+
+    # The child reported its device shortfall before exiting rc=84.
+    report = json.load(open(os.path.join(ckpt_dir, "devices.json")))
+    assert report["visible_devices"] == 4
+    assert report["needed"] == 8
+
+    # Supervisor telemetry: the resize is a first-class recovery event.
+    sup_events = os.path.join(ckpt_dir, "supervisor_events.jsonl")
+    resizes = _events(sup_events, telemetry.KIND_MESH_RESIZED)
+    assert len(resizes) == 1, resizes
+    extra = resizes[0]["extra"]
+    assert extra["from_axes"]["data"] == 8
+    assert extra["to_axes"]["data"] == 4
+    assert extra["effective_batch_preserved"] is True
+    assert extra["global_batch"] == 32 and extra["grad_accum"] == 2
+    attempts = _events(sup_events, telemetry.KIND_SUPERVISOR_ATTEMPT)
+    assert [a["extra"]["classification"] for a in attempts] == \
+        ["crashed", "elastic_reshard", "done"]
+
+    # Child telemetry: the cross-mesh restore was validated and recorded.
+    reshards = _events(os.path.join(ckpt_dir, "events.jsonl"),
+                       telemetry.KIND_CKPT_RESHARDED)
+    assert reshards, "no ckpt_resharded event in the child's events.jsonl"
+    rx = reshards[-1]["extra"]
+    assert rx["from_axes"]["data"] == 8 and rx["to_axes"]["data"] == 4
+    assert rx["leaf_count"] > 0
+
+    # Both events surface in the analyze_trace rollup.
+    summary = telemetry.format_run_summary(
+        telemetry.summarize_events(sup_events))
+    assert "mesh resized: {data:8} -> {data:4}" in summary, summary
+
+    # The run resumed from the step-20 save and trained to the horizon
+    # on the smaller mesh with the effective batch preserved.
+    final = [e for e in _events(os.path.join(ckpt_dir, "events.jsonl"),
+                                telemetry.KIND_TRAIN_STEP)
+             if e.get("step") == 60]
+    assert final, "no train_step event at step 60"
+    assert math.isfinite(final[-1]["metrics"]["loss"])

@@ -1,11 +1,18 @@
-"""Pytest-marker audit: subprocess training drills must be tier-2.
+"""Structural audits: pytest markers and telemetry-kind coverage.
 
-Tier-1 (``-m "not slow"``) is the under-15-minute gate every PR runs; a
+Marker audit — subprocess training drills must be tier-2. Tier-1
+(``-m "not slow"``) is the under-15-minute gate every PR runs; a
 subprocess drill that launches real training children (the DRIVER
 template of tests/test_fault_tolerance.py) costs minutes each and belongs
 behind the ``slow`` marker. This audit makes that a checked property
 instead of a review convention, so new drills (e.g. the async crash
 drills) can't silently land in tier-1.
+
+Telemetry audit — every ``KIND_*`` constant in core/telemetry.py must be
+rolled up by ``summarize_events``/``format_run_summary`` and referenced
+by at least one test: an event kind nothing summarizes is invisible in
+exactly the post-mortems it was added for, and one no test references
+can silently rot (ISSUE 6 satellite).
 
 Pure ast — no test collection, no imports of the audited modules.
 """
@@ -14,6 +21,8 @@ import ast
 import pathlib
 
 TESTS_DIR = pathlib.Path(__file__).resolve().parent
+TELEMETRY_PY = (TESTS_DIR.parent / "distributed_tensorflow_framework_tpu"
+                / "core" / "telemetry.py")
 
 # Module-level names that mark a file as a subprocess-training-drill
 # module: the DRIVER template itself, importing it from the fault
@@ -81,6 +90,48 @@ def test_subprocess_drills_carry_slow_marker():
         "subprocess training drills missing @pytest.mark.slow (they launch "
         f"real training children and must stay out of tier-1): {offenders}"
     )
+
+
+def _telemetry_kind_names() -> list[str]:
+    tree = ast.parse(TELEMETRY_PY.read_text())
+    names = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id.startswith("KIND_"):
+                    names.append(t.id)
+    return names
+
+
+def _function_source(tree: ast.Module, source: str, name: str) -> str:
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return ast.get_source_segment(source, node) or ""
+    raise AssertionError(f"{name} not found in {TELEMETRY_PY}")
+
+
+def test_every_telemetry_kind_is_summarized():
+    """Each KIND_* must appear (by constant name) in the combined source
+    of summarize_events + format_run_summary — the rollup surface
+    scripts/analyze_trace.py prints."""
+    source = TELEMETRY_PY.read_text()
+    tree = ast.parse(source)
+    rollup_src = (_function_source(tree, source, "summarize_events")
+                  + _function_source(tree, source, "format_run_summary"))
+    kinds = _telemetry_kind_names()
+    assert len(kinds) >= 20, kinds  # self-check: extraction saw them
+    missing = [k for k in kinds if k not in rollup_src]
+    assert not missing, (
+        "telemetry kinds with no summarize_events/format_run_summary "
+        f"rollup: {missing}"
+    )
+
+
+def test_every_telemetry_kind_is_referenced_by_a_test():
+    corpus = "".join(
+        p.read_text() for p in sorted(TESTS_DIR.glob("test_*.py")))
+    missing = [k for k in _telemetry_kind_names() if k not in corpus]
+    assert not missing, f"telemetry kinds no test references: {missing}"
 
 
 def test_audit_sees_the_known_drills():

@@ -360,3 +360,97 @@ def test_summarize_without_pipeline_events(tmp_path):
     s = telemetry.summarize_events(path)
     assert s["pipeline"] is None
     assert "pipeline:" not in telemetry.format_run_summary(s)
+
+
+def test_summarize_mesh_resize_and_reshard_rollup(tmp_path):
+    """The elastic events (ISSUE 6) join the recovery section: a
+    supervisor mesh_resized and a restore-side ckpt_resharded both count
+    as recovery activity and render with their axis transitions."""
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="elastic")
+    w.emit(telemetry.KIND_MESH_RESIZED,
+           from_axes={"data": 8}, to_axes={"data": 4}, visible_devices=4,
+           global_batch=32, grad_accum=2, effective_batch_preserved=True)
+    w.emit(telemetry.KIND_CKPT_RESHARDED, step=20,
+           from_axes={"data": 8}, to_axes={"data": 4}, leaf_count=12,
+           respec_agreement="12/8")
+    w.close()
+    s = telemetry.summarize_events(path)
+    rec = s["recovery"]
+    assert rec["mesh_resizes"] == [{"from_axes": {"data": 8},
+                                    "to_axes": {"data": 4},
+                                    "visible_devices": 4}]
+    assert rec["ckpt_reshards"] == [{"step": 20, "from_axes": {"data": 8},
+                                     "to_axes": {"data": 4},
+                                     "leaf_count": 12}]
+    text = telemetry.format_run_summary(s)
+    assert "mesh resized: {data:8} -> {data:4} (4 devices visible)" in text
+    assert "checkpoint resharded at step 20: {data:8} -> {data:4}" in text
+
+
+def test_summarize_rolls_up_every_kind(tmp_path):
+    """One event of EVERY telemetry kind → the summary accounts for each
+    (the marker-audit's rollup guarantee, exercised end-to-end). New
+    kinds must be added here — test_marker_audit.py enforces that every
+    KIND_* has both a rollup and a test reference."""
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="all-kinds")
+    w.emit_run_meta(argv=["train.py"], config_name="lenet",
+                    mesh={"data": 8})  # KIND_RUN_META
+    w.emit(telemetry.KIND_TRAIN_STEP, step=1, metrics={"loss": 1.0},
+           throughput={"examples_per_sec": 10.0})
+    w.emit(telemetry.KIND_EVAL, step=2, metrics={"eval_loss": 1.0})
+    w.emit(telemetry.KIND_BENCH, metrics={"value": 1.0},
+           workload="resnet50")
+    w.emit(telemetry.KIND_BENCH_PROBE, platform="cpu")
+    w.emit(telemetry.KIND_TRACE_SUMMARY, trace_dir="/tmp/t")
+    w.emit(telemetry.KIND_HEALTH, step=3,
+           health={"event": "moe_collapse"})
+    w.emit(telemetry.KIND_FAILURE, step=3, health={"failure": "nan_loss"})
+    w.emit(telemetry.KIND_CKPT_SAVE, step=4,
+           metrics={"ckpt_save_blocked_ms": 1.0, "ckpt_save_total_ms": 2.0},
+           async_save=True)
+    w.emit(telemetry.KIND_STARTUP, step=4,
+           time_to_first_step_s=2.5, restored_step=4)
+    w.emit(telemetry.KIND_PIPELINE, schedule="gpipe", stages=2,
+           microbatches=4, bubble_frac=0.2)
+    w.emit(telemetry.KIND_ANOMALY, step=5,
+           health={"anomaly": "loss_spike", "metric": "loss"})
+    w.emit(telemetry.KIND_ROLLBACK, step=5,
+           health={"from_step": 5, "to_step": 4})
+    w.emit(telemetry.KIND_BATCH_SKIPPED, step=5, health={"batches": 2})
+    w.emit(telemetry.KIND_INFEED_STALL, step=5, health={"attempt": 1})
+    w.emit(telemetry.KIND_CKPT_QUARANTINED, step=4,
+           health={"reason": "hash mismatch"})
+    w.emit(telemetry.KIND_RESTORE_FALLBACK,
+           health={"from_step": 4, "to_step": 2})
+    w.emit(telemetry.KIND_SUPERVISOR_ATTEMPT, attempt=1, rc=137,
+           classification="crashed")
+    w.emit(telemetry.KIND_CRASH_LOOP, verdict="deterministic_crash_loop")
+    w.emit(telemetry.KIND_MESH_RESIZED, from_axes={"data": 8},
+           to_axes={"data": 4}, visible_devices=4)
+    w.emit(telemetry.KIND_CKPT_RESHARDED, step=4, from_axes={"data": 8},
+           to_axes={"data": 4}, leaf_count=8)
+    w.close()
+
+    s = telemetry.summarize_events(path)
+    kind_values = {
+        getattr(telemetry, name)
+        for name in dir(telemetry) if name.startswith("KIND_")
+    }
+    assert kind_values <= set(s["kinds"]), (
+        f"kinds never emitted by this test: {kind_values - set(s['kinds'])}"
+    )
+    assert s["meta"]["config_name"] == "lenet"
+    assert s["evals"] == {"count": 1, "last_step": 2}
+    assert s["bench"] == {"count": 1, "workloads": ["resnet50"]}
+    assert s["bench_probes"] == 1
+    assert s["trace_summaries"] == 1
+    assert s["health_events"] == {"moe_collapse": 1}
+    text = telemetry.format_run_summary(s)
+    assert "run: config_name=lenet" in text
+    assert "evals: 1 (last at step 2)" in text
+    assert "bench results: 1 (resnet50)" in text
+    assert "backend probes: 1" in text
+    assert "trace summaries: 1" in text
+    assert "health events: moe_collapse=1" in text
