@@ -27,7 +27,13 @@ docs/OBSERVABILITY.md) with per-collective byte counts, joinable with a
 training run's events.jsonl by run id. BENCH_JSONL=<path> overrides the
 sink (default: <BENCH_TRACE>/bench_events.jsonl, else ./bench_events.jsonl;
 BENCH_JSONL=0 disables). BENCH_WAIT=<minutes> arms a bounded backend-init
-retry budget (see _init_backend).
+retry budget (see _init_backend). A backend probe HANG (vs a probe error)
+exits 3 with failure_class="probe_hang" in the JSON — chip access
+flakiness, not a code regression. BENCH_COLLECTIVE=f32|bf16|int8 runs the
+collective wire-format A/B instead of a single workload
+(_run_collective_ab): f32-wire baseline vs the requested wire format on
+the same ladder, reporting the tallied wire-byte ratio and throughput
+delta.
 """
 
 from __future__ import annotations
@@ -134,7 +140,11 @@ def _mesh_axes(mesh) -> dict:
 
 
 def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
-                   model_overrides: dict | None = None) -> dict:
+                   model_overrides: dict | None = None,
+                   base_overrides: dict | None = None) -> dict:
+    """``base_overrides`` merges per top-level section into the base dict
+    (the collective A/B uses it to force shard_map + a wire dtype without
+    forking the workload definition)."""
     import numpy as np
 
     from distributed_tensorflow_framework_tpu.core.config import load_config
@@ -142,8 +152,7 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
     from distributed_tensorflow_framework_tpu.data.infeed import to_global
     from distributed_tensorflow_framework_tpu.train.step import StepBuilder
 
-    cfg = load_config(
-        base={
+    base = {
             "name": "bench-resnet50",
             "model": {"name": "resnet50", "num_classes": 1000,
                       "dtype": "bfloat16",
@@ -184,8 +193,13 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
                 "weight_decay": 0.0001,
             },
             "train": {"total_steps": 1000},
-        }
-    )
+    }
+    for section, override in (base_overrides or {}).items():
+        if isinstance(override, dict):
+            base[section] = {**base.get(section, {}), **override}
+        else:
+            base[section] = override
+    cfg = load_config(base=base)
     mesh = create_mesh(cfg.mesh)
     builder = StepBuilder(cfg, mesh)
     from distributed_tensorflow_framework_tpu.data.pipeline import image_np_dtype
@@ -476,11 +490,20 @@ def _ladder_override(default: tuple, n_chips: int) -> tuple:
 class BenchBackendError(RuntimeError):
     """Backend bring-up failure carrying the full probe history, so the
     structured failure line records WHAT was tried, not just the last
-    stderr fragment (VERDICT item 2)."""
+    stderr fragment (VERDICT item 2).
 
-    def __init__(self, message: str, probe_history: list[dict]):
+    ``failure_class`` separates ``probe_hang`` — the chip tunnel never
+    answered, i.e. environment flakiness (stale lease, slice still
+    provisioning) — from ``backend_error`` (the probe ran and failed).
+    A hang exits the bench with rc 3 instead of 1 so the driver can tell
+    "chip access flaked" from "the code under test is broken"
+    (the BENCH_r04/r05 re-land trigger, scripts/chip_window_queue.sh)."""
+
+    def __init__(self, message: str, probe_history: list[dict],
+                 failure_class: str = "backend_error"):
         super().__init__(message)
         self.probe_history = probe_history
+        self.failure_class = failure_class
 
 
 def _probe_device_count(timeout_s: float) -> tuple[str, object]:
@@ -620,7 +643,7 @@ def _init_backend(attempts: int = 3, probe_timeout_s: float = 240.0, *,
                 f"killed and reaped. The backend is wedged or still "
                 f"provisioning — set BENCH_WAIT=<minutes> to keep "
                 f"re-probing under a time budget instead of failing "
-                f"on the first hang", history)
+                f"on the first hang", history, failure_class="probe_hang")
         print(f"bench: backend init attempt {attempt} "
               f"{'hung' if outcome == 'hang' else 'failed'} ({payload})",
               file=sys.stderr)
@@ -636,7 +659,9 @@ def _init_backend(attempts: int = 3, probe_timeout_s: float = 240.0, *,
                 raise BenchBackendError(
                     f"backend init {outcome} after {elapsed / 60:.1f} min "
                     f"({attempt} probes, BENCH_WAIT budget "
-                    f"{wait_budget_s / 60:.0f} min): {payload}", history)
+                    f"{wait_budget_s / 60:.0f} min): {payload}", history,
+                    failure_class=("probe_hang" if outcome == "hang"
+                                   else "backend_error"))
             sleep(wait_s)
         else:
             if attempt >= attempts:
@@ -681,6 +706,75 @@ def _emit_bench_result(writer, workload: str, out: dict, result: dict) -> None:
                 **extra)
 
 
+# BENCH_COLLECTIVE value → parallel.collective_dtype knob value.
+_COLLECTIVE_MODES = {"f32": "", "bf16": "bfloat16", "int8": "int8"}
+
+
+def _run_collective_ab(writer, mode: str, n_chips: int, chip: str) -> int:
+    """BENCH_COLLECTIVE=f32|bf16|int8 — collective wire-format A/B.
+
+    Runs the ResNet-50 workload TWICE on the same batch ladder under
+    ``train.spmd_mode=shard_map`` (the explicit-collective path
+    ``parallel.collective_dtype`` applies to — docs/PERFORMANCE.md):
+    an f32-wire baseline, then the requested wire format. The JSON line
+    reports the tallied wire-byte ratio (baseline/target; trace-time
+    counts from parallel/collectives.tally, exact rather than sampled)
+    and the throughput delta. ``f32`` runs the baseline once and reports
+    ratio 1.0 — the self-calibration dial for the queue.
+    """
+    metric = "resnet50_collective_wire_ratio"
+    unit = "x"
+    ladder = _ladder_override(
+        (128 * n_chips, 64 * n_chips, 32 * n_chips), n_chips)
+
+    def run(wire: str):
+        return _run_ladder(
+            lambda bs: bench_resnet50(bs, base_overrides={
+                "train": {"spmd_mode": "shard_map"},
+                "parallel": {"collective_dtype": wire},
+            }),
+            ladder, metric, unit, chip, writer=writer)
+
+    baseline = run("")
+    if baseline is None:
+        return 1
+    wire_dtype = _COLLECTIVE_MODES[mode]
+    target = run(wire_dtype) if wire_dtype else baseline
+    if target is None:
+        return 1
+
+    def wire_bytes(result):
+        return (result.get("collectives") or {}).get("total_bytes")
+
+    base_b, tgt_b = wire_bytes(baseline), wire_bytes(target)
+    ratio = round(base_b / tgt_b, 3) if base_b and tgt_b else None
+    base_rate = baseline["images_per_sec"] / n_chips
+    tgt_rate = target["images_per_sec"] / n_chips
+    out = {
+        "metric": metric,
+        "value": ratio if ratio is not None else 0.0,
+        "unit": unit,
+        "vs_baseline": 0.0,
+        "baseline_kind": "f32-wire-self",
+        "chip": chip,
+        "num_chips": n_chips,
+        "mesh_axes": target.get("mesh_axes"),
+        "collective_dtype": wire_dtype or "float32",
+        "baseline_wire_bytes": base_b,
+        "target_wire_bytes": tgt_b,
+        "baseline_images_per_sec_per_chip": round(base_rate, 2),
+        "target_images_per_sec_per_chip": round(tgt_rate, 2),
+        # Relative throughput change from the wire format alone (same
+        # ladder, same mesh): +0.04 = 4% faster than the f32 wire.
+        "throughput_delta": round(tgt_rate / base_rate - 1.0, 4),
+        "run_id": writer.run_id,
+    }
+    _annotate_roofline(out, target, chip, n_chips)
+    _emit_bench_result(writer, f"resnet50-collective-{mode}", out, target)
+    print(json.dumps(out))
+    return 0
+
+
 def _run(writer) -> int:
     from distributed_tensorflow_framework_tpu.core import telemetry
 
@@ -704,16 +798,43 @@ def _run(writer) -> int:
             writer.emit(telemetry.KIND_BENCH_PROBE, t=rec.get("t"),
                         health={k: rec.get(k) for k in
                                 ("attempt", "elapsed_s", "outcome", "error")})
+        failure_class = getattr(e, "failure_class", "backend_error")
         writer.emit(telemetry.KIND_FAILURE,
                     health={"failure": "backend_init", "error": str(e),
+                            "failure_class": failure_class,
                             "num_probes": len(history)})
         fail = {"metric": metric, "value": 0.0, "unit": unit,
                 "vs_baseline": 0.0, "error": f"backend init: {e}",
+                "failure_class": failure_class,
                 "run_id": writer.run_id}
         if history:
             fail["probe_history"] = history
         print(json.dumps(fail))
+        if failure_class == "probe_hang":
+            # Distinct exit code: a hung probe is chip access flakiness,
+            # not a code regression — the driver must not count it
+            # against the dial under test (scripts/chip_window_queue.sh
+            # re-lands these instead of reverting).
+            print("bench: backend probe HANG — chip access flakiness, "
+                  "not a code regression (exit 3)", file=sys.stderr)
+            return 3
         return 1
+
+    coll_mode = os.environ.get("BENCH_COLLECTIVE", "").strip()
+    if coll_mode:
+        if coll_mode not in _COLLECTIVE_MODES:
+            err = (f"BENCH_COLLECTIVE={coll_mode!r} not in "
+                   f"{sorted(_COLLECTIVE_MODES)}")
+            writer.emit(telemetry.KIND_FAILURE,
+                        health={"failure": "bench_config", "error": err})
+            print(json.dumps({"metric": metric, "value": 0.0, "unit": unit,
+                              "vs_baseline": 0.0, "error": err,
+                              "run_id": writer.run_id}))
+            return 1
+        # The A/B owns the whole invocation (always the resnet50
+        # workload): one JSON line comparing f32 wire vs the requested
+        # format on the same ladder.
+        return _run_collective_ab(writer, coll_mode, n_chips, chip)
 
     if workload == "bert":
         # The transformer workload (kept OFF the driver's default path —

@@ -294,16 +294,42 @@ class CheckpointManager:
             directory=self._path, step=step)
 
         want_ema = bool(jax.tree.leaves(template.ema_params))
+        want_res = bool(jax.tree.leaves(template.collective_residual))
+        n_want = (jax.tree.leaves(template.collective_residual)[0].shape[0]
+                  if want_res else 0)
 
-        def tmpl_for(stored_ema: bool) -> TrainState:
-            """Restore template matching the stored tree's EMA presence."""
+        def _residual_read_tmpl() -> Any:
+            """Template subtree for READING a stored shaped residual: the
+            concrete (sharded) template when the replica count matches,
+            else host-side ShapeDtypeStructs at the STORED shape — folded
+            onto the new replica rows or dropped after the read."""
+            axes = (saved_topo or {}).get("axes") or {}
+            if not axes:
+                raise ValueError(
+                    f"checkpoint step {step} in {self._path} stores a "
+                    f"collective_residual but its manifest has no mesh "
+                    f"topology record — cannot derive the stored replica "
+                    f"dimension to fold/drop it"
+                )
+            n_saved = int(axes.get("data", 1)) * int(axes.get("fsdp", 1))
+            if want_res and n_saved == n_want:
+                return template.collective_residual
+            return jax.tree.map(
+                lambda p: jax.ShapeDtypeStruct((n_saved,) + p.shape,
+                                               jnp.float32),
+                template.params)
+
+        def tmpl_for(stored_ema: bool, stored_res: str) -> TrainState:
+            """Restore template matching the stored tree's EMA and
+            error-feedback-residual presence."""
+            t = template
             if want_ema and not stored_ema:
                 log.warning(
                     "Checkpoint at step %d has no EMA params (ema_decay "
                     "enabled after it was saved) — will re-seed EMA from "
                     "the restored params", step,
                 )
-                return template.replace(ema_params={})
+                t = t.replace(ema_params={})
             if stored_ema and not want_ema:
                 # Stored EMA must be read into a params-shaped template and
                 # discarded below (orbax's Standard handler has no partial
@@ -314,39 +340,85 @@ class CheckpointManager:
                     "Checkpoint at step %d carries EMA params but ema_decay "
                     "is now disabled — dropping them", step,
                 )
-                return template.replace(ema_params=template.params)
-            return template
+                t = t.replace(ema_params=template.params)
+            if stored_res == "shaped":
+                if not want_res:
+                    log.warning(
+                        "Checkpoint at step %d carries a collective "
+                        "error-feedback residual but quantized collectives "
+                        "are now off — dropping it", step,
+                    )
+                t = t.replace(collective_residual=_residual_read_tmpl())
+            else:
+                if want_res:
+                    log.warning(
+                        "Checkpoint at step %d has no collective residual "
+                        "(quantized collectives enabled after it was saved) "
+                        "— starting from a zero residual", step,
+                    )
+                t = t.replace(collective_residual={})
+            return t
 
-        def attempt(t: TrainState):
-            args = {"state": ocp.args.StandardRestore(_pack(t))}
+        def attempt(t: TrainState, *, legacy: bool):
+            item = _pack(t)
+            if legacy:
+                # Pre-residual checkpoint: flax dataclasses serialize as
+                # dicts, so restore into the historical six-key dict and
+                # rebuild the TrainState afterwards.
+                item = {
+                    "step": item.step, "params": item.params,
+                    "batch_stats": item.batch_stats,
+                    "opt_state": item.opt_state, "rng": item.rng,
+                    "ema_params": item.ema_params,
+                }
+            args = {"state": ocp.args.StandardRestore(item)}
             if dataset is not None:
                 args["data_iter"] = ocp.args.JsonRestore()
-            return self._mgr.restore(step, args=ocp.args.Composite(**args))
+            return self._mgr.restore(step, args=ocp.args.Composite(**args)), \
+                item
 
         stored_ema = self._stored_has_ema(step, default=want_ema)
-        tmpl = tmpl_for(stored_ema)
-        try:
-            restored = attempt(tmpl)
-        except ValueError as e:
-            # Fallback for when the metadata probe misjudged (its JSON
-            # layout is orbax-private and may change): a tree-structure
-            # mismatch on ema_params means the stored EMA presence is the
-            # opposite of what we assumed — flip the template and retry.
-            if "ema_params" not in str(e):
+        stored_res = self._stored_residual_presence(
+            step, default="shaped" if want_res else "empty")
+        ema_flipped = res_flipped = False
+        while True:
+            tmpl = tmpl_for(stored_ema, stored_res)
+            try:
+                restored, item = attempt(tmpl,
+                                         legacy=(stored_res == "missing"))
+                break
+            except ValueError as e:
+                # Fallbacks for when a metadata probe misjudged (the JSON
+                # layout is orbax-private and may change): a tree-structure
+                # mismatch naming the field means the stored presence is
+                # the opposite of what we assumed — flip and retry, once
+                # per field.
+                msg = str(e)
+                if "ema_params" in msg and not ema_flipped:
+                    log.warning(
+                        "EMA-presence probe disagreed with the stored tree "
+                        "(%s); retrying restore with the flipped EMA "
+                        "template", e,
+                    )
+                    ema_flipped, stored_ema = True, not stored_ema
+                    continue
+                if "collective_residual" in msg and not res_flipped:
+                    log.warning(
+                        "residual-presence probe disagreed with the stored "
+                        "tree (%s); retrying restore with the flipped "
+                        "residual template", e,
+                    )
+                    res_flipped = True
+                    stored_res = ("empty" if stored_res == "shaped"
+                                  else "shaped")
+                    continue
                 raise
-            log.warning(
-                "EMA-presence probe disagreed with the stored tree "
-                "(%s); retrying restore with the flipped EMA template", e,
-            )
-            stored_ema = not stored_ema
-            tmpl = tmpl_for(stored_ema)
-            restored = attempt(tmpl)
         if reshard_plan is not None:
             # Cross-mesh load succeeded mechanically; confirm it moved
             # bytes without reshaping them, then record the reshard in the
             # run's event stream (analyze_trace.py surfaces it).
             leaf_count = reshard.validate_restored(
-                _pack(tmpl), restored["state"], step=step)
+                item, restored["state"], step=step)
             self._emit(
                 telemetry.KIND_CKPT_RESHARDED, step=step,
                 from_axes=reshard_plan["from_axes"],
@@ -362,7 +434,29 @@ class CheckpointManager:
                 reshard.describe_axes(reshard_plan["from_axes"]),
                 reshard.describe_axes(reshard_plan["to_axes"]), leaf_count,
             )
-        state = _unpack(restored["state"], tmpl)
+        raw = restored["state"]
+        if stored_res == "missing":
+            # Legacy dict (pre-residual) → TrainState; collective_residual
+            # takes its {} default and is reconciled below.
+            raw = TrainState(**raw)
+        state = _unpack(raw, tmpl)
+        if want_res and stored_res == "shaped":
+            n_saved = jax.tree.leaves(state.collective_residual)[0].shape[0]
+            if n_saved != n_want:
+                folded = reshard.fold_residual(
+                    state.collective_residual, n_want)
+                state = state.replace(collective_residual=jax.tree.map(
+                    lambda f, t: jax.device_put(f, t.sharding),
+                    folded, template.collective_residual))
+                log.warning(
+                    "collective_residual folded %d -> %d replica rows "
+                    "(sum-preserving) across the reshard", n_saved, n_want,
+                )
+        elif want_res:
+            state = state.replace(
+                collective_residual=template.collective_residual)
+        elif jax.tree.leaves(state.collective_residual):
+            state = state.replace(collective_residual={})
         if want_ema and not stored_ema:
             # Real copies, not aliases: params and ema_params both live in
             # the donated TrainState — aliased buffers would be donated
@@ -467,6 +561,32 @@ class CheckpointManager:
             if keys and keys[0].get("key") == "ema_params" and len(keys) > 1:
                 return True
         return False
+
+    def _stored_residual_presence(self, step: int, *, default: str) -> str:
+        """Whether the stored tree carries a collective_residual subtree:
+        ``"missing"`` (pre-residual checkpoint — no such key), ``"empty"``
+        (the {} marker: quantized collectives were off) or ``"shaped"``
+        (per-replica residual arrays). Same best-effort ``_METADATA``
+        probe as ``_stored_has_ema``; ``default`` on unreadable metadata.
+        """
+        import json
+
+        path = os.path.join(self._path, str(step), "state", "_METADATA")
+        try:
+            with open(path) as fh:
+                tree_meta = json.load(fh).get("tree_metadata", {})
+        except Exception as e:
+            log.warning("residual-presence probe failed reading %s (%s) — "
+                        "assuming template shape", path, e)
+            return default
+        found = False
+        for entry in tree_meta.values():
+            keys = entry.get("key_metadata") or []
+            if keys and keys[0].get("key") == "collective_residual":
+                found = True
+                if len(keys) > 1:
+                    return "shaped"
+        return "empty" if found else "missing"
 
     def _stored_param_key_names(self, step: int) -> set[str] | None:
         """Dict-key names under the stored tree's ``params`` subtree, from

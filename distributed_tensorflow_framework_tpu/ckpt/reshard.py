@@ -41,6 +41,7 @@ import logging
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from distributed_tensorflow_framework_tpu.core.mesh import MESH_AXES
@@ -217,6 +218,34 @@ def check_restore_topology(saved: dict | None, template: Any, *,
         plan["leaf_count"], plan["respec_agreement"],
     )
     return plan
+
+
+def fold_residual(tree: Any, n_new: int) -> Any:
+    """Fold a stored ``(n_old, *shape)`` error-feedback residual
+    (train/state.TrainState.collective_residual) onto ``n_new`` replica
+    rows, preserving each leaf's column sum Σ_i r_i — the quantity error
+    feedback owes the optimizer (parallel/collectives.py): the mean
+    gradient trajectory is unchanged by HOW the total residual is
+    distributed over replicas, only by losing part of it.
+
+    Even shrinks (``n_old % n_new == 0``) sum ``k = n_old/n_new``
+    consecutive rows per new row; any other topology change collapses the
+    total into row 0 and restarts the remaining replicas from a zero
+    residual.
+    """
+
+    def fold(leaf):
+        n_old = leaf.shape[0]
+        if n_old == n_new:
+            return leaf
+        if n_old % n_new == 0:
+            k = n_old // n_new
+            return leaf.reshape((n_new, k) + leaf.shape[1:]).sum(axis=1)
+        total = jnp.sum(leaf, axis=0, keepdims=True)
+        pad = jnp.zeros((n_new - 1,) + leaf.shape[1:], leaf.dtype)
+        return jnp.concatenate([total, pad], axis=0)
+
+    return jax.tree.map(fold, tree)
 
 
 def validate_restored(template: Any, restored: Any, *, step: int) -> int:

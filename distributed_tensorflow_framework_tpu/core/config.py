@@ -13,12 +13,15 @@ holds is the logical mesh shape (`MeshConfig`).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import pathlib
 import re
 from dataclasses import dataclass, field
 from typing import Any
 
 import yaml
+
+log = logging.getLogger(__name__)
 
 
 def _fields(cls) -> dict[str, dataclasses.Field]:
@@ -343,9 +346,9 @@ class TrainConfig:
     # per-replica code with hand-placed collectives (the closer analogue of
     # the reference's SyncReplicasOptimizer + NCCL pipeline).
     spmd_mode: str = "jit"
-    # Wire dtype for the explicit gradient all-reduce (shard_map mode only):
-    # "" keeps the gradient dtype; "bfloat16" narrows collective bytes
-    # (EQuARX-style compression — most useful over DCN on multislice).
+    # DEPRECATED — use parallel.collective_dtype, which covers the fsdp
+    # gather/scatter wires too. load_config maps this onto it with a
+    # warning and rejects conflicting settings of both.
     grad_allreduce_dtype: str = ""
     # Accumulation for the compressed all-reduce: "float32" (default)
     # reduce-scatters in f32 (exact adds, 6/8 of f32 bytes, one
@@ -427,6 +430,33 @@ class ResilienceConfig:
 
 
 @config_dataclass
+class ParallelConfig:
+    """Collective wire-format knobs (parallel/collectives.py,
+    docs/PERFORMANCE.md "Quantized collectives")."""
+
+    # Wire dtype for the explicit collectives (shard_map mode only):
+    #   ""         — full-precision wires (bit-identical to pre-knob runs);
+    #   "bfloat16" — narrow the gradient all-reduce and fsdp gathers to
+    #                bf16 (f32 accumulation per train.grad_allreduce_accum);
+    #   "int8"     — EQuARX block-scaled int8 (per-block max-abs scales,
+    #                f32 accumulation of dequantized partials, ~3.9× fewer
+    #                wire bytes than f32) with a per-leaf error-feedback
+    #                residual carried in the training state.
+    # Subsumes the deprecated train.grad_allreduce_dtype, which mapped the
+    # same compression onto the gradient all-reduce only.
+    collective_dtype: str = ""
+    # Elements per quantization block for collective_dtype="int8". One f32
+    # scale rides the wire per block (~1.6% overhead at 256). Smaller
+    # blocks track magnitude variation more tightly at more overhead.
+    collective_block_size: int = 256
+    # Carry the int8 compression error forward in a per-leaf residual
+    # (TrainState.collective_residual) and re-inject it into the next
+    # step's gradients — compensated, not accumulated. Disable only for
+    # A/B measurement of the raw quantization error.
+    error_feedback: bool = True
+
+
+@config_dataclass
 class ExperimentConfig:
     name: str = "experiment"
     mesh: MeshConfig = field(default_factory=MeshConfig)
@@ -437,6 +467,7 @@ class ExperimentConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -494,6 +525,39 @@ def load_config(
                 and "num_classes" not in sec):
             sec["num_classes"] = 1000
     cfg = _build(ExperimentConfig, data)
+    # Deprecation shim: train.grad_allreduce_dtype predates the quantized
+    # collective layer and named only the gradient all-reduce wire; it maps
+    # onto parallel.collective_dtype (which also covers the fsdp
+    # gather/scatter wires). Conflicting settings of both are rejected
+    # rather than silently picking one.
+    if cfg.train.grad_allreduce_dtype:
+        if (cfg.parallel.collective_dtype
+                and cfg.parallel.collective_dtype
+                != cfg.train.grad_allreduce_dtype):
+            raise ValueError(
+                f"train.grad_allreduce_dtype="
+                f"{cfg.train.grad_allreduce_dtype!r} conflicts with "
+                f"parallel.collective_dtype="
+                f"{cfg.parallel.collective_dtype!r}; set only "
+                f"parallel.collective_dtype (the old knob is deprecated)"
+            )
+        if not cfg.parallel.collective_dtype:
+            log.warning(
+                "train.grad_allreduce_dtype is deprecated — mapping it to "
+                "parallel.collective_dtype=%r (docs/MIGRATING.md)",
+                cfg.train.grad_allreduce_dtype,
+            )
+            cfg.parallel.collective_dtype = cfg.train.grad_allreduce_dtype
+    if cfg.parallel.collective_dtype not in ("", "bfloat16", "int8"):
+        raise ValueError(
+            "parallel.collective_dtype must be '', 'bfloat16' or 'int8', "
+            f"got {cfg.parallel.collective_dtype!r}"
+        )
+    if cfg.parallel.collective_block_size < 1:
+        raise ValueError(
+            "parallel.collective_block_size must be >= 1, got "
+            f"{cfg.parallel.collective_block_size}"
+        )
     if cfg.model.pipeline_schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(
             "model.pipeline_schedule must be 'gpipe', '1f1b' or "

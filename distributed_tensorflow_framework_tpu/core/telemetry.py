@@ -308,7 +308,9 @@ def summarize_events(path: str) -> dict:
     summarizing. Returns event counts by kind, the step span, a
     ``ckpt_saves`` section (save count, async count, and loop-blocked vs
     total save milliseconds — the async-pipeline win is blocked ≪ total),
-    a ``startups`` list (restart → first-step latency per process), and a
+    a ``startups`` list (restart → first-step latency per process), a
+    ``collectives`` section (the last per-step wire/logical byte tally and
+    the resulting wire_compression ratio), and a
     ``recovery`` section: quarantined checkpoint steps, restore fallbacks
     (from → to), supervisor attempt classifications, preemptions, and any
     crash-loop verdict.
@@ -342,9 +344,14 @@ def summarize_events(path: str) -> dict:
     health_events: dict[str, int] = {}
     mesh_resizes: list[dict] = []
     ckpt_reshards: list[dict] = []
+    last_collectives: dict | None = None
     for ev in read_events(path, strict=False):
         kind = ev["kind"]
         kinds[kind] = kinds.get(kind, 0) + 1
+        if ev.get("collectives"):
+            # Per-step collective byte tally (parallel/collectives.py);
+            # static per compiled program, so the LAST one wins.
+            last_collectives = dict(ev["collectives"])
         if ev.get("run_id") and ev["run_id"] not in run_ids:
             run_ids.append(ev["run_id"])
         step = ev.get("step")
@@ -446,6 +453,20 @@ def summarize_events(path: str) -> dict:
         # number the analytic bubble_frac should explain.
         tail = sorted(step_rates[len(step_rates) // 2:])
         pipeline["steady_examples_per_sec"] = tail[len(tail) // 2]
+    collectives = None
+    if last_collectives:
+        # Wire vs logical per-step bytes (CollectiveTally summary):
+        # wire_compression > 1 means a narrow/quantized wire dtype
+        # (parallel.collective_dtype) is actually shrinking the traffic.
+        total = last_collectives.get("total_bytes")
+        logical = last_collectives.get("total_logical_bytes", total)
+        collectives = {
+            "total_bytes": total,
+            "total_logical_bytes": logical,
+            "wire_compression": (
+                round(float(logical) / float(total), 3)
+                if total and logical is not None else None),
+        }
     return {
         "path": path,
         "run_ids": run_ids,
@@ -459,6 +480,7 @@ def summarize_events(path: str) -> dict:
         "bench_probes": bench_probes,
         "trace_summaries": trace_summaries,
         "health_events": health_events,
+        "collectives": collectives,
         "ckpt_saves": saves,
         "startups": startups,
         "pipeline": pipeline,
@@ -519,6 +541,14 @@ def format_run_summary(summary: dict) -> str:
         lines.append(f"  backend probes: {summary['bench_probes']}")
     if summary.get("trace_summaries"):  # KIND_TRACE_SUMMARY rollup
         lines.append(f"  trace summaries: {summary['trace_summaries']}")
+    colls = summary.get("collectives")
+    if colls and colls.get("total_bytes") is not None:
+        comp = colls.get("wire_compression")
+        lines.append(
+            f"  collectives: {colls['total_bytes']:,} wire bytes/step"
+            f" ({colls['total_logical_bytes']:,} logical"
+            + (f", {comp:g}x compression" if comp else "") + ")"
+        )
     if summary.get("health_events"):  # KIND_HEALTH rollup
         lines.append(
             "  health events: " + ", ".join(

@@ -27,10 +27,20 @@ class TrainState:
     # tf.train.ExponentialMovingAverage of the reference recipe class;
     # eval reads these when optimizer.ema_decay > 0.
     ema_params: Any = flax.struct.field(default_factory=dict)
+    # Error-feedback residual for the int8 quantized all-reduce ({} unless
+    # parallel.collective_dtype="int8" with error feedback, shard_map
+    # mode): one f32 leaf per param leaf, globally (n_dp, *param.shape)
+    # sharded over the data axes — row i is replica i's uncompensated
+    # compression error, re-injected into its next step's gradients
+    # (parallel/collectives.allreduce_gradients_ef). Checkpointed like any
+    # other state; resharding sum-folds rows (ckpt/reshard.fold_residual)
+    # so the conserved total error survives a mesh change.
+    collective_residual: Any = flax.struct.field(default_factory=dict)
 
     @classmethod
     def create(cls, *, params, batch_stats, tx: optax.GradientTransformation,
-               rng: jax.Array, ema: bool = False) -> "TrainState":
+               rng: jax.Array, ema: bool = False,
+               collective_residual: Any = None) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -38,4 +48,6 @@ class TrainState:
             opt_state=tx.init(params),
             rng=rng,
             ema_params=jax.tree.map(jnp.copy, params) if ema else {},
+            collective_residual=(
+                {} if collective_residual is None else collective_residual),
         )

@@ -39,6 +39,17 @@ from distributed_tensorflow_framework_tpu.train.state import TrainState
 DATA_AXES = ("data", "fsdp")
 
 
+def _fsdp_dim(shape, fsdp_n: int) -> int:
+    """Dim index the explicit-fsdp path shards over: the largest
+    fsdp-divisible dim (mirrors parallel/sharding._apply_fsdp's rule), or
+    -1 for replicated leaves (no divisible dim, scalars)."""
+    best, best_size = -1, 0
+    for i, d in enumerate(shape):
+        if d % fsdp_n == 0 and d > best_size:
+            best, best_size = i, d
+    return best
+
+
 def task_for_model(name: str) -> str:
     from distributed_tensorflow_framework_tpu.models import custom_model_task
 
@@ -69,9 +80,16 @@ class StepBuilder:
         self.mesh = mesh
         self.task = task_for_model(config.model.name)
         self.shard_map_mode = config.train.spmd_mode == "shard_map"
-        if config.train.grad_allreduce_dtype and not self.shard_map_mode:
+        # Collective wire format: parallel.collective_dtype, with the
+        # deprecated train.grad_allreduce_dtype honored for configs built
+        # without load_config's shim.
+        self._collective_dtype = (config.parallel.collective_dtype
+                                  or config.train.grad_allreduce_dtype)
+        self._collective_block = config.parallel.collective_block_size
+        if self._collective_dtype and not self.shard_map_mode:
             raise ValueError(
-                "train.grad_allreduce_dtype only applies to the explicit "
+                "parallel.collective_dtype (and the deprecated "
+                "train.grad_allreduce_dtype) only applies to the explicit "
                 "collective path — set train.spmd_mode='shard_map' (under "
                 "'jit' XLA owns the gradient reduction wire format)"
             )
@@ -80,6 +98,34 @@ class StepBuilder:
                 "train.grad_allreduce_accum must be 'float32' or 'wire', "
                 f"got {config.train.grad_allreduce_accum!r}"
             )
+        # Error-feedback residual rides the TrainState only for the int8
+        # block-scaled all-reduce (parallel/collectives.py).
+        self._use_residual = (self.shard_map_mode
+                              and self._collective_dtype == "int8"
+                              and config.parallel.error_feedback)
+        # shard_map + mesh.fsdp>1 runs EXPLICIT fsdp: params/opt state/EMA
+        # sharded over fsdp, a hand-placed (optionally quantized)
+        # all_gather around the fwd/bwd, grads sliced back to shards for
+        # the update. With fsdp==1 the path is pure replicated DP as
+        # before.
+        self._explicit_fsdp = (self.shard_map_mode
+                               and mesh.shape.get("fsdp", 1) > 1)
+        if self._explicit_fsdp:
+            if config.optimizer.name == "lars":
+                raise ValueError(
+                    "optimizer.name='lars' needs full per-layer param/update "
+                    "norms, but explicit fsdp (spmd_mode='shard_map' with "
+                    "mesh.fsdp>1) updates parameter SHARDS — use "
+                    "spmd_mode='jit' for lars+fsdp"
+                )
+            if config.optimizer.grad_clip_norm > 0:
+                raise ValueError(
+                    "optimizer.grad_clip_norm>0 computes the global grad "
+                    "norm inside the optimizer, which under explicit fsdp "
+                    "(spmd_mode='shard_map' with mesh.fsdp>1) sees only "
+                    "gradient SHARDS — use spmd_mode='jit' for clipped "
+                    "fsdp training"
+                )
         if (self.task == "mlm"
                 and getattr(config.data, "vocab_size", None) is not None
                 and config.data.vocab_size > config.model.vocab_size):
@@ -171,6 +217,7 @@ class StepBuilder:
             config.optimizer, config.train.total_steps
         )
         self._state_specs = None
+        self._fsdp_dims = None  # params-shaped tree of shard dims (fsdp)
 
     def set_schedule_wrapper(self, wrapper) -> None:
         """Rebuild tx/schedule with ``wrapper`` applied (the post-rollback
@@ -195,9 +242,20 @@ class StepBuilder:
         )
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
+        residual = None
+        if self._use_residual:
+            # One f32 row per data-parallel replica, globally
+            # (n_dp, *param.shape) sharded over DATA_AXES — each replica's
+            # local slice is its own uncompensated quantization error.
+            n_dp = (self.mesh.shape.get("data", 1)
+                    * self.mesh.shape.get("fsdp", 1))
+            residual = jax.tree.map(
+                lambda p: jnp.zeros((n_dp,) + p.shape, jnp.float32), params
+            )
         return TrainState.create(
             params=params, batch_stats=batch_stats, tx=self.tx,
             rng=dropout_root, ema=self.config.optimizer.ema_decay > 0,
+            collective_residual=residual,
         )
 
     def state_specs(self, sample_batch: Any) -> Any:
@@ -205,10 +263,42 @@ class StepBuilder:
             seed = jnp.zeros((1,), jnp.uint32)
             shapes = jax.eval_shape(self._create_state, seed, sample_batch)
             if self.shard_map_mode:
-                # The explicit-collective path is pure DP (reference
-                # semantics): params fully replicated. FSDP/TP layouts are
-                # the jit path's job.
-                self._state_specs = jax.tree.map(lambda _: P(), shapes)
+                # Pure DP (reference semantics) replicates everything.
+                # Explicit fsdp (mesh.fsdp>1) shards params / optimizer
+                # slots / EMA over the fsdp axis by shape; the EF residual
+                # shards its replica row over the combined data axes.
+                specs = jax.tree.map(lambda _: P(), shapes)
+                if self._explicit_fsdp:
+                    if jax.tree.leaves(shapes.batch_stats):
+                        raise ValueError(
+                            "explicit fsdp (spmd_mode='shard_map' with "
+                            "mesh.fsdp>1) does not support BN models: "
+                            "running stats would be updated from gathered "
+                            "params on every replica — use spmd_mode='jit' "
+                            "or a BN-free model"
+                        )
+                    fsdp_n = self.mesh.shape["fsdp"]
+
+                    def leaf_spec(s):
+                        d = _fsdp_dim(s.shape, fsdp_n)
+                        if d < 0:
+                            return P()
+                        parts = [None] * len(s.shape)
+                        parts[d] = "fsdp"
+                        return P(*parts)
+
+                    self._fsdp_dims = jax.tree.map(
+                        lambda s: _fsdp_dim(s.shape, fsdp_n), shapes.params)
+                    specs = specs.replace(
+                        params=jax.tree.map(leaf_spec, shapes.params),
+                        opt_state=jax.tree.map(leaf_spec, shapes.opt_state),
+                        ema_params=jax.tree.map(leaf_spec,
+                                                shapes.ema_params),
+                    )
+                if self._use_residual:
+                    specs = specs.replace(collective_residual=jax.tree.map(
+                        lambda _: P(DATA_AXES), shapes.collective_residual))
+                self._state_specs = specs
             elif self.config.optimizer.shard_opt_state:
                 # ZeRO-1 (cross-replica weight-update sharding): params /
                 # BN stats / EMA replicated like pure DP, optimizer slots
@@ -440,16 +530,61 @@ class StepBuilder:
                                        new_model_state)
 
     def _train_step_replica(self, state: TrainState, batch: Any):
-        grads, metrics, new_model_state = self._loss_and_updates(state, batch)
+        wire = self._collective_dtype
+        block = self._collective_block
+        if self._explicit_fsdp:
+            # Unshard params for fwd/bwd: the hand-placed (optionally
+            # quantized) all_gather over fsdp — the explicit twin of the
+            # jit path's XLA-inserted fsdp gather.
+            def gather(p, dim):
+                if dim < 0:
+                    return p
+                return coll.all_gather(p, "fsdp", axis=dim, tiled=True,
+                                       wire_dtype=wire or None,
+                                       block_size=block)
+
+            full_params = jax.tree.map(gather, state.params, self._fsdp_dims)
+            grads, metrics, new_model_state = self._loss_and_updates(
+                state.replace(params=full_params), batch)
+        else:
+            grads, metrics, new_model_state = self._loss_and_updates(
+                state, batch)
         # Explicit sync-DP: mean grads across replicas — the NCCL all-reduce
         # site of the reference (SURVEY.md §2 row 3). Optionally compressed
-        # to a narrower wire dtype (train.grad_allreduce_dtype).
-        wire = self.config.train.grad_allreduce_dtype
-        grads = coll.allreduce_gradients(
-            grads, DATA_AXES,
-            compute_dtype=jnp.dtype(wire) if wire else None,
-            accumulate_f32=self.config.train.grad_allreduce_accum == "float32",
-        )
+        # to a narrower wire dtype (parallel.collective_dtype): bfloat16
+        # casts; int8 runs the block-scaled reduce, with the per-replica
+        # quantization error carried in state.collective_residual when
+        # error feedback is on.
+        new_residual = None
+        if self._use_residual:
+            residual = jax.tree.map(lambda r: r[0], state.collective_residual)
+            grads, new_res = coll.allreduce_gradients_ef(
+                grads, residual, DATA_AXES, block_size=block)
+            new_residual = jax.tree.map(lambda r: r[None], new_res)
+        else:
+            grads = coll.allreduce_gradients(
+                grads, DATA_AXES,
+                compute_dtype=jnp.dtype(wire) if wire else None,
+                accumulate_f32=(
+                    self.config.train.grad_allreduce_accum == "float32"),
+                block_size=block,
+            )
+        full_grad_norm = None
+        if self._explicit_fsdp:
+            # The update runs on shards; grad_norm must come from the FULL
+            # mean gradients, so take it before slicing.
+            full_grad_norm = coll.global_norm(grads)
+            fsdp_n = coll.axis_size("fsdp")
+            idx = coll.axis_index("fsdp")
+
+            def shard(g, dim):
+                if dim < 0:
+                    return g
+                size = g.shape[dim] // fsdp_n
+                return jax.lax.dynamic_slice_in_dim(
+                    g, idx * size, size, axis=dim)
+
+            grads = jax.tree.map(shard, grads, self._fsdp_dims)
         metrics = coll.pmean(metrics, DATA_AXES)
         if self._has_bn(state):
             # Running stats were updated from per/cross-replica batch stats;
@@ -458,7 +593,13 @@ class StepBuilder:
             new_model_state["batch_stats"] = coll.pmean(
                 new_model_state["batch_stats"], DATA_AXES
             )
-        return self._apply_updates(state, grads, metrics, new_model_state)
+        new_state, metrics = self._apply_updates(state, grads, metrics,
+                                                 new_model_state)
+        if full_grad_norm is not None:
+            metrics["grad_norm"] = full_grad_norm
+        if new_residual is not None:
+            new_state = new_state.replace(collective_residual=new_residual)
+        return new_state, metrics
 
     def make_train_step(self, sample_batch: Any) -> Callable:
         specs = self.state_specs(sample_batch)

@@ -81,4 +81,17 @@ run pp-gpipe       env BENCH_WORKLOAD=bert BENCH_PP=4 BENCH_MICRO=8 BENCH_SCHEDU
 run pp-1f1b        env BENCH_WORKLOAD=bert BENCH_PP=4 BENCH_MICRO=8 BENCH_SCHEDULE=1f1b python bench.py
 run pp-interleaved env BENCH_WORKLOAD=bert BENCH_PP=4 BENCH_MICRO=8 BENCH_SCHEDULE=interleaved python bench.py
 
+# 9. Quantized-collective wire-format A/B (docs/PERFORMANCE.md): each
+#    dial runs its OWN f32-wire shard_map baseline on the same ladder,
+#    so the JSON line is self-contained (wire-byte ratio + throughput
+#    delta) — CPU-verified ratio is ~3.6x for int8, the chip question is
+#    whether DCN/ICI time drops enough to show up in img/s at this
+#    scale. bench.py exits 3 (not 1) when the backend PROBE hangs:
+#    that is chip access flakiness, not a code regression — re-land the
+#    dial in the next window instead of reverting (BENCH_r04/r05 both
+#    died to a wedged tunnel, not to the code under test).
+run coll-f32  env BENCH_COLLECTIVE=f32 python bench.py
+run coll-bf16 env BENCH_COLLECTIVE=bf16 python bench.py
+run coll-int8 env BENCH_COLLECTIVE=int8 python bench.py
+
 echo "=== chip queue done $(date -u +%FT%TZ) ==="

@@ -174,3 +174,150 @@ def test_unscaled_roofline_carries_no_tag():
 def test_roofline_tag_needs_a_cost_model():
     # No XLA cost model (flops 0/None): nothing to scale, nothing to tag.
     assert _roofline(accum_scaled=True, flops=0) == {}
+
+
+# ---------------------------------------------- hang classification ----
+# A probe HANG is chip access flakiness (wedged tunnel, slice still
+# provisioning), not a code regression: it must carry a distinct
+# failure_class and exit the bench with rc 3, so the chip-window queue
+# re-lands the dial instead of counting it against the code under test.
+
+
+def test_hang_raises_with_probe_hang_class():
+    state, monotonic, sleep = _fake_clock()
+    with pytest.raises(bench.BenchBackendError) as exc:
+        bench._init_backend(
+            probe=lambda t: ("hang", "reaped"),
+            sleep=sleep, monotonic=monotonic, wait_budget_s=0)
+    assert exc.value.failure_class == "probe_hang"
+
+
+def test_hang_budget_exhausted_keeps_probe_hang_class():
+    state, monotonic, sleep = _fake_clock()
+    with pytest.raises(bench.BenchBackendError) as exc:
+        bench._init_backend(
+            probe=lambda t: ("hang", "reaped"),
+            sleep=sleep, monotonic=monotonic,
+            wait_budget_s=60, hang_retry_delay_s=15)
+    assert exc.value.failure_class == "probe_hang"
+
+
+def test_probe_error_is_not_a_hang():
+    with pytest.raises(bench.BenchBackendError) as exc:
+        bench._init_backend(
+            probe=lambda t: ("error", "RuntimeError: no tpu"),
+            sleep=lambda s: None, wait_budget_s=0)
+    assert exc.value.failure_class == "backend_error"
+
+
+class _FakeWriter:
+    run_id = "test-run"
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **kw):
+        self.events.append((kind, kw))
+
+    def emit_run_meta(self, **kw):
+        pass
+
+
+def _run_with_backend_error(monkeypatch, capsys, err):
+    import json
+
+    monkeypatch.delenv("BENCH_WORKLOAD", raising=False)
+    monkeypatch.delenv("BENCH_COLLECTIVE", raising=False)
+
+    def boom():
+        raise err
+
+    monkeypatch.setattr(bench, "_init_backend", boom)
+    writer = _FakeWriter()
+    rc = bench._run(writer)
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return rc, json.loads(out), writer
+
+
+def test_run_exits_3_on_probe_hang(monkeypatch, capsys):
+    err = bench.BenchBackendError(
+        "backend probe hung", [{"attempt": 1, "outcome": "hang"}],
+        failure_class="probe_hang")
+    rc, fail, writer = _run_with_backend_error(monkeypatch, capsys, err)
+    assert rc == 3
+    assert fail["failure_class"] == "probe_hang"
+    assert fail["value"] == 0.0 and "error" in fail
+    # and the telemetry failure event carries the class too
+    failures = [kw for kind, kw in writer.events
+                if kw.get("health", {}).get("failure") == "backend_init"]
+    assert failures and failures[0]["health"]["failure_class"] == "probe_hang"
+
+
+def test_run_exits_1_on_ordinary_backend_error(monkeypatch, capsys):
+    err = bench.BenchBackendError("RuntimeError: no tpu", [])
+    rc, fail, writer = _run_with_backend_error(monkeypatch, capsys, err)
+    assert rc == 1
+    assert fail["failure_class"] == "backend_error"
+
+
+# ------------------------------------------- collective wire-format A/B
+
+
+def _fake_resnet(rate, wire_bytes):
+    return {"images_per_sec": rate, "sec_per_step": 0.1,
+            "flops_per_step": None, "bytes_per_step": None,
+            "collectives": {"total_bytes": wire_bytes,
+                            "total_logical_bytes": 800_000},
+            "mesh_axes": {"data": 8}}
+
+
+def test_collective_ab_reports_ratio_and_delta(monkeypatch, capsys):
+    import json
+
+    calls = []
+
+    def fake_bench(bs, base_overrides=None, **kw):
+        wire = (base_overrides or {}).get(
+            "parallel", {}).get("collective_dtype", "")
+        calls.append(wire)
+        assert (base_overrides or {}).get(
+            "train", {}).get("spmd_mode") == "shard_map"
+        return (_fake_resnet(1040.0, 200_000) if wire == "int8"
+                else _fake_resnet(1000.0, 800_000))
+
+    monkeypatch.setattr(bench, "bench_resnet50", fake_bench)
+    rc = bench._run_collective_ab(_FakeWriter(), "int8", 8, "TPU v5e")
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert calls == ["", "int8"]  # baseline first, then the target wire
+    assert out["value"] == 4.0    # wire-byte ratio from the tally
+    assert out["throughput_delta"] == 0.04
+    assert out["collective_dtype"] == "int8"
+    assert out["baseline_wire_bytes"] == 800_000
+    assert out["target_wire_bytes"] == 200_000
+
+
+def test_collective_ab_f32_is_self_calibration(monkeypatch, capsys):
+    import json
+
+    calls = []
+
+    def fake_bench(bs, base_overrides=None, **kw):
+        calls.append(bs)
+        return _fake_resnet(1000.0, 800_000)
+
+    monkeypatch.setattr(bench, "bench_resnet50", fake_bench)
+    rc = bench._run_collective_ab(_FakeWriter(), "f32", 8, "TPU v5e")
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and len(calls) == 1  # one run: baseline IS the target
+    assert out["value"] == 1.0 and out["throughput_delta"] == 0.0
+
+
+def test_bench_collective_env_validated(monkeypatch, capsys):
+    import json
+
+    monkeypatch.setenv("BENCH_COLLECTIVE", "fp4")
+    monkeypatch.setattr(bench, "_init_backend", lambda: (8, "TPU v5e"))
+    rc = bench._run(_FakeWriter())
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and "BENCH_COLLECTIVE" in out["error"]

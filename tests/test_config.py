@@ -79,3 +79,32 @@ def test_print_config_roundtrips(capsys):
     # And the dump is itself a loadable config (round-trip property).
     cfg = load_config(base=dumped)
     assert cfg.train.total_steps == 7
+
+
+def test_grad_allreduce_dtype_deprecation_shim(caplog):
+    """train.grad_allreduce_dtype predates parallel.collective_dtype; the
+    old spelling must keep working (mapped with a warning), agree-both
+    must pass silently, and a conflict must be a hard error — a silent
+    precedence pick would change which wire format a run uses."""
+    import logging
+
+    with caplog.at_level(logging.WARNING):
+        cfg = load_config(overrides=["train.grad_allreduce_dtype=bfloat16"])
+    assert cfg.parallel.collective_dtype == "bfloat16"
+    assert "deprecated" in caplog.text
+
+    # Both knobs set to the SAME value: fine (explicit, unambiguous).
+    cfg = load_config(overrides=["train.grad_allreduce_dtype=int8",
+                                 "parallel.collective_dtype=int8"])
+    assert cfg.parallel.collective_dtype == "int8"
+
+    with pytest.raises(ValueError, match="conflicts"):
+        load_config(overrides=["train.grad_allreduce_dtype=bfloat16",
+                               "parallel.collective_dtype=int8"])
+
+
+def test_collective_dtype_validated():
+    with pytest.raises(ValueError, match="collective_dtype"):
+        load_config(overrides=["parallel.collective_dtype=fp8"])
+    with pytest.raises(ValueError, match="collective_block_size"):
+        load_config(overrides=["parallel.collective_block_size=0"])

@@ -148,3 +148,42 @@ def test_audit_sees_the_known_drills():
     # Specialized *_DRIVER templates count too (recovery-ladder drills).
     rd = ast.parse((TESTS_DIR / "test_recovery_drills.py").read_text())
     assert _defines_or_imports_driver(rd)
+
+
+COLLECTIVES_PY = (TESTS_DIR.parent / "distributed_tensorflow_framework_tpu"
+                  / "parallel" / "collectives.py")
+
+
+def _tally_total_fields() -> list[str]:
+    """The TALLY_TOTAL_FIELDS tuple from parallel/collectives.py, by ast
+    (same no-import discipline as the KIND_* audit)."""
+    tree = ast.parse(COLLECTIVES_PY.read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "TALLY_TOTAL_FIELDS":
+                    return [ast.literal_eval(e) for e in node.value.elts]
+    raise AssertionError(f"TALLY_TOTAL_FIELDS not found in {COLLECTIVES_PY}")
+
+
+def test_every_tally_total_field_is_rolled_up():
+    """Each grand-total field the CollectiveTally emits must surface in
+    the telemetry rollup (summarize_events/format_run_summary source) —
+    a total the post-mortem summary never prints silently rots, exactly
+    like an unsummarized KIND_*."""
+    fields = _tally_total_fields()
+    assert "total_bytes" in fields and "total_logical_bytes" in fields
+    source = TELEMETRY_PY.read_text()
+    tree = ast.parse(source)
+    rollup_src = (_function_source(tree, source, "summarize_events")
+                  + _function_source(tree, source, "format_run_summary"))
+    missing = [f for f in fields if f not in rollup_src]
+    assert not missing, (
+        f"CollectiveTally total fields with no telemetry rollup: {missing}")
+
+
+def test_every_tally_total_field_is_referenced_by_a_test():
+    corpus = "".join(
+        p.read_text() for p in sorted(TESTS_DIR.glob("test_*.py")))
+    missing = [f for f in _tally_total_fields() if f not in corpus]
+    assert not missing, f"tally total fields no test references: {missing}"

@@ -134,15 +134,19 @@ def test_collective_tally_2dev_shard_map(devices):
     jax.block_until_ready(out)
 
     s = t.summary()
-    assert s["pmean_calls"] == 1 and s["pmean_bytes"] == 16
-    assert s["all_gather_calls"] == 1 and s["all_gather_bytes"] == 16
-    assert s["total_bytes"] == 32
+    # Ring convention (CollectiveTally docstring): all-reduce counts 2x
+    # its 16 B payload, all-gather counts its OUTPUT (n x the shard).
+    assert s["pmean_calls"] == 1 and s["pmean_bytes"] == 32
+    assert s["all_gather_calls"] == 1 and s["all_gather_bytes"] == 32
+    assert s["total_bytes"] == 64
+    # f32 wire == logical dtype: no compression, totals coincide.
+    assert s["total_logical_bytes"] == 64
 
     # Counters record at TRACE time: a second dispatch of the same
     # executable adds nothing (the numbers describe every step).
     with coll.tally() as t2:
         jax.block_until_ready(mapped(x))
-    assert t2.summary() == {"total_bytes": 0}
+    assert t2.summary() == {"total_bytes": 0, "total_logical_bytes": 0}
 
 
 def test_collective_tally_allreduce_gradients(devices):
@@ -158,8 +162,66 @@ def test_collective_tally_allreduce_gradients(devices):
         jax.block_until_ready(mapped(grads))
     s = t.summary()
     assert s["allreduce_grads_pmean_calls"] == 2  # one per tree leaf
-    assert s["allreduce_grads_pmean_bytes"] == (8 + 6) * 4
-    assert s["total_bytes"] == (8 + 6) * 4
+    assert s["allreduce_grads_pmean_bytes"] == (8 + 6) * 4 * 2  # ring 2x
+    assert s["total_bytes"] == (8 + 6) * 4 * 2
+    assert s["total_logical_bytes"] == s["total_bytes"]
+
+
+def test_collective_tally_int8_wire_vs_logical(devices):
+    """The int8 block-scaled all-reduce must tally wire bytes (int8 codes
+    + f32 scales) SEPARATELY from logical bytes — their ratio is the
+    compression the A/B exists to measure."""
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    grads = {"w": np.ones((256,), np.float32)}
+    grads = jax.device_put(grads, jax.sharding.NamedSharding(mesh, P()))
+
+    mapped = jax.jit(coll.shard_map(
+        lambda g: coll.allreduce_gradients(
+            g, ("data",), compute_dtype="int8", block_size=64),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
+    with coll.tally() as t:
+        out = jax.block_until_ready(mapped(grads))
+    s = t.summary()
+
+    # scatter phase: 256 int8 codes + 4 blocks x 4 B scales = 272 wire,
+    # vs 256 f32 = 1024 logical. gather phase: 128-elem chunk x n=2
+    # output + 2x2 scales = 272 wire vs 1024 logical.
+    assert s["allreduce_grads_q8_scatter_bytes"] == 272
+    assert s["allreduce_grads_q8_scatter_logical_bytes"] == 1024
+    assert s["allreduce_grads_q8_gather_bytes"] == 272
+    assert s["allreduce_grads_q8_gather_logical_bytes"] == 1024
+    assert s["total_bytes"] == 544
+    assert s["total_logical_bytes"] == 2048
+    # A constant tree quantizes exactly: the mean of all-ones is all-ones.
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.ones(256))
+
+
+def test_summarize_collectives_rollup(tmp_path):
+    """Per-step tallies ride train_step events; the run summary reports
+    the LAST one (static per compiled program) with the wire-compression
+    ratio."""
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="coll")
+    w.emit(telemetry.KIND_TRAIN_STEP, step=1, metrics={"loss": 1.0},
+           collectives={"total_bytes": 544, "total_logical_bytes": 2048})
+    w.close()
+    s = telemetry.summarize_events(path)
+    assert s["collectives"] == {"total_bytes": 544,
+                                "total_logical_bytes": 2048,
+                                "wire_compression": round(2048 / 544, 3)}
+    text = telemetry.format_run_summary(s)
+    assert "collectives: 544 wire bytes/step (2,048 logical" in text
+    assert "x compression" in text
+
+
+def test_summarize_without_collectives(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="nocoll")
+    w.emit(telemetry.KIND_TRAIN_STEP, step=1, metrics={"loss": 1.0})
+    w.close()
+    s = telemetry.summarize_events(path)
+    assert s["collectives"] is None
+    assert "collectives:" not in telemetry.format_run_summary(s)
 
 
 # ------------------------------------------------------- run-health hooks ----
