@@ -62,15 +62,20 @@ class MeshTopologyError(ValueError):
     Raised instead of letting orbax fail deep inside ``StandardRestore``
     with a sharding/layout error that names neither mesh. Carries both
     descriptors and names the knob (``checkpoint.allow_reshard``) that
-    turns the refusal into a reshard.
+    turns the refusal into a reshard. ``hint`` lets a caller that holds
+    a more specific knob append its own one-liner — the serving export
+    path names ``serve.allow_reshard`` (serve/export.py), since telling
+    an inference operator to flip a checkpoint.* training knob sends
+    them to the wrong config block.
     """
 
     def __init__(self, saved_axes: dict, requested_axes: dict, *,
-                 directory: str, step: int):
+                 directory: str, step: int, hint: str | None = None):
         self.saved_axes = dict(saved_axes)
         self.requested_axes = dict(requested_axes)
         self.directory = directory
         self.step = step
+        self.hint = hint
         super().__init__(
             f"Checkpoint at step {step} in {directory} was saved under "
             f"mesh {describe_axes(saved_axes)} but the run is configured "
@@ -79,7 +84,7 @@ class MeshTopologyError(ValueError):
             f"new mesh (partition specs are re-derived against it), or "
             f"restore on matching hardware. docs/RESILIENCE.md 'losing a "
             f"slice' covers the elastic-supervisor path that does this "
-            f"automatically."
+            f"automatically." + (f" {hint}" if hint else "")
         )
 
 

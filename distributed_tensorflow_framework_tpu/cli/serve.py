@@ -1,0 +1,101 @@
+"""``python -m distributed_tensorflow_framework_tpu.cli.serve`` — stand up
+the batched-inference server on an exported artifact.
+
+    python -m distributed_tensorflow_framework_tpu.cli.serve \
+        --artifact /runs/lenet_artifact \
+        [--set serve.port=8000 --set serve.max_batch_size=16 \
+         --set serve.seq_buckets=[32,64,128]]
+
+Everything about the standing engine is a ``serve.*`` knob (the model
+itself comes from the artifact, so ``--config`` is optional and only
+consulted for the serve block). The process serves until SIGTERM, then
+drains in-flight requests and exits 0 — the same graceful-preemption
+contract the trainer honors. The resolved endpoint (ephemeral ports
+included) is written to ``<log_dir>/endpoint.json`` for tooling like
+scripts/load_gen.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+from distributed_tensorflow_framework_tpu.cli.train import (
+    _honor_platform_env,
+)
+from distributed_tensorflow_framework_tpu.core.config import load_config
+from distributed_tensorflow_framework_tpu.core.metrics import setup_logging
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--artifact", type=str, default=None,
+                   help="artifact directory from cli/export.py (overrides "
+                        "serve.artifact_dir)")
+    p.add_argument("--config", type=str, default=None,
+                   help="optional YAML config (serve.* block)")
+    p.add_argument(
+        "--set", dest="overrides", action="append", default=[],
+        metavar="key.path=value", help="config override (repeatable)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    _honor_platform_env()
+    args = parse_args(argv)
+    config = load_config(args.config, overrides=list(args.overrides))
+    srv = config.serve
+    artifact_dir = args.artifact or srv.artifact_dir
+    if not artifact_dir:
+        log.error("no artifact: pass --artifact or set serve.artifact_dir")
+        return 2
+
+    from distributed_tensorflow_framework_tpu.core import telemetry
+    from distributed_tensorflow_framework_tpu.serve.engine import (
+        InferenceEngine,
+    )
+    from distributed_tensorflow_framework_tpu.serve.export import (
+        load_artifact,
+    )
+    from distributed_tensorflow_framework_tpu.serve.server import (
+        ServingServer,
+    )
+
+    artifact = load_artifact(artifact_dir)
+    log_dir = srv.log_dir or os.path.join(artifact_dir, "serve_logs")
+    os.makedirs(log_dir, exist_ok=True)
+    writer = telemetry.TelemetryWriter(
+        os.path.join(log_dir, "events.jsonl"))
+    writer.emit_run_meta(
+        argv=list(argv if argv is not None else sys.argv),
+        config=config.name, role="serve", artifact=artifact_dir,
+        model=artifact.model_config.name, step=artifact.step)
+    engine = InferenceEngine(artifact, srv, telemetry_writer=writer)
+    server = ServingServer(engine, srv, telemetry_writer=writer)
+    # The resolved endpoint record: with serve.port=0 the OS picked the
+    # port, so tooling polls this file instead of guessing.
+    endpoint = {
+        "url": f"http://{server.host}:{server.port}",
+        "host": server.host, "port": server.port, "pid": os.getpid(),
+        "artifact": os.path.abspath(artifact_dir),
+        "events": os.path.join(log_dir, "events.jsonl"),
+    }
+    with open(os.path.join(log_dir, "endpoint.json"), "w") as fh:
+        json.dump(endpoint, fh, indent=2)
+        fh.write("\n")
+    server.install_sigterm_drain()
+    try:
+        server.serve_forever()
+    finally:
+        writer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

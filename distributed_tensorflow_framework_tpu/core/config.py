@@ -457,6 +457,58 @@ class ParallelConfig:
 
 
 @config_dataclass
+class ServeConfig:
+    """Standing batched-inference engine (serve/, docs/SERVING.md).
+
+    The serving mesh is DATA-PARALLEL ONLY by design: a serving replica is
+    the deployment unit and params are replicated across it (multi-stage
+    pipelined serving is the 1F1B slot-table follow-up, ROADMAP item 3).
+    """
+
+    # Frozen artifact directory written by cli/export.py (serve/export.py).
+    artifact_dir: str = ""
+    # HTTP front end (serve/server.py). port=0 binds an ephemeral port
+    # (tests / local probing); cli/serve.py writes the resolved endpoint
+    # to <log_dir>/endpoint.json either way.
+    host: str = "127.0.0.1"
+    port: int = 8000
+    # Devices in the serving mesh (-1 = all visible). Unlike mesh.data
+    # this may be SMALLER than the visible device count — serving takes
+    # the first `data` devices, so a training-mesh checkpoint restores
+    # onto a 1-device engine on an 8-device host.
+    data: int = 1
+    # Dynamic batching admission: close a batch at max_batch_size rows,
+    # or max_wait_ms after the FIRST queued request arrived — the
+    # latency/fill tradeoff dial.
+    max_batch_size: int = 8
+    max_wait_ms: float = 5.0
+    # Padding buckets for variable-length (MLM) requests: ascending seq
+    # lengths a batch is padded up to ([] = one bucket at the model's
+    # max_seq_len). Together with the power-of-two row buckets this
+    # bounds XLA recompiles to len(seq_buckets) x len(row buckets).
+    seq_buckets: list[int] = field(default_factory=list)
+    # Admission bound on queued requests: beyond this depth submit()
+    # fails fast (HTTP 503) instead of growing latency without bound.
+    queue_capacity: int = 1024
+    # Export-side: freeze the EMA params when the checkpoint carries them
+    # (matches the trainer's eval_use_ema eval contract).
+    use_ema: bool = True
+    # Gate for restoring a TRAINING-mesh checkpoint onto the serving
+    # mesh. Off, a topology mismatch raises the typed MeshTopologyError
+    # naming this knob — the same deliberate gate as
+    # checkpoint.allow_reshard, scoped to the serve path.
+    allow_reshard: bool = False
+    # Graceful SIGTERM drain budget (mirrors the supervisor's preemption
+    # contract, core/supervision.py): stop admitting, finish every
+    # in-flight request within this budget, flush telemetry, exit 0.
+    drain_timeout_s: float = 30.0
+    # Cadence of the KIND_SERVE_QUEUE / KIND_SERVE_LATENCY gauge events.
+    report_interval_s: float = 10.0
+    # Telemetry logdir ("" = <artifact_dir>/serve_logs).
+    log_dir: str = ""
+
+
+@config_dataclass
 class ExperimentConfig:
     name: str = "experiment"
     mesh: MeshConfig = field(default_factory=MeshConfig)
@@ -468,6 +520,7 @@ class ExperimentConfig:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -577,6 +630,33 @@ def load_config(
             "resilience.loss_ewma_beta must be in (0, 1), got "
             f"{res.loss_ewma_beta}"
         )
+    srv = cfg.serve
+    if srv.max_batch_size < 1:
+        raise ValueError(
+            f"serve.max_batch_size must be >= 1, got {srv.max_batch_size}"
+        )
+    if srv.max_wait_ms < 0:
+        raise ValueError(
+            f"serve.max_wait_ms must be >= 0, got {srv.max_wait_ms}"
+        )
+    if srv.queue_capacity < 1:
+        raise ValueError(
+            f"serve.queue_capacity must be >= 1, got {srv.queue_capacity}"
+        )
+    if srv.seq_buckets:
+        if (any(int(b) < 1 for b in srv.seq_buckets)
+                or list(srv.seq_buckets) != sorted(set(srv.seq_buckets))):
+            raise ValueError(
+                "serve.seq_buckets must be strictly ascending positive "
+                f"sequence lengths, got {srv.seq_buckets} — each request "
+                f"is padded up to the smallest bucket that fits it"
+            )
+        if srv.seq_buckets[-1] > cfg.model.max_seq_len:
+            raise ValueError(
+                f"serve.seq_buckets max {srv.seq_buckets[-1]} exceeds "
+                f"model.max_seq_len={cfg.model.max_seq_len} — the model "
+                f"cannot embed positions past its trained length"
+            )
     # Head-vs-labels cross-check for the built-in classification datasets:
     # a label outside the head's range turns the loss metric into NaN
     # through the integer-label CE gather (fill semantics) while grads

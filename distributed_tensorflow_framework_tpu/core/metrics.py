@@ -16,7 +16,9 @@ their schema fields rather than flattened into one ad-hoc dict.
 from __future__ import annotations
 
 import logging
+import math
 import os
+import random
 import time
 from typing import Any, Mapping
 
@@ -115,6 +117,86 @@ def _fmt(v: Any) -> str:
     if isinstance(v, float):
         return f"{v:.6g}"
     return str(v)
+
+
+class PercentileReservoir:
+    """Streaming p50/p90/p99 over a bounded uniform sample (Vitter's
+    algorithm R).
+
+    The serving path (serve/engine.py) and the load generator
+    (scripts/load_gen.py) both need tail-latency percentiles over an
+    unbounded request stream without keeping every observation; a
+    capacity-bounded reservoir holds a uniform random sample of the
+    stream, so the nearest-rank percentile over the sample is an
+    estimate of the stream percentile with O(capacity) memory. Under
+    ``capacity`` observations the sample IS the stream and the
+    percentiles are exact. Seeded — same stream, same sample — so SLO
+    rollups are reproducible.
+
+    Not thread-safe; callers serialize (the engine adds from its single
+    batcher thread, the load generator under its results lock).
+    """
+
+    def __init__(self, capacity: int = 4096, *, seed: int = 0):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._seed = seed
+        self._rng = random.Random(seed)
+        self._values: list[float] = []
+        self._count = 0
+        self._sum = 0.0
+
+    @property
+    def count(self) -> int:
+        """Observations seen (not the retained sample size)."""
+        return self._count
+
+    def add(self, value: float) -> None:
+        v = float(value)
+        self._count += 1
+        self._sum += v
+        if len(self._values) < self.capacity:
+            self._values.append(v)
+        else:
+            j = self._rng.randrange(self._count)
+            if j < self.capacity:
+                self._values[j] = v
+
+    def percentile(self, p: float) -> float | None:
+        """Nearest-rank percentile over the retained sample; None when
+        empty. ``p`` is in [0, 100]."""
+        if not 0.0 <= p <= 100.0:
+            raise ValueError(f"percentile must be in [0, 100], got {p}")
+        if not self._values:
+            return None
+        ordered = sorted(self._values)
+        rank = max(1, math.ceil(p / 100.0 * len(ordered)))
+        return ordered[rank - 1]
+
+    def summary(self) -> dict[str, Any]:
+        """The SLO rollup shape the serve telemetry emits: count, mean,
+        p50/p90/p99 (None when no observations)."""
+        ordered = sorted(self._values)
+
+        def at(p: float) -> float | None:
+            if not ordered:
+                return None
+            return ordered[max(1, math.ceil(p / 100.0 * len(ordered))) - 1]
+
+        return {
+            "count": self._count,
+            "mean": (self._sum / self._count) if self._count else None,
+            "p50": at(50.0),
+            "p90": at(90.0),
+            "p99": at(99.0),
+        }
+
+    def reset(self) -> None:
+        self._rng = random.Random(self._seed)
+        self._values = []
+        self._count = 0
+        self._sum = 0.0
 
 
 class ThroughputMeter:

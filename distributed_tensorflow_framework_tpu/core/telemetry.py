@@ -93,6 +93,19 @@ KIND_PIPELINE = "pipeline_schedule"
 # one mesh onto another (ckpt/reshard.py, checkpoint.allow_reshard).
 KIND_MESH_RESIZED = "mesh_resized"
 KIND_CKPT_RESHARDED = "ckpt_resharded"
+# Serving SLO events (serve/engine.py, docs/SERVING.md): one per admitted
+# request (queue wait + end-to-end latency), one per executed batch (real
+# vs padded rows — the fill ratio — plus compute time and the queue depth
+# left behind), periodic queue-depth gauges, p50/p90/p99 latency rollups
+# from the bounded reservoir (core/metrics.PercentileReservoir), and the
+# first execution of each (seq, rows) padding bucket — the XLA recompile
+# budget is exactly the bucket set, so an unexpected recompile event IS
+# the bug.
+KIND_SERVE_REQUEST = "serve_request"
+KIND_SERVE_BATCH = "serve_batch"
+KIND_SERVE_QUEUE = "serve_queue_depth"
+KIND_SERVE_LATENCY = "serve_latency"
+KIND_SERVE_RECOMPILE = "serve_bucket_recompile"
 
 
 def make_run_id() -> str:
@@ -344,6 +357,12 @@ def summarize_events(path: str) -> dict:
     health_events: dict[str, int] = {}
     mesh_resizes: list[dict] = []
     ckpt_reshards: list[dict] = []
+    serve = {
+        "requests": 0, "rows": 0, "queue_wait_ms_total": 0.0,
+        "batches": 0, "batch_rows": 0, "padded_rows": 0,
+        "compute_ms_total": 0.0, "queue_depth_max": 0,
+        "recompiles": [], "latency": None,
+    }
     last_collectives: dict | None = None
     for ev in read_events(path, strict=False):
         kind = ev["kind"]
@@ -438,6 +457,40 @@ def summarize_events(path: str) -> dict:
                 "to_axes": extra.get("to_axes"),
                 "leaf_count": extra.get("leaf_count"),
             })
+        elif kind == KIND_SERVE_REQUEST:
+            m = ev.get("metrics") or {}
+            serve["requests"] += 1
+            serve["rows"] += int(m.get("rows", 1) or 1)
+            serve["queue_wait_ms_total"] += float(m.get("queue_wait_ms", 0.0))
+        elif kind == KIND_SERVE_BATCH:
+            m = ev.get("metrics") or {}
+            serve["batches"] += 1
+            serve["batch_rows"] += int(m.get("rows", 0) or 0)
+            serve["padded_rows"] += int(m.get("padded_rows", 0) or 0)
+            serve["compute_ms_total"] += float(m.get("compute_ms", 0.0))
+            serve["queue_depth_max"] = max(
+                serve["queue_depth_max"], int(m.get("queue_depth", 0) or 0))
+        elif kind == KIND_SERVE_QUEUE:
+            m = ev.get("metrics") or {}
+            serve["queue_depth_max"] = max(
+                serve["queue_depth_max"], int(m.get("queue_depth", 0) or 0))
+        elif kind == KIND_SERVE_LATENCY:
+            # Periodic rollups are cumulative over the run; the LAST one
+            # (emitted at drain) wins.
+            m = ev.get("metrics") or {}
+            tp = ev.get("throughput") or {}
+            serve["latency"] = {
+                "p50_ms": m.get("p50_ms"), "p90_ms": m.get("p90_ms"),
+                "p99_ms": m.get("p99_ms"), "count": m.get("count"),
+                "requests_per_sec": tp.get("requests_per_sec"),
+                "rows_per_sec": tp.get("rows_per_sec"),
+            }
+        elif kind == KIND_SERVE_RECOMPILE:
+            m = ev.get("metrics") or {}
+            serve["recompiles"].append({
+                "bucket": extra.get("bucket"),
+                "compile_ms": m.get("compile_ms"),
+            })
         elif kind == KIND_TRAIN_STEP:
             m = ev.get("metrics") or {}
             if pipeline is not None and "pipe_bubble_frac" in m:
@@ -484,6 +537,8 @@ def summarize_events(path: str) -> dict:
         "ckpt_saves": saves,
         "startups": startups,
         "pipeline": pipeline,
+        "serve": (serve if (serve["requests"] or serve["batches"]
+                            or serve["recompiles"]) else None),
         "recovery": {
             "quarantined": quarantined,
             "restore_fallbacks": fallbacks,
@@ -584,6 +639,38 @@ def format_run_summary(summary: dict) -> str:
             bits.append(
                 f"steady {float(pipe['steady_examples_per_sec']):.1f} ex/s")
         lines.append("  pipeline: " + ", ".join(bits))
+    serve = summary.get("serve")
+    if serve:  # KIND_SERVE_REQUEST / KIND_SERVE_BATCH rollup
+        fill = (serve["batch_rows"] / serve["padded_rows"]
+                if serve.get("padded_rows") else None)
+        lines.append(
+            f"  serving: {serve['requests']} requests ({serve['rows']} rows)"
+            f" in {serve['batches']} batches"
+            + (f", fill {fill:.2f}" if fill is not None else "")
+            + f", queue depth max {serve['queue_depth_max']}"
+        )
+        lat = serve.get("latency")
+        if lat and lat.get("p50_ms") is not None:  # KIND_SERVE_LATENCY
+            rps = lat.get("requests_per_sec")
+            lines.append(
+                f"    latency: p50 {float(lat['p50_ms']):.1f} ms, "
+                f"p90 {float(lat.get('p90_ms') or 0):.1f} ms, "
+                f"p99 {float(lat['p99_ms']):.1f} ms over {lat.get('count')} "
+                f"requests"
+                + (f", {float(rps):.1f} req/s" if rps is not None else "")
+            )
+        if serve["queue_wait_ms_total"] or serve["compute_ms_total"]:
+            lines.append(
+                f"    queue wait {serve['queue_wait_ms_total']:.0f} ms vs "
+                f"compute {serve['compute_ms_total']:.0f} ms (totals)"
+            )
+        if serve["recompiles"]:  # KIND_SERVE_RECOMPILE / KIND_SERVE_QUEUE
+            buckets = ", ".join(
+                str(r.get("bucket")) for r in serve["recompiles"])
+            lines.append(
+                f"    bucket recompiles: {len(serve['recompiles'])}"
+                f" ({buckets})"
+            )
     for s in summary.get("startups") or []:
         t = s.get("time_to_first_step_s")
         t_str = f"{t:.1f}s" if isinstance(t, (int, float)) else "?"

@@ -1,0 +1,35 @@
+"""Serving path: frozen artifacts + standing batched-inference engine.
+
+The "millions of users" half of the north star (ROADMAP item 3). Three
+layers, bottom-up:
+
+  * serve/export.py — freeze a trained checkpoint (via the ckpt/manifest
+    restore path, resharded onto the dp-only serving mesh under
+    ``serve.allow_reshard``) into an integrity-manifested artifact with
+    the model config and a param-tree digest recorded;
+  * serve/engine.py — the standing engine: request queue, dynamic
+    batching (max-batch-size / max-wait-ms admission), padding buckets
+    bounding XLA recompiles, a jitted forward reusing
+    parallel/sharding.py specs, and the KIND_SERVE_* SLO telemetry;
+  * serve/server.py — the stdlib-only HTTP front end (predict + healthz)
+    with graceful SIGTERM drain mirroring the supervisor's preemption
+    contract.
+
+See docs/SERVING.md for the architecture and knob reference.
+"""
+
+from distributed_tensorflow_framework_tpu.serve.engine import (  # noqa: F401
+    EngineClosedError,
+    InferenceEngine,
+    OversizeRequestError,
+    QueueFullError,
+    SequenceTooLongError,
+    ServeError,
+    serving_mesh,
+)
+from distributed_tensorflow_framework_tpu.serve.export import (  # noqa: F401
+    Artifact,
+    export_checkpoint,
+    load_artifact,
+    save_artifact,
+)

@@ -1,0 +1,481 @@
+"""Standing batched-inference engine with SLO telemetry.
+
+The engine is the trainer's step loop turned inside out: instead of an
+infeed pipeline pushing fixed-shape batches at a jitted step, requests of
+arbitrary row count and (for MLM) arbitrary sequence length arrive at a
+queue, and a batcher thread decides when a batch is worth launching:
+
+  * admission — launch when ``serve.max_batch_size`` rows are waiting OR
+    the oldest request has waited ``serve.max_wait_ms``, whichever comes
+    first. Latency-throughput knob, same trade as infeed prefetch depth.
+  * padding buckets — variable shapes would make XLA recompile per
+    request. Sequences pad up to the next entry of ``serve.seq_buckets``
+    and row counts to a power-of-two ladder over multiples of the dp
+    size, so the compile budget is exactly |seq_buckets| x |row ladder|;
+    each bucket's first execution is telemetered (KIND_SERVE_RECOMPILE)
+    because past the warmup an unexpected recompile IS the bug.
+  * placement — params are placed once via parallel/sharding.py specs
+    (replicated on the dp-only serving mesh) and batches via
+    core/mesh.batch_spec, the same rules the trainer compiles under.
+
+Everything observable rides core/telemetry.py: per-request queue-wait and
+latency, per-batch fill and compute time, periodic queue depth, and
+p50/p90/p99 rollups from a bounded reservoir (core/metrics.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from distributed_tensorflow_framework_tpu.core import telemetry
+from distributed_tensorflow_framework_tpu.core.config import ServeConfig
+from distributed_tensorflow_framework_tpu.core.mesh import (
+    MeshConfig,
+    MeshSizeError,
+    batch_spec,
+    create_mesh,
+)
+from distributed_tensorflow_framework_tpu.core.metrics import (
+    PercentileReservoir,
+)
+from distributed_tensorflow_framework_tpu.models import get_model
+from distributed_tensorflow_framework_tpu.parallel import sharding as shd
+from distributed_tensorflow_framework_tpu.serve.export import Artifact
+from distributed_tensorflow_framework_tpu.train.step import model_inputs
+
+log = logging.getLogger(__name__)
+
+
+class ServeError(RuntimeError):
+    """Base for serving-path request errors (server.py maps subclasses to
+    HTTP statuses; everything else is a 500)."""
+
+
+class OversizeRequestError(ServeError):
+    """Request has more rows than ``serve.max_batch_size`` — it could
+    never be admitted whole. Split it client-side or raise the knob."""
+
+
+class SequenceTooLongError(ServeError):
+    """Sequence exceeds the largest padding bucket (or the artifact's
+    fixed length when no buckets are configured)."""
+
+
+class QueueFullError(ServeError):
+    """Backpressure: ``serve.queue_capacity`` requests already queued.
+    The caller should retry with backoff (server.py returns 503)."""
+
+
+class EngineClosedError(ServeError):
+    """Submitted after drain began, or the request was still queued when
+    the drain timeout expired."""
+
+
+def serving_mesh(data: int = 1):
+    """The dp-only serving mesh over the first ``data`` devices (-1 = all
+    visible). Serving never shards params — fsdp/pipe/model stay 1 and
+    parallel/sharding falls back to replication — so "mesh" here is just
+    data-parallel replica count for batch throughput."""
+    devices = jax.devices()
+    n = len(devices) if data in (0, -1) else int(data)
+    if n > len(devices):
+        raise MeshSizeError({"data": n}, n, len(devices))
+    return create_mesh(MeshConfig(data=n), devices=devices[:n])
+
+
+def pick_bucket(value: int, buckets: list[int]) -> int:
+    """Smallest bucket >= value (buckets ascending). ValueError past the
+    last bucket — the caller owns the typed error."""
+    for b in buckets:
+        if value <= b:
+            return int(b)
+    raise ValueError(f"{value} exceeds largest bucket {buckets[-1]}")
+
+
+def batch_buckets(max_batch_size: int, dp: int) -> list[int]:
+    """Row-count padding ladder: dp, 2*dp, 4*dp, ... capped at
+    max_batch_size rounded up to a dp multiple. Every entry is divisible
+    by ``dp`` so the padded batch always shards over the data axis."""
+    cap = -(-int(max_batch_size) // dp) * dp
+    out, b = [], dp
+    while b < cap:
+        out.append(b)
+        b *= 2
+    out.append(cap)
+    return out
+
+
+@dataclass
+class _Request:
+    inputs: dict[str, np.ndarray]
+    rows: int
+    seq_len: int  # 0 for classification
+    future: Future = field(default_factory=Future)
+    t_enqueue: float = field(default_factory=time.monotonic)
+
+
+class InferenceEngine:
+    """Standing engine over a loaded :class:`~serve.export.Artifact`.
+
+    Thread layout: callers (server worker threads) block in
+    :meth:`predict`; one batcher thread forms and launches batches; one
+    reporter thread emits periodic queue-depth/latency telemetry. The
+    jitted forward itself runs on the batcher thread, so device order is
+    trivially serial — no interleaved-launch hazards.
+    """
+
+    def __init__(self, artifact: Artifact, serve_cfg: ServeConfig, *,
+                 mesh=None, telemetry_writer=None):
+        self.artifact = artifact
+        self.cfg = serve_cfg
+        self.mesh = mesh if mesh is not None else serving_mesh(serve_cfg.data)
+        self._tw = telemetry_writer
+        self.task = artifact.task
+        self.dp = int(np.prod(
+            [self.mesh.shape[a] for a in ("data", "fsdp", "expert")]))
+        self.row_buckets = batch_buckets(serve_cfg.max_batch_size, self.dp)
+        self.max_rows = self.row_buckets[-1]
+        if self.task == "mlm":
+            fixed = int(artifact.input_spec["input_ids"]["shape"][0])
+            self.seq_buckets = ([int(b) for b in serve_cfg.seq_buckets]
+                                or [fixed])
+        else:
+            self.seq_buckets = []
+        self.model = get_model(
+            artifact.model_config, bn_axis_name=None, mesh=self.mesh)
+        # One placement at startup: replicated under the dp-only specs.
+        specs = shd.infer_param_specs(artifact.params, self.mesh)
+        self._variables = {
+            "params": shd.shard_pytree(artifact.params, specs, self.mesh)}
+        if jax.tree.leaves(artifact.batch_stats):
+            stat_specs = shd.infer_param_specs(
+                artifact.batch_stats, self.mesh)
+            self._variables["batch_stats"] = shd.shard_pytree(
+                artifact.batch_stats, stat_specs, self.mesh)
+        self._batch_sharding = NamedSharding(self.mesh, batch_spec(self.mesh))
+        self._fn = jax.jit(self._forward)
+        self._compiled: set[tuple] = set()
+
+        self._cond = threading.Condition()
+        self._queue: deque[_Request] = deque()
+        self._stop_reporting = threading.Event()
+        self._state = "running"  # running | draining | closed
+        self._t_start = time.monotonic()
+        self._latency = PercentileReservoir()
+        self._requests = 0
+        self._rows = 0
+        self._batches = 0
+        self._batch_rows = 0
+        self._padded_rows = 0
+        self._queue_wait_ms = 0.0
+        self._compute_ms = 0.0
+        self._batcher = threading.Thread(
+            target=self._batch_loop, name="serve-batcher", daemon=True)
+        self._batcher.start()
+        self._reporter = threading.Thread(
+            target=self._report_loop, name="serve-reporter", daemon=True)
+        self._reporter.start()
+        log.info(
+            "engine up: task=%s step=%d dp=%d row_buckets=%s seq_buckets=%s",
+            self.task, artifact.step, self.dp, self.row_buckets,
+            self.seq_buckets)
+
+    # ---------------------------------------------------------- forward
+
+    def _forward(self, variables, inputs):
+        with self.mesh:
+            logits = self.model.apply(variables, *inputs, train=False)
+        if isinstance(logits, dict):
+            logits = logits["logits"]
+        return logits
+
+    # ------------------------------------------------------- validation
+
+    def _validate(self, inputs: dict[str, Any]) -> _Request:
+        spec = self.artifact.input_spec
+        unknown = set(inputs) - set(spec) - {"segment_ids"}
+        if unknown:
+            raise ServeError(
+                f"unknown input key(s) {sorted(unknown)} — this artifact "
+                f"takes {sorted(spec)}")
+        arrays: dict[str, np.ndarray] = {}
+        for key, info in spec.items():
+            row_ndim = len(info["shape"])
+            if key not in inputs:
+                if key == "attention_mask":
+                    continue  # synthesized below
+                raise ServeError(f"missing required input {key!r}")
+            arr = np.asarray(inputs[key], dtype=np.dtype(info["dtype"]))
+            if arr.ndim == row_ndim:  # single row without the batch dim
+                arr = arr[None]
+            if arr.ndim != row_ndim + 1:
+                raise ServeError(
+                    f"input {key!r} has shape {arr.shape}, expected "
+                    f"(rows, {', '.join(map(str, info['shape']))})")
+            arrays[key] = arr
+        if self.task == "mlm":
+            ids = arrays["input_ids"]
+            rows, seq = ids.shape
+            if "attention_mask" not in arrays:
+                arrays["attention_mask"] = np.ones_like(ids)
+            if arrays["attention_mask"].shape != ids.shape:
+                raise ServeError(
+                    f"attention_mask shape {arrays['attention_mask'].shape} "
+                    f"!= input_ids shape {ids.shape}")
+            if seq > self.seq_buckets[-1]:
+                raise SequenceTooLongError(
+                    f"sequence length {seq} exceeds the largest padding "
+                    f"bucket {self.seq_buckets[-1]} (serve.seq_buckets="
+                    f"{self.seq_buckets}) — truncate or add a bucket")
+        else:
+            rows = arrays["image"].shape[0]
+            want = tuple(spec["image"]["shape"])
+            if arrays["image"].shape[1:] != want:
+                raise ServeError(
+                    f"image rows have shape {arrays['image'].shape[1:]}, "
+                    f"artifact expects {want}")
+            seq = 0
+        if rows < 1:
+            raise ServeError("request has zero rows")
+        if rows > self.max_rows:
+            raise OversizeRequestError(
+                f"request has {rows} rows but serve.max_batch_size="
+                f"{self.cfg.max_batch_size} (padded cap {self.max_rows}) — "
+                f"split the request or raise the knob")
+        return _Request(inputs=arrays, rows=rows, seq_len=seq)
+
+    # ------------------------------------------------------- public API
+
+    def submit(self, inputs: dict[str, Any]) -> Future:
+        """Validate + enqueue; returns a Future resolving to the per-row
+        logits (np.ndarray, request rows only — padding stripped)."""
+        req = self._validate(inputs)
+        with self._cond:
+            if self._state != "running":
+                raise EngineClosedError(
+                    f"engine is {self._state} — not accepting requests")
+            if len(self._queue) >= self.cfg.queue_capacity:
+                raise QueueFullError(
+                    f"queue at capacity ({self.cfg.queue_capacity}) — "
+                    f"retry with backoff")
+            self._queue.append(req)
+            self._cond.notify_all()
+        return req.future
+
+    def predict(self, inputs: dict[str, Any],
+                timeout: float | None = None) -> np.ndarray:
+        return self.submit(inputs).result(timeout)
+
+    def stats(self) -> dict[str, Any]:
+        """Point-in-time counters for healthz (no locking beyond the
+        queue peek — monotonic counters can be a batch stale)."""
+        with self._cond:
+            depth = len(self._queue)
+        return {
+            "state": self._state,
+            "uptime_s": time.monotonic() - self._t_start,
+            "requests": self._requests,
+            "rows": self._rows,
+            "batches": self._batches,
+            "batch_rows": self._batch_rows,
+            "padded_rows": self._padded_rows,
+            "queue_depth": depth,
+            "queue_wait_ms_total": self._queue_wait_ms,
+            "compute_ms_total": self._compute_ms,
+            "latency": self._latency.summary(),
+            "row_buckets": self.row_buckets,
+            "seq_buckets": self.seq_buckets,
+            "compiled_buckets": sorted(str(k) for k in self._compiled),
+        }
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Stop admission, serve everything already queued, stop threads.
+
+        Mirrors the supervisor's preemption contract: in-flight work is
+        completed, not dropped. Returns True when the queue fully drained
+        within ``timeout``; leftover requests (timeout expiry) fail with
+        EngineClosedError rather than hanging their clients.
+        """
+        with self._cond:
+            if self._state == "closed":
+                return True
+            self._state = "draining"
+            self._cond.notify_all()
+        self._batcher.join(timeout)
+        drained = not self._batcher.is_alive()
+        leftovers: list[_Request] = []
+        with self._cond:
+            self._state = "closed"
+            leftovers, self._queue = list(self._queue), deque()
+            self._cond.notify_all()
+        for req in leftovers:
+            req.future.set_exception(EngineClosedError(
+                "engine drain timed out before this request was served"))
+        self._stop_reporting.set()
+        self._reporter.join(max(1.0, self.cfg.report_interval_s))
+        self._emit_latency()  # final cumulative rollup — last one wins
+        log.info("engine drained: %d requests in %d batches, %d undrained",
+                 self._requests, self._batches, len(leftovers))
+        return drained and not leftovers
+
+    # ---------------------------------------------------------- batcher
+
+    def _take_batch(self) -> list[_Request] | None:
+        """Block until a batch is worth launching (admission rule) or the
+        engine is told to finish; None means exit the loop."""
+        with self._cond:
+            while not self._queue:
+                if self._state != "running":
+                    return None
+                self._cond.wait(0.1)
+            deadline = self._queue[0].t_enqueue + self.cfg.max_wait_ms / 1e3
+            while (self._state == "running"
+                   and sum(r.rows for r in self._queue) < self.max_rows):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            batch, rows = [], 0
+            while self._queue and rows + self._queue[0].rows <= self.max_rows:
+                req = self._queue.popleft()
+                batch.append(req)
+                rows += req.rows
+            return batch
+
+    def _assemble(self, batch: list[_Request]) -> tuple[dict, tuple, int]:
+        """Pad requests into one fixed (row_bucket, seq_bucket) batch.
+
+        Filler rows replicate row 0 rather than zeros — an all-zero row
+        is a degenerate input some models normalize over, and replicated
+        real rows keep the padded batch numerically unremarkable. Their
+        outputs are sliced off before any future resolves.
+        """
+        rows = sum(r.rows for r in batch)
+        row_bucket = pick_bucket(rows, self.row_buckets)
+        if self.task == "mlm":
+            seq_bucket = max(
+                pick_bucket(r.seq_len, self.seq_buckets) for r in batch)
+            keys = ["input_ids", "attention_mask"]
+            if any("segment_ids" in r.inputs for r in batch):
+                keys.append("segment_ids")
+            cols = {k: [] for k in keys}
+            for r in batch:
+                for k in keys:
+                    arr = r.inputs.get(k)
+                    if arr is None:  # segment_ids absent for this request
+                        arr = np.zeros((r.rows, r.seq_len), np.int32)
+                    pad = seq_bucket - arr.shape[1]
+                    if pad:
+                        arr = np.pad(arr, ((0, 0), (0, pad)))
+                    cols[k].append(arr)
+            host = {k: np.concatenate(v) for k, v in cols.items()}
+        else:
+            seq_bucket = 0
+            host = {"image": np.concatenate([r.inputs["image"]
+                                             for r in batch])}
+        fill = row_bucket - rows
+        if fill:
+            host = {k: np.concatenate([v, np.repeat(v[:1], fill, axis=0)])
+                    for k, v in host.items()}
+        placed = {k: jax.device_put(v, self._batch_sharding)
+                  for k, v in host.items()}
+        return placed, (seq_bucket, row_bucket), rows
+
+    def _run_batch(self, batch: list[_Request]) -> None:
+        with self._cond:
+            depth = len(self._queue)
+        t_form = time.monotonic()
+        placed, key, rows = self._assemble(batch)
+        inputs = model_inputs(self.task, placed)
+        first_use = key not in self._compiled
+        t0 = time.monotonic()
+        logits = self._fn(self._variables, inputs)
+        logits = np.asarray(jax.block_until_ready(logits))
+        compute_ms = (time.monotonic() - t0) * 1e3
+        if first_use:
+            self._compiled.add(key)
+            label = (f"rows{key[1]}" if self.task != "mlm"
+                     else f"seq{key[0]}xrows{key[1]}")
+            if self._tw:
+                self._tw.emit(
+                    telemetry.KIND_SERVE_RECOMPILE,
+                    metrics={"compile_ms": compute_ms}, bucket=label)
+            log.info("compiled bucket %s in %.0f ms (%d/%d buckets warm)",
+                     label, compute_ms, len(self._compiled),
+                     len(self.row_buckets) * max(1, len(self.seq_buckets)))
+        row_bucket = key[1]
+        self._batches += 1
+        self._batch_rows += rows
+        self._padded_rows += row_bucket
+        self._compute_ms += compute_ms
+        if self._tw:
+            self._tw.emit(
+                telemetry.KIND_SERVE_BATCH,
+                metrics={"rows": rows, "padded_rows": row_bucket,
+                         "compute_ms": compute_ms, "queue_depth": depth})
+        offset = 0
+        for req in batch:
+            out = logits[offset:offset + req.rows]
+            if self.task == "mlm":  # strip the seq padding too
+                out = out[:, :req.seq_len]
+            offset += req.rows
+            wait_ms = (t_form - req.t_enqueue) * 1e3
+            latency_ms = (time.monotonic() - req.t_enqueue) * 1e3
+            self._requests += 1
+            self._rows += req.rows
+            self._queue_wait_ms += wait_ms
+            self._latency.add(latency_ms)
+            if self._tw:
+                self._tw.emit(
+                    telemetry.KIND_SERVE_REQUEST,
+                    metrics={"rows": req.rows, "queue_wait_ms": wait_ms,
+                             "latency_ms": latency_ms})
+            req.future.set_result(out)
+
+    def _batch_loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            if not batch:
+                continue
+            try:
+                self._run_batch(batch)
+            except Exception as e:  # noqa: BLE001 — engine must outlive a bad batch
+                log.exception("batch of %d request(s) failed", len(batch))
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+
+    # --------------------------------------------------------- reporter
+
+    def _emit_latency(self) -> None:
+        if not self._tw or not self._latency.count:
+            return
+        s = self._latency.summary()
+        elapsed = max(time.monotonic() - self._t_start, 1e-9)
+        self._tw.emit(
+            telemetry.KIND_SERVE_LATENCY,
+            metrics={"p50_ms": s["p50"], "p90_ms": s["p90"],
+                     "p99_ms": s["p99"], "count": s["count"]},
+            throughput={"requests_per_sec": self._requests / elapsed,
+                        "rows_per_sec": self._rows / elapsed})
+
+    def _report_loop(self) -> None:
+        while not self._stop_reporting.wait(self.cfg.report_interval_s):
+            with self._cond:
+                depth = len(self._queue)
+            if self._tw:
+                self._tw.emit(telemetry.KIND_SERVE_QUEUE,
+                              metrics={"queue_depth": depth})
+            self._emit_latency()

@@ -1,0 +1,331 @@
+"""Freeze a trained checkpoint into a serving artifact.
+
+An artifact is a directory holding exactly what inference needs and
+nothing the trainer needs back:
+
+    <artifact>/
+      artifact.json   — schema, model config, task, source step, input
+                        spec, sha256 digest of the param tree
+      params/         — orbax StandardSave of {"params", "batch_stats"}
+      manifest.json   — ckpt/manifest.py integrity commit record over the
+                        whole directory (an artifact without one is
+                        uncommitted, same contract as training steps)
+
+Export goes THROUGH the existing restore path (ckpt/checkpoint.py): the
+checkpoint's integrity manifest is verified, quarantine/fallback apply,
+and the mesh-topology gate (ckpt/reshard.py) runs — a multi-host training
+mesh restores onto the 1-device/dp-only serving mesh only when
+``serve.allow_reshard`` is set, otherwise the typed MeshTopologyError
+names that knob. EMA params are frozen when present (``serve.use_ema``),
+matching what the trainer's eval would have scored.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from distributed_tensorflow_framework_tpu.ckpt import manifest as mf
+from distributed_tensorflow_framework_tpu.ckpt import reshard
+from distributed_tensorflow_framework_tpu.ckpt.checkpoint import (
+    CheckpointManager,
+)
+from distributed_tensorflow_framework_tpu.core.config import (
+    ExperimentConfig,
+    ModelConfig,
+    _build,
+)
+
+log = logging.getLogger(__name__)
+
+ARTIFACT_SCHEMA = "dtf-serve-artifact/1"
+ARTIFACT_JSON = "artifact.json"
+_PARAMS_DIR = "params"
+
+# Hint appended to MeshTopologyError on the export path: the operator is
+# holding the serve config block, not the training checkpoint block.
+RESHARD_HINT = (
+    "Serving export: set serve.allow_reshard=true (cli/export.py --set "
+    "serve.allow_reshard=true) to restore this training-mesh checkpoint "
+    "onto the dp-only serving mesh."
+)
+
+
+def param_tree_digest(tree: Any) -> str:
+    """sha256 over every leaf's (tree path, shape, dtype) — the artifact's
+    recorded param spec digest. Checked again at load so a tree that
+    deserialized into a different structure/shape fails by name, not as a
+    shape error deep inside the first forward pass."""
+    h = hashlib.sha256()
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in leaves:
+        shape = tuple(np.shape(leaf))
+        dtype = np.asarray(leaf).dtype if np.ndim(leaf) == 0 else leaf.dtype
+        h.update(
+            f"{jax.tree_util.keystr(path)}={shape}:{dtype}\n".encode())
+    return h.hexdigest()
+
+
+def input_spec_for(config: ExperimentConfig, task: str) -> dict[str, Any]:
+    """Per-ROW request spec recorded in the artifact: what a client must
+    send per example. The server's healthz exposes it so the load
+    generator can synthesize valid traffic without sharing config."""
+    if task == "mlm":
+        seq = int(config.data.seq_len or config.model.max_seq_len)
+        return {
+            "input_ids": {"shape": [seq], "dtype": "int32"},
+            "attention_mask": {"shape": [seq], "dtype": "int32"},
+        }
+    return {
+        "image": {
+            "shape": [int(config.data.image_size),
+                      int(config.data.image_size),
+                      int(config.data.channels)],
+            "dtype": "float32",
+        },
+    }
+
+
+def _sample_batch(config: ExperimentConfig, task: str, rows: int) -> dict:
+    """Shape-only host batch for building the restore template (the init
+    only traces shapes; no dataset construction needed for export)."""
+    if task == "mlm":
+        seq = int(config.data.seq_len or config.model.max_seq_len)
+        return {
+            "input_ids": np.zeros((rows, seq), np.int32),
+            "targets": np.full((rows, seq), -1, np.int32),
+            "attention_mask": np.ones((rows, seq), np.int32),
+        }
+    size, ch = int(config.data.image_size), int(config.data.channels)
+    return {
+        "image": np.zeros((rows, size, size, ch), np.float32),
+        "label": np.zeros((rows,), np.int32),
+    }
+
+
+@dataclasses.dataclass
+class Artifact:
+    """A loaded serving artifact: host param trees + the recorded meta."""
+
+    model_config: ModelConfig
+    task: str
+    params: Any
+    batch_stats: Any
+    step: int
+    param_spec_digest: str
+    input_spec: dict[str, Any]
+    meta: dict[str, Any]
+
+    @property
+    def vocab_size(self) -> int:
+        return int(self.meta.get("vocab_size") or
+                   self.model_config.vocab_size)
+
+
+def save_artifact(
+    output_dir: str,
+    *,
+    model_config: ModelConfig,
+    task: str,
+    params: Any,
+    batch_stats: Any,
+    step: int,
+    input_spec: dict[str, Any],
+    source: dict[str, Any] | None = None,
+    vocab_size: int | None = None,
+) -> str:
+    """Low-level artifact writer (export_checkpoint's back half; tests use
+    it directly to build artifacts from initialized params). Refuses a
+    non-empty target — an artifact is immutable once committed."""
+    out = os.path.abspath(output_dir)
+    if os.path.isdir(out) and os.listdir(out):
+        raise ValueError(
+            f"artifact directory {out} already exists and is not empty — "
+            f"artifacts are immutable; export to a fresh directory"
+        )
+    os.makedirs(out, exist_ok=True)
+    host_params = jax.device_get(params)
+    host_stats = jax.device_get(batch_stats)
+    ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+    try:
+        ckptr.save(
+            os.path.join(out, _PARAMS_DIR),
+            args=ocp.args.StandardSave(
+                {"params": host_params, "batch_stats": host_stats}),
+        )
+    finally:
+        ckptr.close()
+    meta = {
+        "schema": ARTIFACT_SCHEMA,
+        "task": task,
+        "step": int(step),
+        "model": dataclasses.asdict(model_config),
+        "param_spec_digest": param_tree_digest(host_params),
+        "input_spec": input_spec,
+        "vocab_size": int(vocab_size or model_config.vocab_size),
+        "exported_t": time.time(),
+        "source": source or {},
+    }
+    path = os.path.join(out, ARTIFACT_JSON)
+    with open(path, "w") as fh:
+        json.dump(meta, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    # The integrity commit record: hash every payload file (ckpt/manifest
+    # discipline — an artifact without a manifest is uncommitted).
+    mf.write_manifest(out, step)
+    log.info("exported serving artifact to %s (step %d, %s)",
+             out, step, task)
+    return out
+
+
+def export_checkpoint(
+    config: ExperimentConfig,
+    output_dir: str,
+    *,
+    step: int | None = None,
+) -> str:
+    """Export ``config.checkpoint.directory``'s checkpoint into a frozen
+    serving artifact at ``output_dir``.
+
+    Restores onto the serving mesh (``serve.data`` devices, dp-only) via
+    the full integrity + topology-gated restore path; a training-mesh
+    checkpoint requires ``serve.allow_reshard`` or raises the typed
+    MeshTopologyError with the serve-side knob named.
+    """
+    from distributed_tensorflow_framework_tpu.serve.engine import (
+        serving_mesh,
+    )
+    from distributed_tensorflow_framework_tpu.train.step import (
+        StepBuilder,
+        task_for_model,
+    )
+
+    if config.model.pipeline_stages > 1:
+        raise ValueError(
+            "export of pipelined models (model.pipeline_stages>1) is not "
+            "supported yet — multi-stage serving is the 1F1B slot-table "
+            "follow-up (ROADMAP item 3); export from a stage-merged "
+            "checkpoint instead"
+        )
+    if not config.checkpoint.directory:
+        raise ValueError("checkpoint.directory must name the trained run "
+                         "to export")
+    # The template builder runs on the SERVING mesh with serving-only
+    # semantics: jit mode, no quantized-collective residual (a stored
+    # residual is dropped by the restore reconciliation — serving never
+    # steps the optimizer).
+    cfg = copy.deepcopy(config)
+    cfg.train.spmd_mode = "jit"
+    cfg.train.grad_allreduce_dtype = ""
+    cfg.parallel.collective_dtype = ""
+    cfg.optimizer.shard_opt_state = False
+    mesh = serving_mesh(cfg.serve.data)
+    task = task_for_model(cfg.model.name)
+    builder = StepBuilder(cfg, mesh)
+    rows = int(mesh.shape["data"])
+    template = builder.init_state(0, _sample_batch(cfg, task, rows))
+    ckpt_cfg = dataclasses.replace(
+        cfg.checkpoint,
+        async_save=False,
+        allow_reshard=cfg.serve.allow_reshard,
+    )
+    manager = CheckpointManager(ckpt_cfg, mesh=mesh, process_count=1)
+    try:
+        try:
+            state = manager.restore(template, step=step)
+        except reshard.MeshTopologyError as e:
+            raise reshard.MeshTopologyError(
+                e.saved_axes, e.requested_axes, directory=e.directory,
+                step=e.step, hint=RESHARD_HINT,
+            ) from None
+    finally:
+        manager.close()
+    if state is None:
+        raise ValueError(
+            f"no committed checkpoint to export in "
+            f"{config.checkpoint.directory}"
+        )
+    use_ema = bool(cfg.serve.use_ema and jax.tree.leaves(state.ema_params))
+    params = state.ema_params if use_ema else state.params
+    restored_step = int(jax.device_get(state.step))
+    return save_artifact(
+        output_dir,
+        model_config=cfg.model,
+        task=task,
+        params=params,
+        batch_stats=state.batch_stats,
+        step=restored_step,
+        input_spec=input_spec_for(cfg, task),
+        vocab_size=(cfg.data.vocab_size if task == "mlm" else None),
+        source={
+            "checkpoint_dir": os.path.abspath(config.checkpoint.directory),
+            "experiment": config.name,
+            "used_ema": use_ema,
+            "serve_mesh": {a: int(s) for a, s in mesh.shape.items()},
+            "sharding_spec_digest": reshard.spec_digest(state),
+        },
+    )
+
+
+def load_artifact(artifact_dir: str, *, verify: bool = True) -> Artifact:
+    """Load + integrity-check a committed artifact into host trees."""
+    out = os.path.abspath(artifact_dir)
+    meta_path = os.path.join(out, ARTIFACT_JSON)
+    if not os.path.isfile(meta_path):
+        raise ValueError(
+            f"{out} is not a serving artifact (no {ARTIFACT_JSON}) — "
+            f"export one with cli/export.py"
+        )
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    if meta.get("schema") != ARTIFACT_SCHEMA:
+        raise ValueError(
+            f"artifact schema {meta.get('schema')!r} != {ARTIFACT_SCHEMA!r}"
+            f" — re-export with this version"
+        )
+    manifest = mf.read_manifest(out)
+    if manifest is None:
+        raise ValueError(
+            f"artifact {out} has no integrity manifest (export did not "
+            f"complete) — re-export it"
+        )
+    if verify:
+        errors = mf.verify_step_dir(out, manifest)
+        if errors:
+            raise ValueError(
+                f"artifact {out} failed integrity verification: "
+                + "; ".join(errors[:5])
+            )
+    ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+    try:
+        tree = ckptr.restore(os.path.join(out, _PARAMS_DIR))
+    finally:
+        ckptr.close()
+    params = tree["params"]
+    digest = param_tree_digest(params)
+    if digest != meta["param_spec_digest"]:
+        raise ValueError(
+            f"artifact {out} param tree digest mismatch: recorded "
+            f"{meta['param_spec_digest'][:12]}…, loaded {digest[:12]}… — "
+            f"the stored tree does not match what was exported"
+        )
+    return Artifact(
+        model_config=_build(ModelConfig, meta["model"]),
+        task=meta["task"],
+        params=params,
+        batch_stats=tree.get("batch_stats", {}),
+        step=int(meta["step"]),
+        param_spec_digest=digest,
+        input_spec=meta["input_spec"],
+        meta=meta,
+    )

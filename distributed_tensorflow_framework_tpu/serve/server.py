@@ -1,0 +1,191 @@
+"""Stdlib-only HTTP front end for the inference engine.
+
+One ThreadingHTTPServer (a worker thread per connection, each blocking in
+``engine.predict`` while the batcher coalesces across them — that
+blocking IS the dynamic batching window) and two routes:
+
+  * ``POST /predict`` — ``{"inputs": {...}}`` in, ``{"outputs": [...]}``
+    out. Typed engine errors map to useful statuses: validation and
+    oversize/too-long → 400, backpressure and draining → 503 (retryable),
+    anything else → 500.
+  * ``GET /healthz`` — liveness + the artifact's input spec (the load
+    generator reads it to synthesize traffic) + engine counters.
+
+SIGTERM mirrors the trainer's graceful-preemption contract
+(core/supervision.py): stop admission, finish every queued request
+within ``serve.drain_timeout_s``, then exit 0 — the supervisor treats a
+serving drain as success, not a crash to back off from.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import numpy as np
+
+from distributed_tensorflow_framework_tpu.core import telemetry
+from distributed_tensorflow_framework_tpu.core.config import ServeConfig
+from distributed_tensorflow_framework_tpu.serve.engine import (
+    EngineClosedError,
+    InferenceEngine,
+    OversizeRequestError,
+    QueueFullError,
+    SequenceTooLongError,
+    ServeError,
+)
+
+log = logging.getLogger(__name__)
+
+_MAX_BODY = 64 * 1024 * 1024  # refuse absurd request bodies outright
+
+
+class ServingServer:
+    """Engine + ThreadingHTTPServer, owning the drain choreography."""
+
+    def __init__(self, engine: InferenceEngine, serve_cfg: ServeConfig, *,
+                 telemetry_writer=None):
+        self.engine = engine
+        self.cfg = serve_cfg
+        self._tw = telemetry_writer
+        self._draining = threading.Event()
+        self._done = threading.Event()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route through logging
+                log.debug("%s %s", self.address_string(), fmt % args)
+
+            def _reply(self, status: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path != "/healthz":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                outer.handle_healthz(self)
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                outer.handle_predict(self)
+
+        class Server(ThreadingHTTPServer):
+            # The socketserver default accept backlog of 5 drops
+            # connections under concurrent load (urllib clients open a
+            # fresh connection per request) — size it to the engine's
+            # admission bound instead.
+            request_queue_size = max(128, serve_cfg.queue_capacity)
+
+        # Port 0 asks the OS for an ephemeral port; cli/serve.py writes
+        # the RESOLVED endpoint to endpoint.json so tooling can find it.
+        self.httpd = Server((serve_cfg.host, serve_cfg.port), Handler)
+        self.httpd.daemon_threads = True
+        self.host, self.port = self.httpd.server_address[:2]
+
+    # ------------------------------------------------------------ routes
+
+    def handle_predict(self, handler) -> None:
+        if self._draining.is_set():
+            handler._reply(503, {"error": "draining", "retryable": True})
+            return
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+            if length <= 0 or length > _MAX_BODY:
+                handler._reply(400, {"error": f"bad Content-Length {length}"})
+                return
+            payload = json.loads(handler.rfile.read(length))
+            inputs = payload.get("inputs")
+            if not isinstance(inputs, dict):
+                handler._reply(
+                    400, {"error": "body must be {\"inputs\": {...}}"})
+                return
+            outputs = self.engine.predict(
+                inputs, timeout=self.cfg.drain_timeout_s)
+            handler._reply(200, {
+                "outputs": np.asarray(outputs).tolist(),
+                "rows": int(np.asarray(outputs).shape[0]),
+                "step": self.engine.artifact.step,
+            })
+        except (OversizeRequestError, SequenceTooLongError) as e:
+            handler._reply(400, {"error": str(e)})
+        except (QueueFullError, EngineClosedError) as e:
+            handler._reply(503, {"error": str(e), "retryable": True})
+        except ServeError as e:
+            handler._reply(400, {"error": str(e)})
+        except json.JSONDecodeError as e:
+            handler._reply(400, {"error": f"invalid JSON: {e}"})
+        except Exception as e:  # noqa: BLE001 — server must outlive a bad request
+            log.exception("predict failed")
+            handler._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def handle_healthz(self, handler) -> None:
+        status = 503 if self._draining.is_set() else 200
+        art = self.engine.artifact
+        handler._reply(status, {
+            "status": "draining" if status == 503 else "ok",
+            "task": art.task,
+            "model": art.model_config.name,
+            "step": art.step,
+            "vocab_size": art.vocab_size,
+            "input_spec": art.input_spec,
+            "engine": self.engine.stats(),
+        })
+
+    # ------------------------------------------------------------- drain
+
+    def shutdown(self, reason: str = "shutdown") -> bool:
+        """Stop admission → drain the engine → stop the HTTP loop.
+
+        Idempotent; safe from any thread (including a signal handler's
+        helper thread). Returns the engine's drained-clean verdict.
+        """
+        if self._draining.is_set():
+            self._done.wait(self.cfg.drain_timeout_s)
+            return True
+        self._draining.set()
+        log.info("drain started (%s): refusing new requests, %d queued",
+                 reason, self.engine.stats()["queue_depth"])
+        drained = self.engine.drain(self.cfg.drain_timeout_s)
+        if self._tw:
+            self._tw.emit(
+                telemetry.KIND_HEALTH,
+                health={"event": "serve_drain", "reason": reason,
+                        "clean": drained})
+        self.httpd.shutdown()
+        self._done.set()
+        log.info("drain complete (clean=%s)", drained)
+        return drained
+
+    def install_sigterm_drain(self) -> None:
+        """SIGTERM → graceful drain, from the main thread (signal module
+        requirement). The handler only spawns the drain thread — all real
+        work happens off the signal path."""
+
+        def _on_term(signum, frame):
+            del signum, frame
+            threading.Thread(
+                target=self.shutdown, args=("sigterm",),
+                name="serve-drain", daemon=True).start()
+
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGINT, _on_term)
+
+    def serve_forever(self) -> None:
+        """Block until shutdown() (or SIGTERM via the installed handler)."""
+        log.info("serving on http://%s:%d (predict, healthz)",
+                 self.host, self.port)
+        self.httpd.serve_forever()
+        self.httpd.server_close()

@@ -94,4 +94,46 @@ run coll-f32  env BENCH_COLLECTIVE=f32 python bench.py
 run coll-bf16 env BENCH_COLLECTIVE=bf16 python bench.py
 run coll-int8 env BENCH_COLLECTIVE=int8 python bench.py
 
+# 10. Serving latency/throughput A/B (docs/SERVING.md): dynamic batching
+#     ON (max_batch_size=8) vs OFF (=1) against the same exported
+#     artifact — the win is the p99-vs-req/s spread between the two
+#     SERVE_BENCH json files (closed 32-way + open-loop 200 req/s each).
+#     Self-contained: short synthetic lenet train → export (the 1-device
+#     serving mesh makes serve.allow_reshard mandatory) → standing
+#     server per arm, drained via SIGTERM (exit 0 = clean drain).
+serve_ab() {
+  local label="$1" batch="$2"
+  rm -rf /tmp/chipq_serve/artifact/serve_logs
+  python -m distributed_tensorflow_framework_tpu.cli.serve \
+      --artifact /tmp/chipq_serve/artifact \
+      --set serve.port=0 --set serve.max_batch_size="$batch" \
+      --set serve.max_wait_ms=5 > /tmp/chipq_serve_"$label".log 2>&1 &
+  local pid=$!
+  for _ in $(seq 120); do
+    [ -f /tmp/chipq_serve/artifact/serve_logs/endpoint.json ] && break
+    sleep 1
+  done
+  run serve-"$label" python scripts/load_gen.py \
+      --endpoint /tmp/chipq_serve/artifact/serve_logs/endpoint.json \
+      --requests 512 --concurrency 32 --rate 200 --mode both \
+      --out SERVE_BENCH_"$label".json
+  kill -TERM "$pid" 2>/dev/null
+  wait "$pid"
+  echo "--- [serve-$label] drain rc=$? (0 = clean SIGTERM drain)"
+  run serve-"$label"-slo python scripts/analyze_trace.py \
+      /tmp/chipq_serve/artifact/serve_logs/events.jsonl
+}
+rm -rf /tmp/chipq_serve
+run serve-train python train.py --config configs/lenet_mnist.yaml \
+    --set data.name=synthetic_images --set train.total_steps=30 \
+    --set checkpoint.directory=/tmp/chipq_serve/ckpt \
+    --set checkpoint.save_interval_steps=30 --set checkpoint.async_save=false
+run serve-export python -m distributed_tensorflow_framework_tpu.cli.export \
+    --config configs/lenet_mnist.yaml \
+    --set data.name=synthetic_images \
+    --set checkpoint.directory=/tmp/chipq_serve/ckpt \
+    --set serve.allow_reshard=true --output /tmp/chipq_serve/artifact
+serve_ab batched 8
+serve_ab unbatched 1
+
 echo "=== chip queue done $(date -u +%FT%TZ) ==="

@@ -1,0 +1,274 @@
+"""Load generator for the serving path (docs/SERVING.md) — stdlib only.
+
+Drives a running ``cli/serve.py`` endpoint two ways and writes a
+schema-versioned SERVE_BENCH.json:
+
+  * closed loop — N workers, each holding one outstanding request
+    (back-to-back). Measures the server's batching efficiency: the
+    concurrency IS the offered batch, so throughput ~ how well the
+    admission window coalesces it.
+  * open loop — requests dispatched at a fixed rate regardless of
+    completions (the SLO-honest mode: a slow server accumulates queue,
+    it does not throttle the workload).
+
+Payloads are synthesized from the endpoint's /healthz input spec, with
+variable sequence lengths for MLM artifacts so the padding buckets
+actually exercise. Client-side p50/p90/p99 come from the same bounded
+reservoir the engine uses (core/metrics.PercentileReservoir); the
+server-side queue-wait vs compute split is the delta of /healthz engine
+counters across the run.
+
+Usage:
+
+    python scripts/load_gen.py --endpoint http://127.0.0.1:8000 \
+        [--requests 256] [--concurrency 32] [--rows 1] [--rate 100] \
+        [--out SERVE_BENCH.json] [--mode closed|open|both]
+
+``--endpoint`` also accepts a path to the server's endpoint.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from distributed_tensorflow_framework_tpu.core.metrics import (  # noqa: E402
+    PercentileReservoir,
+)
+
+BENCH_SCHEMA = "dtf-serve-bench/1"
+
+
+def resolve_endpoint(endpoint: str) -> str:
+    """A URL, or a path to (a directory holding) endpoint.json."""
+    if endpoint.startswith("http://") or endpoint.startswith("https://"):
+        return endpoint.rstrip("/")
+    path = endpoint
+    if os.path.isdir(path):
+        path = os.path.join(path, "endpoint.json")
+    with open(path) as fh:
+        return json.load(fh)["url"].rstrip("/")
+
+
+def fetch_healthz(url: str) -> dict:
+    with urllib.request.urlopen(url + "/healthz", timeout=10) as resp:
+        return json.load(resp)
+
+
+def make_payload(spec: dict, rows: int, *, vocab_size: int,
+                 rng: random.Random, seq_buckets: list[int]) -> dict:
+    """One request body from the artifact's input spec. MLM rows draw a
+    random length <= a random bucket so every bucket sees traffic."""
+    inputs: dict = {}
+    if "input_ids" in spec:
+        max_len = int(spec["input_ids"]["shape"][0])
+        cap = rng.choice(seq_buckets) if seq_buckets else max_len
+        seq = rng.randint(max(1, cap // 2), min(cap, max_len))
+        inputs["input_ids"] = [
+            [rng.randrange(1, max(2, vocab_size)) for _ in range(seq)]
+            for _ in range(rows)]
+        inputs["attention_mask"] = [[1] * seq for _ in range(rows)]
+    else:
+        shape = spec["image"]["shape"]
+        n = 1
+        for d in shape:
+            n *= int(d)
+        flat = [rng.random() for _ in range(n)]
+
+        def nest(vals, dims):
+            if len(dims) == 1:
+                return vals
+            step = len(vals) // dims[0]
+            return [nest(vals[i * step:(i + 1) * step], dims[1:])
+                    for i in range(dims[0])]
+
+        inputs["image"] = [nest(flat, [int(d) for d in shape])
+                           for _ in range(rows)]
+    return {"inputs": inputs}
+
+
+def post_predict(url: str, payload: dict, timeout: float = 60.0) -> tuple:
+    """(status, latency_ms, rows_returned). Network errors count as
+    status 0 — a closed connection mid-drain must not crash the bench."""
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out = json.load(resp)
+            return resp.status, (time.monotonic() - t0) * 1e3, \
+                int(out.get("rows", 0))
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, (time.monotonic() - t0) * 1e3, 0
+    except (urllib.error.URLError, OSError, TimeoutError):
+        return 0, (time.monotonic() - t0) * 1e3, 0
+
+
+def _drive(url: str, payloads: list[dict], *, concurrency: int,
+           rate: float | None) -> dict:
+    """Run one mode over pre-built payloads; rate=None → closed loop."""
+    latency = PercentileReservoir()
+    lock = threading.Lock()
+    counts = {"ok": 0, "errors": 0, "rows": 0, "by_status": {}}
+    idx = {"next": 0}
+
+    def record(status, ms, rows):
+        with lock:
+            latency.add(ms)
+            key = str(status)
+            counts["by_status"][key] = counts["by_status"].get(key, 0) + 1
+            if status == 200:
+                counts["ok"] += 1
+                counts["rows"] += rows
+            else:
+                counts["errors"] += 1
+
+    t_start = time.monotonic()
+    if rate is None:  # closed loop: each worker keeps one request in flight
+        def worker():
+            while True:
+                with lock:
+                    i = idx["next"]
+                    if i >= len(payloads):
+                        return
+                    idx["next"] = i + 1
+                record(*post_predict(url, payloads[i]))
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(concurrency)]
+    else:  # open loop: dispatch on schedule, completion be damned
+        def fire(payload):
+            record(*post_predict(url, payload))
+
+        threads = []
+        for i, payload in enumerate(payloads):
+            t_due = t_start + i / rate
+            delay = t_due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=fire, args=(payload,), daemon=True)
+            threads.append(t)
+            t.start()
+    if rate is None:
+        for t in threads:
+            t.start()
+    for t in threads:
+        t.join()
+    elapsed = max(time.monotonic() - t_start, 1e-9)
+    s = latency.summary()
+    return {
+        "mode": "closed" if rate is None else "open",
+        "requests": len(payloads),
+        "ok": counts["ok"],
+        "errors": counts["errors"],
+        "by_status": counts["by_status"],
+        "rows": counts["rows"],
+        "elapsed_s": elapsed,
+        "requests_per_sec": counts["ok"] / elapsed,
+        "rows_per_sec": counts["rows"] / elapsed,
+        "latency_ms": {"p50": s["p50"], "p90": s["p90"], "p99": s["p99"],
+                       "mean": s["mean"], "count": s["count"]},
+        **({"offered_rate": rate} if rate is not None else
+           {"concurrency": concurrency}),
+    }
+
+
+def run_bench(endpoint: str, *, requests: int = 256, concurrency: int = 32,
+              rows: int = 1, rate: float = 100.0, mode: str = "both",
+              seed: int = 0) -> dict:
+    url = resolve_endpoint(endpoint)
+    health = fetch_healthz(url)
+    spec = health["input_spec"]
+    engine0 = health.get("engine", {})
+    rng = random.Random(seed)
+    seq_buckets = [int(b) for b in engine0.get("seq_buckets", [])]
+    payloads = [
+        make_payload(spec, rows, vocab_size=int(health.get("vocab_size", 2)),
+                     rng=rng, seq_buckets=seq_buckets)
+        for _ in range(requests)]
+    runs = []
+    if mode in ("closed", "both"):
+        runs.append(_drive(url, payloads, concurrency=concurrency,
+                           rate=None))
+    if mode in ("open", "both"):
+        runs.append(_drive(url, payloads, concurrency=concurrency,
+                           rate=rate))
+    engine1 = fetch_healthz(url).get("engine", {})
+    # Server-side split over the bench window: where did a request's
+    # life go — waiting for the admission window, or under compute?
+    split = {
+        "queue_wait_ms": (engine1.get("queue_wait_ms_total", 0)
+                          - engine0.get("queue_wait_ms_total", 0)),
+        "compute_ms": (engine1.get("compute_ms_total", 0)
+                       - engine0.get("compute_ms_total", 0)),
+        "batches": (engine1.get("batches", 0) - engine0.get("batches", 0)),
+        "batch_rows": (engine1.get("batch_rows", 0)
+                       - engine0.get("batch_rows", 0)),
+        "padded_rows": (engine1.get("padded_rows", 0)
+                        - engine0.get("padded_rows", 0)),
+    }
+    if split["padded_rows"]:
+        split["fill"] = split["batch_rows"] / split["padded_rows"]
+    return {
+        "schema": BENCH_SCHEMA,
+        "endpoint": url,
+        "model": health.get("model"),
+        "task": health.get("task"),
+        "step": health.get("step"),
+        "rows_per_request": rows,
+        "runs": runs,
+        "server_split": split,
+        "server_latency": engine1.get("latency"),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--endpoint", required=True,
+                    help="server URL, or path to its endpoint.json")
+    ap.add_argument("--requests", type=int, default=256)
+    ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--rows", type=int, default=1,
+                    help="rows per request")
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="open-loop offered rate (req/s)")
+    ap.add_argument("--mode", choices=("closed", "open", "both"),
+                    default="both")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="SERVE_BENCH.json")
+    args = ap.parse_args(argv)
+    try:
+        bench = run_bench(
+            args.endpoint, requests=args.requests,
+            concurrency=args.concurrency, rows=args.rows, rate=args.rate,
+            mode=args.mode, seed=args.seed)
+    except (urllib.error.URLError, OSError, FileNotFoundError) as e:
+        print(f"error: cannot reach {args.endpoint}: {e}", file=sys.stderr)
+        return 1
+    with open(args.out, "w") as fh:
+        json.dump(bench, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    for run in bench["runs"]:
+        lat = run["latency_ms"]
+        print(f"{run['mode']:>6}: {run['ok']}/{run['requests']} ok, "
+              f"{run['requests_per_sec']:.1f} req/s, "
+              f"p50 {lat['p50']:.1f} ms, p99 {lat['p99']:.1f} ms")
+    print(f"wrote {args.out}")
+    return 0 if all(r["ok"] for r in bench["runs"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -150,6 +150,20 @@ def test_audit_sees_the_known_drills():
     assert _defines_or_imports_driver(rd)
 
 
+def test_serve_kinds_are_audited():
+    """Self-check that the kind audit actually covers the serving SLO
+    events: all five KIND_SERVE_* constants must be extracted (a rename
+    that drops the prefix would silently fall out of the serving
+    rollup's audit trail)."""
+    serve_kinds = {k for k in _telemetry_kind_names()
+                   if k.startswith("KIND_SERVE_")}
+    assert serve_kinds >= {
+        "KIND_SERVE_REQUEST", "KIND_SERVE_BATCH", "KIND_SERVE_QUEUE",
+        "KIND_SERVE_LATENCY", "KIND_SERVE_RECOMPILE",
+    }, serve_kinds
+    assert len(serve_kinds) >= 5
+
+
 COLLECTIVES_PY = (TESTS_DIR.parent / "distributed_tensorflow_framework_tpu"
                   / "parallel" / "collectives.py")
 

@@ -1,0 +1,424 @@
+"""Serving path (serve/): export round-trip, batcher behavior, padding
+buckets, reshard gate UX, and the KIND_SERVE_* telemetry rollups.
+
+The slow end-to-end drill (real HTTP server subprocess + load generator
++ SIGTERM drain) lives in test_serve_drill.py; this file stays in tier 1
+by driving the engine in-process.
+"""
+
+import copy
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+from test_train_lenet import lenet_config
+from test_train_models import tiny_bert_base
+
+from distributed_tensorflow_framework_tpu.ckpt import manifest as mf
+from distributed_tensorflow_framework_tpu.ckpt.reshard import (
+    MeshTopologyError,
+)
+from distributed_tensorflow_framework_tpu.core import telemetry
+from distributed_tensorflow_framework_tpu.core.config import load_config
+from distributed_tensorflow_framework_tpu.models import get_model
+from distributed_tensorflow_framework_tpu.serve import (
+    InferenceEngine,
+    OversizeRequestError,
+    SequenceTooLongError,
+    export_checkpoint,
+    load_artifact,
+    save_artifact,
+    serving_mesh,
+)
+from distributed_tensorflow_framework_tpu.serve.engine import (
+    batch_buckets,
+    pick_bucket,
+)
+from distributed_tensorflow_framework_tpu.serve.export import (
+    ARTIFACT_JSON,
+    input_spec_for,
+)
+from distributed_tensorflow_framework_tpu.train import Trainer
+
+pytestmark = pytest.mark.serve
+
+
+def _serve_overrides(**extra):
+    base = {
+        "serve.data": 1,
+        "serve.max_batch_size": 8,
+        "serve.max_wait_ms": 5.0,
+        "serve.report_interval_s": 60.0,
+    }
+    base.update(extra)
+    return base
+
+
+@pytest.fixture(scope="module")
+def trained_cfg(tmp_path_factory, devices):
+    """A short lenet training run with a committed sync checkpoint,
+    trained on the default 8-device data mesh (so exporting onto the
+    1-device serving mesh is a REAL topology change)."""
+    ckpt_dir = tmp_path_factory.mktemp("serve_ckpt")
+    cfg = lenet_config(**{
+        "checkpoint.directory": str(ckpt_dir),
+        "checkpoint.async_save": False,
+        "checkpoint.save_interval_steps": 10,
+        "train.total_steps": 10,
+    })
+    trainer = Trainer(cfg)
+    trainer.build()
+    trainer.train()
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def artifact_dir(trained_cfg, tmp_path_factory):
+    cfg = copy.deepcopy(trained_cfg)
+    for k, v in _serve_overrides(**{"serve.allow_reshard": True}).items():
+        obj = cfg
+        parts = k.split(".")
+        for p in parts[:-1]:
+            obj = getattr(obj, p)
+        setattr(obj, parts[-1], v)
+    out = tmp_path_factory.mktemp("serve_artifact") / "lenet"
+    return export_checkpoint(cfg, str(out))
+
+
+@pytest.fixture(scope="module")
+def artifact(artifact_dir):
+    return load_artifact(artifact_dir)
+
+
+@pytest.fixture(scope="module")
+def engine(artifact, trained_cfg):
+    cfg = copy.deepcopy(trained_cfg)
+    for k, v in _serve_overrides().items():
+        obj = cfg
+        parts = k.split(".")
+        for p in parts[:-1]:
+            obj = getattr(obj, p)
+        setattr(obj, parts[-1], v)
+    eng = InferenceEngine(artifact, cfg.serve, mesh=serving_mesh(1))
+    yield eng
+    eng.drain(10.0)
+
+
+def _direct_logits(artifact, images):
+    model = get_model(artifact.model_config)
+    variables = {"params": artifact.params}
+    if jax.tree.leaves(artifact.batch_stats):
+        variables["batch_stats"] = artifact.batch_stats
+    return np.asarray(model.apply(variables, images, train=False))
+
+
+# ----------------------------------------------------------- pure helpers
+
+
+def test_pick_bucket_boundaries():
+    assert pick_bucket(1, [8, 16]) == 8
+    assert pick_bucket(8, [8, 16]) == 8  # boundary lands in the bucket
+    assert pick_bucket(9, [8, 16]) == 16
+    assert pick_bucket(16, [8, 16]) == 16
+    with pytest.raises(ValueError):
+        pick_bucket(17, [8, 16])
+
+
+def test_batch_buckets_ladder():
+    assert batch_buckets(8, 1) == [1, 2, 4, 8]
+    assert batch_buckets(1, 1) == [1]
+    assert batch_buckets(12, 2) == [2, 4, 8, 12]
+    # Cap rounds UP to a dp multiple so the padded batch always shards.
+    assert batch_buckets(7, 2) == [2, 4, 8]
+
+
+# ------------------------------------------------------- export round-trip
+
+
+def test_export_artifact_layout(artifact_dir, artifact):
+    meta_path = os.path.join(artifact_dir, ARTIFACT_JSON)
+    assert os.path.isfile(meta_path)
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    assert meta["schema"] == "dtf-serve-artifact/1"
+    assert meta["task"] == "classification"
+    assert meta["step"] == 10
+    assert meta["model"]["name"] == "lenet5"
+    assert meta["source"]["serve_mesh"]["data"] == 1
+    # Integrity manifest commits the whole directory (ckpt discipline).
+    manifest = mf.read_manifest(artifact_dir)
+    assert manifest is not None
+    assert mf.verify_step_dir(artifact_dir, manifest) == []
+    # Round-trip: digest recomputed at load matches the recorded one.
+    assert artifact.param_spec_digest == meta["param_spec_digest"]
+    assert artifact.step == 10
+    assert "image" in artifact.input_spec
+
+
+def test_export_refuses_nonempty_dir(artifact_dir, artifact, trained_cfg):
+    with pytest.raises(ValueError, match="immutable"):
+        save_artifact(
+            artifact_dir,
+            model_config=artifact.model_config, task=artifact.task,
+            params=artifact.params, batch_stats=artifact.batch_stats,
+            step=1, input_spec=artifact.input_spec)
+
+
+def test_reshard_gate_names_serve_knob(trained_cfg, tmp_path):
+    """Without serve.allow_reshard, exporting a training-mesh checkpoint
+    must fail with the TYPED error whose hint names the SERVE-side knob
+    (not just checkpoint.allow_reshard, which is the wrong config block
+    for an inference operator)."""
+    cfg = copy.deepcopy(trained_cfg)
+    cfg.serve.data = 1
+    assert cfg.serve.allow_reshard is False
+    with pytest.raises(MeshTopologyError) as ei:
+        export_checkpoint(cfg, str(tmp_path / "gated"))
+    assert "serve.allow_reshard" in str(ei.value)
+    assert ei.value.hint and "serve.allow_reshard" in ei.value.hint
+    assert not os.path.exists(tmp_path / "gated")
+
+
+def test_load_artifact_rejects_tampering(artifact_dir, tmp_path):
+    import shutil
+
+    tampered = tmp_path / "tampered"
+    shutil.copytree(artifact_dir, tampered)
+    meta_path = tampered / ARTIFACT_JSON
+    meta = json.loads(meta_path.read_text())
+    meta["step"] = 999  # payload no longer matches the manifest hash
+    meta_path.write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="integrity"):
+        load_artifact(str(tampered))
+
+
+# ---------------------------------------------------------------- engine
+
+
+def test_predict_matches_direct_forward(engine, artifact):
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(3, 28, 28, 1)).astype(np.float32)
+    served = engine.predict({"image": images}, timeout=30.0)
+    direct = _direct_logits(artifact, images)
+    assert served.shape == direct.shape
+    np.testing.assert_allclose(served, direct, rtol=1e-5, atol=1e-5)
+
+
+def test_single_row_without_batch_dim(engine, artifact):
+    rng = np.random.default_rng(1)
+    image = rng.normal(size=(28, 28, 1)).astype(np.float32)
+    served = engine.predict({"image": image}, timeout=30.0)
+    assert served.shape[0] == 1
+    np.testing.assert_allclose(
+        served, _direct_logits(artifact, image[None]), rtol=1e-5, atol=1e-5)
+
+
+def test_concurrent_batched_matches_unbatched(engine, artifact):
+    """~12 concurrent requests of varied row counts: the batcher
+    coalesces them into padded batches, and every caller still gets
+    exactly its own rows' logits."""
+    rng = np.random.default_rng(2)
+    requests = [rng.normal(size=(r, 28, 28, 1)).astype(np.float32)
+                for r in [1, 2, 3, 1, 4, 2, 1, 5, 2, 3, 1, 2]]
+    futures = []
+    barrier = threading.Barrier(len(requests))
+    results = [None] * len(requests)
+
+    def fire(i):
+        barrier.wait()  # maximize queue overlap → real coalescing
+        results[i] = engine.predict({"image": requests[i]}, timeout=60.0)
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(len(requests))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    del futures
+    for req, served in zip(requests, results):
+        assert served.shape[0] == req.shape[0]
+        np.testing.assert_allclose(
+            served, _direct_logits(artifact, req), rtol=1e-5, atol=1e-5)
+    # Coalescing happened: fewer batches than requests (the barrier makes
+    # anything else wildly unlikely with an 8-row window).
+    assert engine.stats()["batches"] < engine.stats()["requests"]
+
+
+def test_oversize_request_rejected(engine):
+    images = np.zeros((9, 28, 28, 1), np.float32)  # max_batch_size=8
+    with pytest.raises(OversizeRequestError):
+        engine.submit({"image": images})
+
+
+def test_bad_inputs_rejected(engine):
+    from distributed_tensorflow_framework_tpu.serve import ServeError
+
+    with pytest.raises(ServeError, match="unknown input"):
+        engine.submit({"image": np.zeros((1, 28, 28, 1), np.float32),
+                       "bogus": [1]})
+    with pytest.raises(ServeError, match="missing required"):
+        engine.submit({})
+    with pytest.raises(ServeError, match="expects"):
+        engine.submit({"image": np.zeros((1, 14, 14, 1), np.float32)})
+
+
+def test_empty_queue_is_quiet(engine):
+    """An idle engine launches no batches — the admission wait must not
+    spin out empty batches when the queue times out empty."""
+    import time
+
+    before = engine.stats()["batches"]
+    time.sleep(0.25)  # many max_wait_ms windows
+    assert engine.stats()["batches"] == before
+    # ...and it still serves afterwards.
+    out = engine.predict(
+        {"image": np.zeros((1, 28, 28, 1), np.float32)}, timeout=30.0)
+    assert out.shape[0] == 1
+
+
+# ------------------------------------------------- MLM padding buckets
+
+
+@pytest.fixture(scope="module")
+def bert_artifact(tmp_path_factory, devices):
+    """An UNTRAINED tiny-BERT artifact via save_artifact directly —
+    bucket mechanics don't need trained weights."""
+    base = tiny_bert_base(max_seq_len=16)
+    base["data"]["seq_len"] = 16
+    base["data"]["global_batch_size"] = 8
+    cfg = load_config(base=base)
+    mesh = serving_mesh(1)
+    from distributed_tensorflow_framework_tpu.train.step import StepBuilder
+
+    cfg.mesh.data = 1
+    builder = StepBuilder(cfg, mesh)
+    sample = {
+        "input_ids": np.zeros((1, 16), np.int32),
+        "targets": np.full((1, 16), -1, np.int32),
+        "attention_mask": np.ones((1, 16), np.int32),
+    }
+    state = builder.init_state(0, sample)
+    out = tmp_path_factory.mktemp("bert_artifact") / "bert"
+    save_artifact(
+        str(out),
+        model_config=cfg.model, task="mlm",
+        params=jax.device_get(state.params),
+        batch_stats=jax.device_get(state.batch_stats),
+        step=0, input_spec=input_spec_for(cfg, "mlm"),
+        vocab_size=cfg.data.vocab_size)
+    return load_artifact(str(out))
+
+
+@pytest.fixture(scope="module")
+def bert_engine(bert_artifact):
+    cfg = load_config(base={"model": {"name": "bert", "max_seq_len": 16}})
+    cfg.serve.data = 1
+    cfg.serve.max_batch_size = 4
+    cfg.serve.max_wait_ms = 2.0
+    cfg.serve.report_interval_s = 60.0
+    cfg.serve.seq_buckets = [8, 16]
+    eng = InferenceEngine(bert_artifact, cfg.serve, mesh=serving_mesh(1))
+    yield eng
+    eng.drain(10.0)
+
+
+def test_seq_buckets_bound_compiles(bert_engine, bert_artifact):
+    rng = np.random.default_rng(3)
+
+    def request(seq):
+        ids = rng.integers(1, 512, size=(1, seq)).astype(np.int32)
+        return {"input_ids": ids, "attention_mask": np.ones_like(ids)}
+
+    out5 = bert_engine.predict(request(5), timeout=60.0)
+    assert out5.shape[:2] == (1, 5)  # seq padding stripped from the reply
+    assert (8, 1) in bert_engine._compiled  # padded to the 8-bucket
+    out9 = bert_engine.predict(request(9), timeout=60.0)
+    assert out9.shape[:2] == (1, 9)
+    assert (16, 1) in bert_engine._compiled
+    # A second in-bucket length reuses the compile (no new key).
+    n = len(bert_engine._compiled)
+    bert_engine.predict(request(7), timeout=60.0)
+    assert len(bert_engine._compiled) == n
+    with pytest.raises(SequenceTooLongError):
+        bert_engine.submit(request(17))
+
+
+def test_mlm_padding_is_inert(bert_engine, bert_artifact):
+    """Padding a 5-token request up to the 8 bucket must not perturb the
+    real positions: BERT masks padded KEYS out of attention entirely."""
+    rng = np.random.default_rng(4)
+    ids = rng.integers(1, 512, size=(2, 5)).astype(np.int32)
+    mask = np.ones_like(ids)
+    served = bert_engine.predict(
+        {"input_ids": ids, "attention_mask": mask}, timeout=60.0)
+    model = get_model(bert_artifact.model_config)
+    direct = model.apply(
+        {"params": bert_artifact.params}, ids, mask, train=False)
+    if isinstance(direct, dict):
+        direct = direct["logits"]
+    np.testing.assert_allclose(
+        served, np.asarray(direct), rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------------- telemetry
+
+
+def test_serve_telemetry_rollup(artifact, trained_cfg, tmp_path):
+    """All five KIND_SERVE_* events flow end-to-end: emitted by the
+    engine, schema-valid, aggregated by summarize_events, and surfaced in
+    the human rollup (the analyze_trace.py summarize_run surface)."""
+    import time
+
+    cfg = copy.deepcopy(trained_cfg)
+    cfg.serve.data = 1
+    cfg.serve.max_batch_size = 4
+    cfg.serve.max_wait_ms = 2.0
+    cfg.serve.report_interval_s = 0.05  # force a KIND_SERVE_QUEUE tick
+    events = str(tmp_path / "events.jsonl")
+    writer = telemetry.TelemetryWriter(events)
+    eng = InferenceEngine(
+        artifact, cfg.serve, mesh=serving_mesh(1),
+        telemetry_writer=writer)
+    try:
+        rng = np.random.default_rng(5)
+        for rows in (1, 3, 2, 1, 4, 2):
+            eng.predict(
+                {"image": rng.normal(size=(rows, 28, 28, 1))
+                 .astype(np.float32)}, timeout=30.0)
+        time.sleep(0.15)  # at least one reporter tick
+    finally:
+        assert eng.drain(10.0)
+        writer.close()
+    kinds = {ev["kind"] for ev in telemetry.read_events(events)}
+    assert telemetry.KIND_SERVE_REQUEST in kinds
+    assert telemetry.KIND_SERVE_BATCH in kinds
+    assert telemetry.KIND_SERVE_QUEUE in kinds
+    assert telemetry.KIND_SERVE_LATENCY in kinds
+    assert telemetry.KIND_SERVE_RECOMPILE in kinds
+    summary = telemetry.summarize_events(events)
+    serve = summary["serve"]
+    assert serve["requests"] == 6
+    assert serve["rows"] == 13
+    assert 1 <= serve["batches"] <= 6
+    assert serve["batch_rows"] == 13
+    assert serve["padded_rows"] >= serve["batch_rows"]
+    assert serve["latency"]["count"] == 6
+    assert serve["latency"]["p99_ms"] >= serve["latency"]["p50_ms"]
+    assert serve["recompiles"]  # first bucket use was recorded
+    text = telemetry.format_run_summary(summary)
+    assert "serving: 6 requests (13 rows)" in text
+    assert "p99" in text
+    assert "bucket recompiles" in text
+
+
+def test_runs_without_serve_events_have_no_serving_section(tmp_path):
+    events = str(tmp_path / "train_only.jsonl")
+    writer = telemetry.TelemetryWriter(events)
+    writer.emit(telemetry.KIND_TRAIN_STEP, step=1, metrics={"loss": 1.0})
+    writer.close()
+    summary = telemetry.summarize_events(events)
+    assert summary["serve"] is None
+    assert "serving:" not in telemetry.format_run_summary(summary)

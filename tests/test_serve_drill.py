@@ -1,0 +1,195 @@
+"""End-to-end serving acceptance drill (tier-2).
+
+The full path, as deployed: train → export → ``cli/serve.py`` server
+SUBPROCESS on an ephemeral port → ``scripts/load_gen.py`` driving 256
+concurrent requests through real HTTP → SLO rollup via
+``scripts/analyze_trace.py`` → SIGTERM drain to a clean exit 0.
+
+Logit parity is asserted BITWISE: a request's rows served inside a
+coalesced padded batch must match the unbatched direct forward exactly
+(same jitted computation, row-independent ops — verified, not assumed).
+"""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+from test_train_lenet import lenet_config
+
+from distributed_tensorflow_framework_tpu.core import telemetry
+from distributed_tensorflow_framework_tpu.serve import (
+    export_checkpoint,
+    load_artifact,
+)
+from distributed_tensorflow_framework_tpu.train import Trainer
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+pytestmark = [pytest.mark.slow, pytest.mark.serve]
+
+
+def _post(url, payload, timeout=60.0):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+    except (urllib.error.URLError, OSError):
+        return 0, {}
+
+
+def _wait_for_endpoint(path, proc, timeout=180.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"server exited rc={proc.returncode} before serving:\n"
+                f"{proc.stdout.read()}")
+        if os.path.isfile(path):
+            with open(path) as fh:
+                return json.load(fh)
+        time.sleep(0.5)
+    raise AssertionError(f"no endpoint.json at {path} after {timeout}s")
+
+
+def test_serving_acceptance_drill(devices, tmp_path):
+    # 1. Train a short lenet run with a committed checkpoint.
+    cfg = lenet_config(**{
+        "checkpoint.directory": str(tmp_path / "ckpt"),
+        "checkpoint.async_save": False,
+        "checkpoint.save_interval_steps": 10,
+        "train.total_steps": 10,
+    })
+    trainer = Trainer(cfg)
+    trainer.build()
+    trainer.train()
+
+    # 2. Export onto the 1-device serving mesh (training mesh was the
+    # full 8-device data mesh, so this is a real reshard).
+    cfg.serve.data = 1
+    cfg.serve.allow_reshard = True
+    art_dir = export_checkpoint(cfg, str(tmp_path / "artifact"))
+    artifact = load_artifact(art_dir)
+
+    # 3. Stand the server up as a real subprocess on an ephemeral port.
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "distributed_tensorflow_framework_tpu.cli.serve",
+         "--artifact", art_dir,
+         "--set", "serve.port=0",
+         "--set", "serve.max_batch_size=8",
+         "--set", "serve.max_wait_ms=5",
+         "--set", "serve.report_interval_s=0.5"],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        endpoint = _wait_for_endpoint(
+            os.path.join(art_dir, "serve_logs", "endpoint.json"), proc)
+        url = endpoint["url"]
+
+        # 4. 256 requests through the load generator (closed 32-way
+        # concurrent + open-loop), SERVE_BENCH.json written.
+        bench_path = tmp_path / "SERVE_BENCH.json"
+        gen = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "load_gen.py"),
+             "--endpoint", url, "--requests", "256", "--concurrency", "32",
+             "--rate", "200", "--mode", "both", "--out", str(bench_path)],
+            cwd=str(REPO), env=env, capture_output=True, text=True,
+            timeout=600)
+        assert gen.returncode == 0, gen.stdout + gen.stderr
+        bench = json.loads(bench_path.read_text())
+        assert bench["schema"] == "dtf-serve-bench/1"
+        assert len(bench["runs"]) == 2
+        for run in bench["runs"]:
+            assert run["ok"] == 256, run
+            assert run["latency_ms"]["p99"] >= run["latency_ms"]["p50"] > 0
+            assert run["requests_per_sec"] > 0
+        # The server actually coalesced: fewer batches than requests.
+        assert 0 < bench["server_split"]["batches"] < 512
+        assert bench["server_split"]["compute_ms"] > 0
+
+        # 5. Parity: the same rows served inside a coalesced batch and
+        # via the direct in-process forward must match BITWISE.
+        rng = np.random.default_rng(0)
+        images = rng.normal(size=(3, 28, 28, 1)).astype(np.float32)
+        from distributed_tensorflow_framework_tpu.models import get_model
+
+        model = get_model(artifact.model_config)
+        direct = np.asarray(
+            model.apply({"params": artifact.params}, images, train=False))
+        payload = {"inputs": {"image": images.tolist()}}
+        statuses, outputs = [], []
+        lock = threading.Lock()
+
+        def fire():
+            s, out = _post(url, payload)
+            with lock:
+                statuses.append(s)
+                outputs.append(out)
+
+        threads = [threading.Thread(target=fire) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert statuses == [200] * 8, statuses
+        for out in outputs:
+            served = np.asarray(out["outputs"], np.float32)
+            assert served.shape == direct.shape
+            assert np.array_equal(served, direct), (
+                f"batched logits diverge from direct forward by "
+                f"{np.max(np.abs(served - direct))}")
+
+        # 6. SLO rollup through the analyze_trace.py surface.
+        events_path = endpoint["events"]
+        rollup = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "analyze_trace.py"),
+             events_path],
+            cwd=str(REPO), env=env, capture_output=True, text=True,
+            timeout=120)
+        assert rollup.returncode == 0, rollup.stdout + rollup.stderr
+        assert "serving:" in rollup.stdout
+        assert "p99" in rollup.stdout
+        assert "req/s" in rollup.stdout
+
+        # 7. SIGTERM drain: requests in flight when the signal lands
+        # either complete (200) or are refused cleanly (503/closed) —
+        # never a hung client — and the process exits 0.
+        drain_statuses = []
+
+        def fire_during_drain():
+            s, _ = _post(url, payload, timeout=30.0)
+            with lock:
+                drain_statuses.append(s)
+
+        drainers = [threading.Thread(target=fire_during_drain)
+                    for _ in range(16)]
+        for t in drainers:
+            t.start()
+        proc.send_signal(signal.SIGTERM)
+        for t in drainers:
+            t.join()
+        assert proc.wait(timeout=120) == 0, proc.stdout.read()
+        assert set(drain_statuses) <= {200, 503, 0}, drain_statuses
+        # The drain left its telemetry record, and it drained clean.
+        drained = [ev for ev in telemetry.read_events(events_path)
+                   if (ev.get("health") or {}).get("event") == "serve_drain"]
+        assert drained and drained[-1]["health"]["clean"] is True
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)

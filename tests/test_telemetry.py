@@ -493,6 +493,17 @@ def test_summarize_rolls_up_every_kind(tmp_path):
            to_axes={"data": 4}, visible_devices=4)
     w.emit(telemetry.KIND_CKPT_RESHARDED, step=4, from_axes={"data": 8},
            to_axes={"data": 4}, leaf_count=8)
+    w.emit(telemetry.KIND_SERVE_REQUEST,
+           metrics={"rows": 2, "queue_wait_ms": 1.0, "latency_ms": 4.0})
+    w.emit(telemetry.KIND_SERVE_BATCH,
+           metrics={"rows": 2, "padded_rows": 4, "compute_ms": 3.0,
+                    "queue_depth": 1})
+    w.emit(telemetry.KIND_SERVE_QUEUE, metrics={"queue_depth": 2})
+    w.emit(telemetry.KIND_SERVE_LATENCY,
+           metrics={"p50_ms": 3.0, "p90_ms": 4.0, "p99_ms": 4.0, "count": 1},
+           throughput={"requests_per_sec": 10.0, "rows_per_sec": 20.0})
+    w.emit(telemetry.KIND_SERVE_RECOMPILE, bucket="rows2",
+           metrics={"compile_ms": 50.0})
     w.close()
 
     s = telemetry.summarize_events(path)
@@ -509,6 +520,8 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert s["bench_probes"] == 1
     assert s["trace_summaries"] == 1
     assert s["health_events"] == {"moe_collapse": 1}
+    assert s["serve"]["requests"] == 1 and s["serve"]["batches"] == 1
+    assert s["serve"]["queue_depth_max"] == 2
     text = telemetry.format_run_summary(s)
     assert "run: config_name=lenet" in text
     assert "evals: 1 (last at step 2)" in text
@@ -516,3 +529,5 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert "backend probes: 1" in text
     assert "trace summaries: 1" in text
     assert "health events: moe_collapse=1" in text
+    assert "serving: 1 requests (2 rows) in 1 batches" in text
+    assert "bucket recompiles: 1 (rows2)" in text
