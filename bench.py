@@ -215,7 +215,28 @@ def bench_resnet50(batch_size: int, steps: int = 20, warmup: int = 3,
     out = _compile_and_time(builder, state, batch, steps, warmup)
     out["images_per_sec"] = batch_size / out["sec_per_step"]
     out["mesh_axes"] = _mesh_axes(mesh)
+    out["opt_state_bytes_per_chip"] = _opt_state_bytes_per_chip(state)
     return out
+
+
+def _opt_state_bytes_per_chip(state) -> int:
+    """Per-device optimizer-slot footprint, read off the placed shardings.
+
+    Sums prod(shard_shape) x itemsize over every opt_state leaf — the
+    number ZeRO weight-update sharding divides by the data x fsdp replica
+    count, so the BENCH_ZERO A/B reports the memory win exactly (from the
+    arrays' own layouts) rather than estimating it."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(state.opt_state):
+        sharding = getattr(leaf, "sharding", None)
+        shape = (sharding.shard_shape(leaf.shape)
+                 if sharding is not None else getattr(leaf, "shape", ()))
+        itemsize = int(getattr(getattr(leaf, "dtype", None), "itemsize", 4))
+        total += int(np.prod(shape)) * itemsize
+    return total
 
 
 def bench_inception(batch_size: int, steps: int = 20, warmup: int = 3) -> dict:
@@ -775,6 +796,72 @@ def _run_collective_ab(writer, mode: str, n_chips: int, chip: str) -> int:
     return 0
 
 
+_ZERO_MODES = ("off", "shard_map")
+
+
+def _run_zero_ab(writer, mode: str, n_chips: int, chip: str) -> int:
+    """BENCH_ZERO=off|shard_map — ZeRO weight-update sharding A/B.
+
+    Runs the ResNet-50 workload TWICE on the same batch ladder under
+    ``train.spmd_mode=shard_map``: a replicated-optimizer baseline
+    (``optimizer.zero_sharding=off``), then the bucketed reduce-scatter /
+    all-gather update path. The JSON line reports the per-chip optimizer
+    slot footprint of both arms (read off the placed shardings — the
+    memory win is the point of ZeRO-1/2) plus the throughput delta the
+    extra collectives cost. ``off`` runs the baseline once and reports
+    ratio 1.0 — the self-calibration dial for the queue.
+    """
+    metric = "resnet50_zero_opt_state_ratio"
+    unit = "x"
+    ladder = _ladder_override(
+        (128 * n_chips, 64 * n_chips, 32 * n_chips), n_chips)
+
+    def run(arm: str):
+        return _run_ladder(
+            lambda bs: bench_resnet50(bs, base_overrides={
+                "train": {"spmd_mode": "shard_map"},
+                "optimizer": {"zero_sharding": arm},
+            }),
+            ladder, metric, unit, chip, writer=writer)
+
+    baseline = run("off")
+    if baseline is None:
+        return 1
+    target = run("shard_map") if mode == "shard_map" else baseline
+    if target is None:
+        return 1
+
+    base_b = baseline.get("opt_state_bytes_per_chip")
+    tgt_b = target.get("opt_state_bytes_per_chip")
+    ratio = round(base_b / tgt_b, 3) if base_b and tgt_b else None
+    base_rate = baseline["images_per_sec"] / n_chips
+    tgt_rate = target["images_per_sec"] / n_chips
+    out = {
+        "metric": metric,
+        "value": ratio if ratio is not None else 0.0,
+        "unit": unit,
+        "vs_baseline": 0.0,
+        "baseline_kind": "zero-off-self",
+        "chip": chip,
+        "num_chips": n_chips,
+        "mesh_axes": target.get("mesh_axes"),
+        "zero_sharding": mode,
+        "baseline_opt_state_bytes_per_chip": base_b,
+        "target_opt_state_bytes_per_chip": tgt_b,
+        "baseline_images_per_sec_per_chip": round(base_rate, 2),
+        "target_images_per_sec_per_chip": round(tgt_rate, 2),
+        # Relative throughput change from the sharded update alone (same
+        # ladder, same mesh): -0.02 = 2% slower than the replicated
+        # optimizer. The memory ratio above is what that 2% buys.
+        "throughput_delta": round(tgt_rate / base_rate - 1.0, 4),
+        "run_id": writer.run_id,
+    }
+    _annotate_roofline(out, target, chip, n_chips)
+    _emit_bench_result(writer, f"resnet50-zero-{mode}", out, target)
+    print(json.dumps(out))
+    return 0
+
+
 def _run(writer) -> int:
     from distributed_tensorflow_framework_tpu.core import telemetry
 
@@ -835,6 +922,22 @@ def _run(writer) -> int:
         # workload): one JSON line comparing f32 wire vs the requested
         # format on the same ladder.
         return _run_collective_ab(writer, coll_mode, n_chips, chip)
+
+    zero_mode = os.environ.get("BENCH_ZERO", "").strip()
+    if zero_mode:
+        if zero_mode not in _ZERO_MODES:
+            err = (f"BENCH_ZERO={zero_mode!r} not in "
+                   f"{sorted(_ZERO_MODES)}")
+            writer.emit(telemetry.KIND_FAILURE,
+                        health={"failure": "bench_config", "error": err})
+            print(json.dumps({"metric": metric, "value": 0.0, "unit": unit,
+                              "vs_baseline": 0.0, "error": err,
+                              "run_id": writer.run_id}))
+            return 1
+        # Like BENCH_COLLECTIVE, the A/B owns the invocation: one JSON
+        # line comparing replicated vs ZeRO-sharded optimizer state on
+        # the same ladder.
+        return _run_zero_ab(writer, zero_mode, n_chips, chip)
 
     if workload == "bert":
         # The transformer workload (kept OFF the driver's default path —

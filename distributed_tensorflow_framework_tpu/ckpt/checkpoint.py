@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import time
 from typing import Any
@@ -58,6 +59,7 @@ from distributed_tensorflow_framework_tpu.ckpt import reshard
 from distributed_tensorflow_framework_tpu.ckpt.async_saver import AsyncSaver
 from distributed_tensorflow_framework_tpu.core import faults, telemetry
 from distributed_tensorflow_framework_tpu.core.config import CheckpointConfig
+from distributed_tensorflow_framework_tpu.parallel import zero
 from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
 from distributed_tensorflow_framework_tpu.train.state import TrainState
 
@@ -319,6 +321,40 @@ class CheckpointManager:
                                                jnp.float32),
                 template.params)
 
+        # ZeRO-stacked optimizer slots (parallel/zero.py): detected
+        # structurally from the template — (n, ceil(size/n)) rows per
+        # param-mirroring slot. A cross-mesh restore must READ them at
+        # the STORED row grid and refold host-side (the row count is the
+        # data×fsdp replica count, exactly like the EF residual above).
+        zero_rows = zero.stacked_rows(template.opt_state, template.params)
+
+        def _zero_saved_rows() -> int | None:
+            axes = (saved_topo or {}).get("axes") or {}
+            if not axes:
+                return None
+            return int(axes.get("data", 1)) * int(axes.get("fsdp", 1))
+
+        def _zero_read_tmpl() -> Any:
+            n_saved = _zero_saved_rows()
+            if n_saved is None:
+                raise ValueError(
+                    f"checkpoint step {step} in {self._path} is being "
+                    f"resharded with ZeRO-stacked optimizer state but its "
+                    f"manifest has no mesh topology record — cannot derive "
+                    f"the stored shard grid"
+                )
+            if n_saved == zero_rows:
+                return template.opt_state
+
+            def tmpl(slot, param):
+                if param is None or getattr(slot, "ndim", 0) != 2:
+                    return slot
+                size = int(math.prod(param.shape)) if param.shape else 1
+                return jax.ShapeDtypeStruct(
+                    (n_saved, -(-size // n_saved)), slot.dtype)
+
+            return zero.map_slots(tmpl, template.opt_state, template.params)
+
         def tmpl_for(stored_ema: bool, stored_res: str) -> TrainState:
             """Restore template matching the stored tree's EMA and
             error-feedback-residual presence."""
@@ -357,6 +393,8 @@ class CheckpointManager:
                         "— starting from a zero residual", step,
                     )
                 t = t.replace(collective_residual={})
+            if reshard_plan is not None and zero_rows:
+                t = t.replace(opt_state=_zero_read_tmpl())
             return t
 
         def attempt(t: TrainState, *, legacy: bool):
@@ -412,6 +450,24 @@ class CheckpointManager:
                     stored_res = ("empty" if stored_res == "shaped"
                                   else "shaped")
                     continue
+                if "opt_state" in msg or "Ranks do not match" in msg:
+                    # A slot-shape (or tensorstore rank — the stacked
+                    # (n, chunk) layout differs in RANK from the param
+                    # shape, and that error carries no tree path)
+                    # mismatch here is the ZeRO layout
+                    # toggled (or re-gridded without a reshard plan)
+                    # across a resume — name the knob instead of leaking
+                    # an orbax tree error.
+                    raise ValueError(
+                        f"checkpoint step {step} in {self._path} stores an "
+                        f"optimizer state whose slot layout does not match "
+                        f"this run's: toggling optimizer.zero_sharding "
+                        f"between 'shard_map' and another mode across a "
+                        f"resume is unsupported (replicated and "
+                        f"ZeRO-stacked slot layouts are incompatible) — "
+                        f"restore with the setting the checkpoint was "
+                        f"saved under ({e})"
+                    ) from e
                 raise
         if reshard_plan is not None:
             # Cross-mesh load succeeded mechanically; confirm it moved
@@ -440,6 +496,20 @@ class CheckpointManager:
             # takes its {} default and is reconciled below.
             raw = TrainState(**raw)
         state = _unpack(raw, tmpl)
+        if reshard_plan is not None and zero_rows:
+            n_saved = _zero_saved_rows()
+            if n_saved != zero_rows:
+                refolded = reshard.refold_zero_opt_state(
+                    state.opt_state, template.params, zero_rows)
+                state = state.replace(opt_state=jax.tree.map(
+                    lambda f, t: (jax.device_put(f, t.sharding)
+                                  if hasattr(t, "sharding") else f),
+                    refolded, template.opt_state))
+                log.warning(
+                    "ZeRO optimizer state re-gridded %d -> %d shard rows "
+                    "(padding truncated and re-derived) across the "
+                    "reshard", n_saved, zero_rows,
+                )
         if want_res and stored_res == "shaped":
             n_saved = jax.tree.leaves(state.collective_residual)[0].shape[0]
             if n_saved != n_want:

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import math
 from typing import Any
 
 import jax
@@ -251,6 +252,41 @@ def fold_residual(tree: Any, n_new: int) -> Any:
         return jnp.concatenate([total, pad], axis=0)
 
     return jax.tree.map(fold, tree)
+
+
+def refold_zero_opt_state(stored: Any, params: Any, n_new: int) -> Any:
+    """Re-chunk ZeRO-stacked optimizer slots for a new replica count.
+
+    The shard_map ZeRO path (parallel/zero.py) stores each slot as
+    ``(n_old, ceil(size/n_old))`` — flattened param values zero-padded to
+    the row grid. A cross-mesh restore must re-grid to
+    ``(n_new, ceil(size/n_new))``: flatten, TRUNCATE to the true element
+    count (dropping the old grid's padding), re-pad for the new grid.
+    The padding is provably inert — padded grad AND param positions are
+    exactly zero, so every optax rule we allow under ZeRO produces a
+    zero update there (rmsprop's ``initial_scale=1.0`` slot refolds to 0
+    in pad cells, which only affects those same zero-update cells).
+
+    ``params`` pairs slots to their true sizes via
+    :func:`parallel.zero.map_slots`; non-mirroring leaves (optax step
+    counters) pass through untouched.
+    """
+    from distributed_tensorflow_framework_tpu.parallel import zero
+
+    def refold(slot, param):
+        if param is None or getattr(slot, "ndim", 0) != 2:
+            return slot
+        size = int(math.prod(param.shape)) if param.shape else 1
+        chunk = -(-size // n_new)
+        if tuple(slot.shape) == (n_new, chunk):
+            return slot
+        flat = jnp.asarray(slot).reshape(-1)[:size]
+        pad = n_new * chunk - size
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        return flat.reshape(n_new, chunk)
+
+    return zero.map_slots(refold, stored, params)
 
 
 def validate_restored(template: Any, restored: Any, *, step: int) -> int:

@@ -86,6 +86,14 @@ KIND_INFEED_STALL = "infeed_stall"
 # can be read against the schedule that produced it. The per-step
 # ``pipe_bubble_frac`` metric rides in ordinary train_step events.
 KIND_PIPELINE = "pipeline_schedule"
+# One per ZeRO-sharded run (optimizer.zero_sharding="shard_map",
+# parallel/zero.py): the static shard/bucket plan — bucket count, shard
+# (replica) count, per-shard elements, reduce-scatter vs all-gather wire
+# bytes per step, the structural overlap-fraction bound (B-1)/B and the
+# nominal-bandwidth estimate of collective milliseconds hidden behind
+# backward compute. Analytic from the plan; measured bytes ride the
+# ordinary CollectiveTally rows (zero_reduce_scatter / zero_all_gather).
+KIND_ZERO_UPDATE = "zero_update"
 # Elastic resharding (docs/RESILIENCE.md "losing a slice"):
 # ``mesh_resized`` is the supervisor refitting the mesh to a shrunken/
 # grown device set before a relaunch (scripts/train_resilient.py, rc 84);
@@ -348,6 +356,7 @@ def summarize_events(path: str) -> dict:
     }
     startups: list[dict] = []
     pipeline: dict | None = None
+    zero: dict | None = None
     step_rates: list[float] = []
     meta: dict | None = None
     evals = {"count": 0, "last_step": None}
@@ -424,6 +433,8 @@ def summarize_events(path: str) -> dict:
             })
         elif kind == KIND_PIPELINE:
             pipeline = dict(extra)
+        elif kind == KIND_ZERO_UPDATE:
+            zero = dict(extra)
         elif kind == KIND_RUN_META and meta is None:
             meta = {k: extra.get(k) for k in (
                 "config_name", "model", "dataset", "mesh",
@@ -537,6 +548,7 @@ def summarize_events(path: str) -> dict:
         "ckpt_saves": saves,
         "startups": startups,
         "pipeline": pipeline,
+        "zero": zero,
         "serve": (serve if (serve["requests"] or serve["batches"]
                             or serve["recompiles"]) else None),
         "recovery": {
@@ -639,6 +651,23 @@ def format_run_summary(summary: dict) -> str:
             bits.append(
                 f"steady {float(pipe['steady_examples_per_sec']):.1f} ex/s")
         lines.append("  pipeline: " + ", ".join(bits))
+    zero = summary.get("zero")
+    if zero:  # KIND_ZERO_UPDATE rollup
+        bits = [
+            f"{zero.get('shards', '?')} shards, "
+            f"{zero.get('buckets', '?')} buckets "
+            f"({zero.get('bucket_mb', '?')} MiB, wire {zero.get('wire', '?')})"
+        ]
+        if zero.get("rs_wire_bytes") is not None:
+            bits.append(
+                f"RS {int(zero['rs_wire_bytes']):,} B + "
+                f"AG {int(zero.get('ag_wire_bytes') or 0):,} B/step")
+        if zero.get("overlap_frac_est") is not None:
+            bits.append(
+                f"overlap est {float(zero['overlap_frac_est']):.2f}"
+                + (f" (~{float(zero['hidden_ms_est']):.2f} ms hidden)"
+                   if zero.get("hidden_ms_est") is not None else ""))
+        lines.append("  zero update sharding: " + ", ".join(bits))
     serve = summary.get("serve")
     if serve:  # KIND_SERVE_REQUEST / KIND_SERVE_BATCH rollup
         fill = (serve["batch_rows"] / serve["padded_rows"]

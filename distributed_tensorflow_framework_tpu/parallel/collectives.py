@@ -390,26 +390,29 @@ def pmean(x: Any, axis_names: Sequence[str] | str) -> Any:
 
 def all_gather(x: jax.Array, axis_name, *, axis: int = 0, tiled: bool = True,
                wire_dtype: Any = None,
-               block_size: int = DEFAULT_BLOCK_SIZE) -> jax.Array:
+               block_size: int = DEFAULT_BLOCK_SIZE,
+               kind: str = "all_gather") -> jax.Array:
     """All-gather with an optional narrow wire format.
 
     ``bfloat16`` casts the payload (lossy for f32 operands — no error
     feedback exists for gathered values, see docs/PERFORMANCE.md);
     ``int8`` ships block-scaled int8 and dequantizes on arrival. The
-    fsdp param gather (train/step.py) is the hot call site.
+    fsdp param gather (train/step.py) is the hot call site. ``kind``
+    relabels the tally row for call sites that need their bytes
+    attributed separately (the ZeRO update gather, parallel/zero.py).
     """
     wire = _canon_wire(wire_dtype)
     n = _axes_size(axis_name)
     if wire is None or wire == x.dtype:
-        _record("all_gather", x, multiplier=n)
+        _record(kind, x, multiplier=n)
         return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
     if wire != jnp.int8:
-        _record("all_gather", x, wire_dtype=wire, multiplier=n)
+        _record(kind, x, wire_dtype=wire, multiplier=n)
         return lax.all_gather(x.astype(wire), axis_name, axis=axis,
                               tiled=tiled).astype(x.dtype)
     flat = _pad_to(x.astype(jnp.float32).reshape(-1), block_size)
     q, scales = quantize_blockwise(flat, block_size)
-    _record("all_gather", x, wire_dtype=jnp.int8, multiplier=n,
+    _record(kind, x, wire_dtype=jnp.int8, multiplier=n,
             overhead_bytes=n * scales.size * SCALE_BYTES)
     qg = lax.all_gather(q, axis_name, axis=0, tiled=False)       # (n, padded)
     sg = lax.all_gather(scales, axis_name, axis=0, tiled=False)
@@ -425,23 +428,25 @@ def all_gather(x: jax.Array, axis_name, *, axis: int = 0, tiled: bool = True,
 
 def reduce_scatter(x: jax.Array, axis_name, *, scatter_axis: int = 0,
                    wire_dtype: Any = None,
-                   block_size: int = DEFAULT_BLOCK_SIZE) -> jax.Array:
+                   block_size: int = DEFAULT_BLOCK_SIZE,
+                   kind: str = "reduce_scatter") -> jax.Array:
     """Reduce-scatter (sum) with an optional narrow wire format.
 
     The int8 path quantizes each destination's chunk independently (so
     scales travel with their chunk), routes chunks with one
     ``all_to_all``, and accumulates the dequantized partials in f32 —
     the scatter half of the EQuARX all-reduce, usable standalone for
-    ZeRO-2-style scattered grad updates.
+    ZeRO-2-style scattered grad updates. ``kind`` relabels the tally row
+    for call sites needing separate byte attribution (parallel/zero.py).
     """
     wire = _canon_wire(wire_dtype)
     if wire is None or wire == x.dtype:
-        _record("reduce_scatter", x)
+        _record(kind, x)
         return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
                                 tiled=True)
     if wire != jnp.int8:
         # Narrow-float wire AND accumulation (document at call sites).
-        _record("reduce_scatter", x, wire_dtype=wire)
+        _record(kind, x, wire_dtype=wire)
         return lax.psum_scatter(
             x.astype(wire), axis_name, scatter_dimension=scatter_axis,
             tiled=True).astype(x.dtype)
@@ -455,7 +460,7 @@ def reduce_scatter(x: jax.Array, axis_name, *, scatter_axis: int = 0,
     rows = moved.reshape(n, -1)                      # row p = chunk for dev p
     rows = jax.vmap(lambda v: _pad_to(v, block_size))(rows)
     q, scales = jax.vmap(lambda v: quantize_blockwise(v, block_size))(rows)
-    _record("reduce_scatter", x, wire_dtype=jnp.int8,
+    _record(kind, x, wire_dtype=jnp.int8,
             overhead_bytes=scales.size * SCALE_BYTES)
     qx = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=False)
     sx = lax.all_to_all(scales, axes, split_axis=0, concat_axis=0, tiled=False)

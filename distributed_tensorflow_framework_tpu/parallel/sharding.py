@@ -102,17 +102,34 @@ def _apply_tp(path: str, shape: tuple[int, ...], mesh: Mesh) -> P | None:
     return _match_rules(path, shape, mesh, TP_RULES)
 
 
+def pick_fsdp_dim(shape: tuple[int, ...], fsdp: int,
+                  taken: tuple = ()) -> int:
+    """Dim index to shard over fsdp, or -1 if none qualifies.
+
+    The LARGEST still-unsharded dim divisible by ``fsdp`` wins; among
+    equal-size candidates the TRAILING dim wins — matching the TP rules'
+    column/row convention (kernels shard their last dim first) and, more
+    importantly, DETERMINISTIC: the old first-dim tie-break depended on
+    scan order alone, so a square kernel's layout could flip between a
+    spec computed here and one computed by a caller iterating
+    differently. ``taken`` marks already-sharded dims (per-dim axis
+    entries; None = free).
+    """
+    axes = tuple(taken) + (None,) * (len(shape) - len(tuple(taken)))
+    best, best_size = -1, 0
+    for i, (dim, axis) in enumerate(zip(shape, axes)):
+        if axis is None and dim and dim % fsdp == 0 and dim >= best_size:
+            best, best_size = i, dim
+    return best
+
+
 def _apply_fsdp(spec: P | None, shape: tuple[int, ...], mesh: Mesh) -> P | None:
     fsdp = mesh.shape.get("fsdp", 1)
     if fsdp <= 1:
         return spec
     dims = spec if spec is not None else (None,) * len(shape)
     dims = tuple(dims) + (None,) * (len(shape) - len(tuple(dims)))
-    # Shard the largest still-unsharded divisible dim over fsdp.
-    best, best_size = -1, 0
-    for i, (dim, axis) in enumerate(zip(shape, dims)):
-        if axis is None and dim % fsdp == 0 and dim > best_size:
-            best, best_size = i, dim
+    best = pick_fsdp_dim(shape, fsdp, dims)
     if best < 0:
         return spec
     new = list(dims)

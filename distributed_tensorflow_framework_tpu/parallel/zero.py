@@ -1,0 +1,505 @@
+"""ZeRO weight-update sharding with bucketed compute/comm overlap.
+
+The "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" paper (PAPERS.md) observes that sync-DP wastes two resources:
+every replica holds the FULL optimizer state, and every replica runs the
+FULL weight update — both redundant, since the all-reduced gradient is
+identical everywhere. The fix: reduce-SCATTER the gradients so replica
+``i`` owns shard ``i`` of the flattened gradient, update only that shard
+(1/n of the optimizer memory and update FLOPs), and all-gather the result.
+
+This module is the explicit shard_map twin of that transform
+(``optimizer.zero_sharding="shard_map"``; the passive jit-spec variant is
+``"jit"``, the deprecated ``optimizer.shard_opt_state``). Layout:
+
+  * Every param leaf is flattened, zero-padded to ``n·c`` elements
+    (``c = ceil(size/n)``), and viewed as ``n`` rows of ``c`` — row ``i``
+    is replica ``i``'s shard. Optimizer slots are created directly at the
+    stacked ``(n, c)`` shape (``tx.init`` on the stacked tree), globally
+    sharded ``P(("data","fsdp"))`` on the row dim, so per-device slot HBM
+    is ~1/n of the replicated layout. Padding rows are inert: padded
+    grads are exactly zero, so their momentum/variance never moves and
+    their update is identically zero for every optax rule we ship.
+  * Gradients are reduce-scattered in BUCKETS of consecutive leaves in
+    REVERSE layer order (natural-sorted param path, deepest-in-backward
+    first). TPU collectives execute in program order, so issuing bucket
+    ``k``'s reduce-scatter before the (independent) remaining program
+    lets XLA's latency-hiding scheduler overlap it with the backward of
+    layers issued after it — every bucket except the last can hide
+    behind compute. ``optimizer.zero_bucket_mb`` trades per-collective
+    latency overhead against overlap granularity.
+  * The all-gather ships the UPDATES, not the params: every replica
+    applies the identical gathered update to its full f32 master params,
+    so replicas cannot drift even under a lossy gather wire. Wire
+    formats reuse ``parallel.collective_dtype`` (bf16 cast / int8
+    block-scaled, parallel/quantization.py); the int8 reduce-scatter
+    threads per-replica error feedback through
+    ``TrainState.collective_residual`` exactly like the all-reduce path
+    (compensate → quantize → carry ``c − D(Q(c))`` to the next step).
+    The update all-gather has NO error feedback — gathered values have
+    no next-step correction site — which is why it ships updates (lossy
+    but replica-identical) rather than params.
+
+Checkpoint/reshard integration: the stacked ``(n, c)`` slots round-trip
+through orbax as ordinary arrays; a cross-mesh restore reads them at the
+STORED row count and refolds host-side (ckpt/reshard.refold_zero_opt_state
+— flatten, truncate the padding, re-pad for the new ``n``), mirroring the
+error-feedback residual's fold.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_tensorflow_framework_tpu.parallel import collectives as coll
+from distributed_tensorflow_framework_tpu.parallel.quantization import (
+    DEFAULT_BLOCK_SIZE,
+    SCALE_BYTES,
+    dequantize_blockwise,
+    quantize_blockwise,
+)
+
+DATA_AXES = ("data", "fsdp")
+
+# Tally kinds for the ZeRO collectives — kept distinct from the generic
+# reduce_scatter/all_gather kinds so the telemetry rollup (KIND_ZERO_UPDATE)
+# and the bench A/B can attribute wire bytes to this path specifically.
+RS_KIND = "zero_reduce_scatter"
+AG_KIND = "zero_all_gather"
+
+# Order-of-magnitude per-link ICI bandwidth (v4/v5-class, one direction)
+# used ONLY for the telemetry "hidden ms" estimate — an interpretation aid
+# for the overlap fraction, not a measurement. Real numbers come from the
+# bench/trace pipeline.
+NOMINAL_ICI_BYTES_PER_S = 45e9
+
+
+def natural_key(path: str) -> tuple:
+    """Digit-aware sort key: ``layer_10`` sorts after ``layer_2``."""
+    return tuple(
+        (0, int(tok)) if tok.isdigit() else (1, tok)
+        for tok in re.split(r"(\d+)", path)
+        if tok
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafChunk:
+    """Shard geometry for one param leaf."""
+
+    index: int               # position in the param tree's flatten order
+    path: str                # "/"-joined tree path (bucket ordering key)
+    shape: tuple[int, ...]
+    size: int                # true element count
+    chunk: int               # per-replica elements: ceil(size / n)
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroPlan:
+    """Static shard/bucket plan for one param tree on an ``n``-way mesh.
+
+    ``leaf_chunks`` is in tree-flatten order (index-aligned with any
+    params-shaped tree); ``buckets`` groups the same leaves in REVERSE
+    layer order — the issue order of the bucketed reduce-scatter.
+    """
+
+    n: int
+    bucket_bytes: int
+    leaf_chunks: tuple[LeafChunk, ...]
+    buckets: tuple[tuple[LeafChunk, ...], ...]
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.buckets)
+
+    def shard_elements(self) -> int:
+        """Per-replica elements across all leaves (incl. padding)."""
+        return sum(lc.chunk for lc in self.leaf_chunks)
+
+
+def build_plan(params: Any, n: int, bucket_mb: float) -> ZeroPlan:
+    """Partition a param tree into per-replica shards and RS buckets.
+
+    ``params`` may hold arrays or ShapeDtypeStructs — only paths and
+    shapes are read, so the plan is identical between ``eval_shape`` and
+    the live step (it must be: the opt-state specs derive from it).
+    """
+    if n < 1:
+        raise ValueError(f"zero sharding needs n >= 1, got {n}")
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    chunks = []
+    for index, (path, leaf) in enumerate(leaves):
+        shape = tuple(int(d) for d in leaf.shape)
+        size = int(math.prod(shape)) if shape else 1
+        chunks.append(LeafChunk(
+            index=index,
+            path="/".join(
+                str(getattr(p, "key", getattr(p, "name", p))) for p in path),
+            shape=shape,
+            size=size,
+            chunk=-(-size // n),
+        ))
+    # Reverse layer order: backward produces the deepest layers' grads
+    # first, so their bucket's reduce-scatter is issued first and overlaps
+    # the rest of the backward.
+    ordered = sorted(chunks, key=lambda lc: natural_key(lc.path),
+                     reverse=True)
+    bucket_bytes = max(1, int(bucket_mb * 2**20))
+    buckets: list[tuple[LeafChunk, ...]] = []
+    cur: list[LeafChunk] = []
+    cur_bytes = 0
+    for lc in ordered:
+        cur.append(lc)
+        cur_bytes += lc.size * 4  # f32 gradient bytes
+        if cur_bytes >= bucket_bytes:
+            buckets.append(tuple(cur))
+            cur, cur_bytes = [], 0
+    if cur:
+        buckets.append(tuple(cur))
+    return ZeroPlan(n=n, bucket_bytes=bucket_bytes,
+                    leaf_chunks=tuple(chunks), buckets=tuple(buckets))
+
+
+# ------------------------------------------------------- shard reshaping --
+def _stack_rows(x: jax.Array, lc: LeafChunk, n: int) -> jax.Array:
+    """Full leaf → ``(n, chunk)`` rows (flattened, zero-padded)."""
+    flat = x.reshape(-1)
+    pad = n * lc.chunk - flat.size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(n, lc.chunk)
+
+
+def stacked_shards(tree: Any, plan: ZeroPlan) -> Any:
+    """Params-shaped tree → stacked ``(n, chunk)`` tree (global view).
+
+    This is the tree ``tx.init`` runs on: the resulting slot leaves are
+    born at the sharded-friendly stacked shape (scalars like optax step
+    counts stay scalar), so no post-hoc slot rewriting is needed.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = [_stack_rows(x, lc, plan.n)
+           for x, lc in zip(leaves, plan.leaf_chunks)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def local_shards(tree: Any, plan: ZeroPlan, row: jax.Array) -> Any:
+    """Per-replica ``(chunk,)`` views of a full (replicated) tree.
+
+    ``row`` is this replica's linear index over the shard axes
+    (collectives.linear_axis_index) — used inside shard_map to slice the
+    param shard the optax update needs for weight decay.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    for x, lc in zip(leaves, plan.leaf_chunks):
+        flat = x.reshape(-1)
+        pad = plan.n * lc.chunk - flat.size
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        out.append(lax.dynamic_slice(flat, (row * lc.chunk,), (lc.chunk,)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def squeeze_slots(opt_state: Any) -> Any:
+    """Local shard_map view ``(1, chunk)`` → ``(chunk,)`` slot leaves.
+
+    Scalar leaves (optax step counters, replicated) pass through. The
+    stacked layout guarantees every non-scalar slot leaf is exactly 2-D.
+    """
+    return jax.tree.map(
+        lambda x: x[0] if getattr(x, "ndim", 0) >= 2 else x, opt_state)
+
+
+def unsqueeze_slots(opt_state: Any) -> Any:
+    """Inverse of :func:`squeeze_slots`: ``(chunk,)`` → ``(1, chunk)``."""
+    return jax.tree.map(
+        lambda x: x[None] if getattr(x, "ndim", 0) >= 1 else x, opt_state)
+
+
+# ------------------------------------------------- slot/param tree pairing --
+def map_slots(fn, opt_state: Any, params: Any) -> Any:
+    """Map ``fn(slot_leaf, param_leaf_or_None)`` over an optax state.
+
+    Optax slot trees (mu/nu/trace/...) mirror the param tree, so a slot
+    leaf's tree path ends with its param's path; non-mirroring leaves
+    (step counters) match nothing and get ``param_leaf=None``. The
+    longest-suffix match disambiguates params whose path is a suffix of
+    another's.
+    """
+    p_by_key = {
+        jax.tree_util.keystr(path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+
+    def lookup(slot_key: str):
+        best = None
+        for pk in p_by_key:
+            if slot_key.endswith(pk) and (best is None or len(pk) > len(best)):
+                best = pk
+        return p_by_key[best] if best is not None else None
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+    out = [fn(leaf, lookup(jax.tree_util.keystr(path)))
+           for path, leaf in leaves]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def stacked_rows(opt_state: Any, params: Any) -> int | None:
+    """Detect the zero stacked slot layout; return its row count or None.
+
+    Structural test used by the checkpoint reshard path (which sees only
+    a restore template): the layout is "stacked over n" when every
+    param-mirroring slot leaf has shape ``(n, ceil(size/n))`` and at
+    least one such shape differs from its param's (otherwise the layouts
+    are indistinguishable AND interchangeable).
+    """
+    n: int | None = None
+    differs = False
+    pairs: list[tuple[Any, Any]] = []
+    map_slots(lambda s, p: pairs.append((s, p)), opt_state, params)
+    for slot, p in pairs:
+        if p is None or getattr(slot, "ndim", None) in (None, 0):
+            continue
+        if slot.ndim != 2:
+            return None
+        size = int(math.prod(p.shape)) if p.shape else 1
+        rows = int(slot.shape[0])
+        if slot.shape != (rows, -(-size // rows)):
+            return None
+        if n is None:
+            n = rows
+        elif rows != n:
+            return None
+        if tuple(slot.shape) != tuple(p.shape):
+            differs = True
+    return n if differs else None
+
+
+# ------------------------------------------------- bucketed collectives --
+def _reduce_scatter_bucket(mat: jax.Array, axes: tuple, *, wire,
+                           block_size: int, paths: tuple[str, ...]):
+    """Reduce-scatter ONE bucket: ``(n, C)`` rows → own summed ``(C,)``.
+
+    Module-level (not a closure) so the dispatch-order test can spy the
+    per-bucket issue sequence, mirroring tests/test_pipeline.py's
+    schedule-dispatch spy. ``paths`` names the bucket's leaves — unused
+    in compute, load-bearing for the spy and for debugging.
+
+    Returns ``(own_row_sum, e1)`` where ``e1`` (int8 wire only, else
+    None) is this replica's full quantization error ``c − D(Q(c))`` in
+    the ``(n, C)`` layout — the error-feedback carry.
+    """
+    del paths
+    n, c = mat.shape
+    if wire == jnp.int8:
+        rows = jax.vmap(lambda v: coll._pad_to(v, block_size))(mat)
+        q, scales = jax.vmap(
+            lambda v: quantize_blockwise(v, block_size))(rows)
+        coll._record(RS_KIND, mat, wire_dtype=jnp.int8,
+                     logical_dtype=jnp.float32,
+                     overhead_bytes=scales.size * SCALE_BYTES)
+        # Row p of every replica routes to replica p — the scatter phase.
+        qx = lax.all_to_all(q, axes, split_axis=0, concat_axis=0,
+                            tiled=False)
+        sx = lax.all_to_all(scales, axes, split_axis=0, concat_axis=0,
+                            tiled=False)
+        partials = jax.vmap(
+            lambda qq, ss: dequantize_blockwise(qq, ss, block_size))(qx, sx)
+        own = partials.sum(axis=0)[:c]
+        e1 = (rows - jax.vmap(
+            lambda qq, ss: dequantize_blockwise(qq, ss, block_size)
+        )(q, scales))[:, :c]
+        return own, e1
+    flat = mat.reshape(-1)
+    if wire is not None and wire != flat.dtype:
+        # Narrow-float wire AND narrow adds (same contract as the
+        # collectives.reduce_scatter bf16 path — document at call sites).
+        coll._record(RS_KIND, flat, wire_dtype=wire)
+        own = lax.psum_scatter(flat.astype(wire), axes,
+                               scatter_dimension=0, tiled=True)
+        return own.astype(jnp.float32), None
+    coll._record(RS_KIND, flat)
+    return lax.psum_scatter(flat, axes, scatter_dimension=0, tiled=True), None
+
+
+def _all_gather_bucket(vec: jax.Array, axes: tuple, *, wire,
+                       block_size: int, paths: tuple[str, ...]) -> jax.Array:
+    """All-gather ONE bucket's ``(C,)`` shard → ``(n, C)`` rows.
+
+    Module-level for the same spy-ability as the scatter twin. Lossy
+    wire formats are replica-IDENTICAL (every replica dequantizes the
+    same payload), so gathered updates cannot diverge the master params.
+    """
+    del paths
+    full = coll.all_gather(vec, axes, axis=0, tiled=True,
+                           wire_dtype=wire, block_size=block_size,
+                           kind=AG_KIND)
+    return full.reshape(-1, vec.shape[0])
+
+
+def _axes_list(axes) -> tuple:
+    return (axes,) if isinstance(axes, str) else tuple(axes)
+
+
+def bucketed_reduce_scatter(
+    plan: ZeroPlan,
+    grads: Any,
+    axis_names: Sequence[str] = DATA_AXES,
+    *,
+    wire_dtype: Any = None,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    residual: Any | None = None,
+) -> tuple[Any, Any | None]:
+    """Bucketed mean reduce-scatter of a gradient tree.
+
+    Issues one :func:`_reduce_scatter_bucket` per plan bucket in reverse
+    layer order — the program order that lets each bucket's collective
+    overlap the backward of the layers issued after it. Returns
+    ``(shard_grads, new_residual)``: ``shard_grads`` mirrors the param
+    tree with per-replica ``(chunk,)`` f32 leaves holding this replica's
+    slice of the MEAN gradient; ``new_residual`` (int8 wire with
+    ``residual`` given, else None) mirrors it at full param shapes.
+
+    Error feedback: ``residual`` holds this replica's last-step
+    compression error at param shape; it is added to the gradients
+    before quantization (compensation) and the new error
+    ``c − D(Q(c))`` is returned. Summed over replicas that is exactly
+    the signal the scattered mean missed — no requantization happens on
+    the scatter side, so unlike the all-reduce there is no second error
+    term.
+    """
+    axes = _axes_list(axis_names)
+    n = plan.n
+    wire = coll._canon_wire(wire_dtype)
+    use_ef = wire == jnp.int8 and residual is not None
+    g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+    r_leaves = (jax.tree_util.tree_flatten(residual)[0]
+                if use_ef else [None] * len(g_leaves))
+    if len(g_leaves) != len(plan.leaf_chunks):
+        raise ValueError(
+            f"zero plan covers {len(plan.leaf_chunks)} leaves but the "
+            f"gradient tree has {len(g_leaves)}")
+    shard_out: list[Any] = [None] * len(g_leaves)
+    res_out: list[Any] = [None] * len(g_leaves)
+    for bucket in plan.buckets:
+        mats = []
+        for lc in bucket:
+            g = g_leaves[lc.index].astype(jnp.float32)
+            if use_ef:
+                g = g + r_leaves[lc.index].astype(jnp.float32)
+            mats.append(_stack_rows(g, lc, n))
+        mat = jnp.concatenate(mats, axis=1) if len(mats) > 1 else mats[0]
+        paths = tuple(lc.path for lc in bucket)
+        own, e1 = _reduce_scatter_bucket(
+            mat, axes, wire=wire, block_size=block_size, paths=paths)
+        mean_own = own / n
+        off = 0
+        for lc in bucket:
+            shard_out[lc.index] = mean_own[off:off + lc.chunk]
+            if e1 is not None:
+                res_out[lc.index] = (
+                    e1[:, off:off + lc.chunk].reshape(-1)[: lc.size]
+                    .reshape(lc.shape))
+            off += lc.chunk
+    shards = jax.tree_util.tree_unflatten(treedef, shard_out)
+    if not use_ef:
+        return shards, None
+    return shards, jax.tree_util.tree_unflatten(treedef, res_out)
+
+
+def bucketed_all_gather(
+    plan: ZeroPlan,
+    shards: Any,
+    axis_names: Sequence[str] = DATA_AXES,
+    *,
+    wire_dtype: Any = None,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+) -> Any:
+    """Gather per-replica ``(chunk,)`` shards back to full param shapes.
+
+    The gather runs over the same buckets as the scatter (one collective
+    per bucket, program-ordered), shipping the UPDATE values — see the
+    module docstring for why updates rather than params.
+    """
+    axes = _axes_list(axis_names)
+    n = plan.n
+    wire = coll._canon_wire(wire_dtype)
+    s_leaves, treedef = jax.tree_util.tree_flatten(shards)
+    out: list[Any] = [None] * len(s_leaves)
+    for bucket in plan.buckets:
+        vec = jnp.concatenate(
+            [s_leaves[lc.index].astype(jnp.float32).reshape(-1)
+             for lc in bucket])
+        paths = tuple(lc.path for lc in bucket)
+        rows = _all_gather_bucket(vec, axes, wire=wire,
+                                  block_size=block_size, paths=paths)
+        assert rows.shape[0] == n, (rows.shape, n)
+        off = 0
+        for lc in bucket:
+            out[lc.index] = (rows[:, off:off + lc.chunk].reshape(-1)
+                             [: lc.size].reshape(lc.shape))
+            off += lc.chunk
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def shard_global_norm(shards: Any,
+                      axis_names: Sequence[str] = DATA_AXES) -> jax.Array:
+    """Global L2 norm of a tree whose leaves are disjoint per-replica
+    shards: sqrt of the psum of local squared sums (padding contributes
+    exactly zero). Replaces ``collectives.global_norm`` for the zero
+    path, where the full mean gradient never materializes."""
+    local = sum(
+        jnp.sum(jnp.square(x.astype(jnp.float32)))
+        for x in jax.tree.leaves(shards))
+    return jnp.sqrt(lax.psum(local, _axes_list(axis_names)))
+
+
+# ------------------------------------------------------------ telemetry --
+def plan_summary(plan: ZeroPlan, *, wire_dtype: Any = None,
+                 block_size: int = DEFAULT_BLOCK_SIZE) -> dict:
+    """Static per-step wire estimate for the KIND_ZERO_UPDATE event.
+
+    Analytic (from the plan, not a trace tally) so the Trainer can emit
+    it at build time; follows the CollectiveTally byte convention
+    (reduce-scatter: 1× input payload; all-gather: 1× output payload).
+    ``overlap_frac_est`` is the structural bound — every reduce-scatter
+    bucket except the LAST issued has backward compute left to hide
+    behind — and ``hidden_ms_est`` converts the hideable bytes at a
+    nominal ICI bandwidth (interpretation aid, not a measurement).
+    """
+    wire = coll._canon_wire(wire_dtype)
+    itemsize = 4 if wire is None else jnp.dtype(wire).itemsize
+    rs_bytes = ag_bytes = 0
+    for bucket in plan.buckets:
+        c = sum(lc.chunk for lc in bucket)
+        payload = plan.n * c
+        if wire == jnp.int8:
+            padded = -(-c // block_size) * block_size
+            scales = plan.n * (padded // block_size) * SCALE_BYTES
+            rs_bytes += plan.n * padded + scales
+            ag_bytes += plan.n * padded + scales
+        else:
+            rs_bytes += payload * itemsize
+            ag_bytes += payload * itemsize
+    b = plan.num_buckets
+    overlap = (b - 1) / b if b else 0.0
+    return {
+        "buckets": b,
+        "shards": plan.n,
+        "shard_elements": plan.shard_elements(),
+        "bucket_mb": round(plan.bucket_bytes / 2**20, 3),
+        "wire": str(jnp.dtype(wire)) if wire is not None else "float32",
+        "rs_wire_bytes": int(rs_bytes),
+        "ag_wire_bytes": int(ag_bytes),
+        "overlap_frac_est": round(overlap, 4),
+        "hidden_ms_est": round(
+            rs_bytes * overlap / NOMINAL_ICI_BYTES_PER_S * 1e3, 3),
+    }

@@ -158,6 +158,19 @@ class Trainer:
         self._sample = sample
         self.state = self.builder.init_state(self.config.train.seed, sample)
         self.train_step = self.builder.make_train_step(sample)
+        if getattr(self.builder, "_zero", False):
+            # One record of the static shard/bucket plan so byte and
+            # step-time rollups read against the overlap structure that
+            # produced them (parallel/zero.plan_summary).
+            from distributed_tensorflow_framework_tpu.parallel import zero
+            self.writer.telemetry.emit(
+                telemetry.KIND_ZERO_UPDATE,
+                **zero.plan_summary(
+                    self.builder._zero_plan,
+                    wire_dtype=self.config.parallel.collective_dtype or None,
+                    block_size=self.config.parallel.collective_block_size,
+                ),
+            )
         # Optimized-HLO capture for trace attribution (ProfileHook dumps
         # it next to the .xplane.pb). Only when profiling is armed: the
         # explicit lower+compile does not populate the jit call cache, so

@@ -37,26 +37,41 @@ def _decay_mask(params: Any) -> Any:
 def make_optimizer(
     config: OptimizerConfig, total_steps: int,
     schedule_wrapper=None,
+    decay_mask_ref: Any = None,
 ) -> tuple[optax.GradientTransformation, optax.Schedule]:
     """Build the optax chain + schedule. ``schedule_wrapper`` (schedule →
     schedule) post-processes the schedule before the chain captures it —
     the hook the post-rollback LR re-warmup (train/schedules.with_rewarmup)
     uses to rebuild the optimizer without changing the opt-state pytree
-    (optax schedule state is a bare step counter, schedule-agnostic)."""
+    (optax schedule state is a bare step counter, schedule-agnostic).
+
+    ``decay_mask_ref``: the tree whose paths/ranks decide the weight-decay
+    mask, when the tree ``tx`` will RUN on is not that tree. The ZeRO
+    shard_map path (parallel/zero.py) updates flattened 1-D per-replica
+    shards — rank and path both lost — so StepBuilder passes the real
+    param tree here and the PRECOMPUTED boolean mask rides along. The
+    mask's values never change the opt-state structure (optax masked
+    wrappers carry no per-leaf state), so swapping mask callables for a
+    mask tree is checkpoint-compatible."""
     sched = make_schedule(config, total_steps)
     if schedule_wrapper is not None:
         sched = schedule_wrapper(sched)
+    # Callable by default (evaluated lazily on the update tree); a
+    # precomputed bool pytree when a ref tree is given — the ref and the
+    # update tree share a treedef, so the leaf pairing is positional.
+    mask = (_decay_mask if decay_mask_ref is None
+            else _decay_mask(decay_mask_ref))
     chain = []
     if config.grad_clip_norm > 0:
         chain.append(optax.clip_by_global_norm(config.grad_clip_norm))
     name = config.name.lower()
     if name in ("sgd", "sgd_momentum", "momentum"):
         if config.weight_decay > 0:
-            chain.append(optax.add_decayed_weights(config.weight_decay, mask=_decay_mask))
+            chain.append(optax.add_decayed_weights(config.weight_decay, mask=mask))
         chain.append(optax.sgd(sched, momentum=config.momentum, nesterov=config.nesterov))
     elif name == "adam":
         if config.weight_decay > 0:
-            chain.append(optax.add_decayed_weights(config.weight_decay, mask=_decay_mask))
+            chain.append(optax.add_decayed_weights(config.weight_decay, mask=mask))
         chain.append(optax.adam(sched, b1=config.beta1, b2=config.beta2, eps=config.eps))
     elif name == "adamw":
         chain.append(
@@ -66,7 +81,7 @@ def make_optimizer(
                 b2=config.beta2,
                 eps=config.eps,
                 weight_decay=config.weight_decay,
-                mask=_decay_mask,
+                mask=mask,
             )
         )
     elif name == "rmsprop":
@@ -74,7 +89,7 @@ def make_optimizer(
         # RMSProp-based): decay/momentum/eps from config — canonical
         # Inception-v3 values are decay=0.9, momentum=0.9, eps=1.0.
         if config.weight_decay > 0:
-            chain.append(optax.add_decayed_weights(config.weight_decay, mask=_decay_mask))
+            chain.append(optax.add_decayed_weights(config.weight_decay, mask=mask))
         # initial_scale=1.0: TF1's RMSPropOptimizer initializes the
         # mean-square slot to ones (optax defaults to zero) — without it
         # early updates are systematically larger than the reference's.
@@ -92,7 +107,7 @@ def make_optimizer(
             optax.lars(
                 sched,
                 weight_decay=config.weight_decay,
-                weight_decay_mask=_decay_mask,
+                weight_decay_mask=mask,
                 momentum=config.momentum,
             )
         )

@@ -40,12 +40,17 @@ class TrainState:
     @classmethod
     def create(cls, *, params, batch_stats, tx: optax.GradientTransformation,
                rng: jax.Array, ema: bool = False,
-               collective_residual: Any = None) -> "TrainState":
+               collective_residual: Any = None,
+               opt_params: Any = None) -> "TrainState":
+        """``opt_params``: the tree ``tx.init`` runs on, when it differs
+        from ``params`` — the ZeRO shard_map path initializes slots at
+        the stacked ``(n, chunk)`` layout (parallel/zero.stacked_shards)
+        while the master params stay replicated at model shapes."""
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             batch_stats=batch_stats,
-            opt_state=tx.init(params),
+            opt_state=tx.init(params if opt_params is None else opt_params),
             rng=rng,
             ema_params=jax.tree.map(jnp.copy, params) if ema else {},
             collective_residual=(

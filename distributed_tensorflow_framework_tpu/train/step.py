@@ -32,6 +32,7 @@ from distributed_tensorflow_framework_tpu.core.mesh import batch_spec
 from distributed_tensorflow_framework_tpu.models import get_model
 from distributed_tensorflow_framework_tpu.parallel import sharding as shd
 from distributed_tensorflow_framework_tpu.parallel import collectives as coll
+from distributed_tensorflow_framework_tpu.parallel import zero
 from distributed_tensorflow_framework_tpu.train import losses
 from distributed_tensorflow_framework_tpu.train.optimizers import make_optimizer
 from distributed_tensorflow_framework_tpu.train.state import TrainState
@@ -40,14 +41,11 @@ DATA_AXES = ("data", "fsdp")
 
 
 def _fsdp_dim(shape, fsdp_n: int) -> int:
-    """Dim index the explicit-fsdp path shards over: the largest
-    fsdp-divisible dim (mirrors parallel/sharding._apply_fsdp's rule), or
-    -1 for replicated leaves (no divisible dim, scalars)."""
-    best, best_size = -1, 0
-    for i, d in enumerate(shape):
-        if d % fsdp_n == 0 and d > best_size:
-            best, best_size = i, d
-    return best
+    """Dim index the explicit-fsdp path shards over, or -1 for replicated
+    leaves (no divisible dim, scalars). Delegates to the ONE tie-break
+    rule in parallel/sharding.pick_fsdp_dim so the explicit layout can
+    never diverge from the jit-spec one."""
+    return shd.pick_fsdp_dim(tuple(shape), fsdp_n)
 
 
 def task_for_model(name: str) -> str:
@@ -99,17 +97,59 @@ class StepBuilder:
                 f"got {config.train.grad_allreduce_accum!r}"
             )
         # Error-feedback residual rides the TrainState only for the int8
-        # block-scaled all-reduce (parallel/collectives.py).
+        # block-scaled collectives (parallel/collectives.py, parallel/zero.py).
         self._use_residual = (self.shard_map_mode
                               and self._collective_dtype == "int8"
                               and config.parallel.error_feedback)
+        # ZeRO weight-update sharding (parallel/zero.py). "jit" is the
+        # passive spec variant (the deprecated optimizer.shard_opt_state,
+        # honored here for configs built without load_config's shim);
+        # "shard_map" is the explicit bucketed reduce-scatter path.
+        zs = config.optimizer.zero_sharding
+        if config.optimizer.shard_opt_state and zs == "off":
+            zs = "jit"
+        self._zero = zs == "shard_map"
+        self._zero_n = (mesh.shape.get("data", 1)
+                        * mesh.shape.get("fsdp", 1))
+        self._zero_plan = None
+        if self._zero:
+            if not self.shard_map_mode:
+                raise ValueError(
+                    "optimizer.zero_sharding='shard_map' is the explicit "
+                    "bucketed reduce-scatter path and needs "
+                    "train.spmd_mode='shard_map'; under spmd_mode='jit' "
+                    "use optimizer.zero_sharding='jit' (XLA owns the "
+                    "update-shard/all-gather pattern there)"
+                )
+            if self._zero_n <= 1:
+                raise ValueError(
+                    "optimizer.zero_sharding='shard_map' shards the weight "
+                    "update over the data×fsdp replicas — this mesh has "
+                    f"{self._zero_n}, so it would be a silent no-op"
+                )
+            if config.optimizer.name == "lars":
+                raise ValueError(
+                    "optimizer.name='lars' needs full per-layer "
+                    "param/update norms, but zero_sharding='shard_map' "
+                    "updates flattened parameter SHARDS — use "
+                    "zero_sharding='jit' for lars"
+                )
+            if config.optimizer.grad_clip_norm > 0:
+                raise ValueError(
+                    "optimizer.grad_clip_norm>0 computes the global grad "
+                    "norm inside the optimizer, which under "
+                    "zero_sharding='shard_map' sees only gradient SHARDS "
+                    "— use zero_sharding='jit' for clipped training"
+                )
         # shard_map + mesh.fsdp>1 runs EXPLICIT fsdp: params/opt state/EMA
         # sharded over fsdp, a hand-placed (optionally quantized)
         # all_gather around the fwd/bwd, grads sliced back to shards for
         # the update. With fsdp==1 the path is pure replicated DP as
-        # before.
+        # before. Under ZeRO the fsdp axis instead folds into the shard
+        # count (params stay replicated — no forward-pass gathers).
         self._explicit_fsdp = (self.shard_map_mode
-                               and mesh.shape.get("fsdp", 1) > 1)
+                               and mesh.shape.get("fsdp", 1) > 1
+                               and not self._zero)
         if self._explicit_fsdp:
             if config.optimizer.name == "lars":
                 raise ValueError(
@@ -146,16 +186,20 @@ class StepBuilder:
                 "spmd_mode='shard_map' is the pure-DP reference-parity path; "
                 "expert parallelism (mesh.expert>1) requires spmd_mode='jit'"
             )
-        if config.optimizer.shard_opt_state:
+        self._zero_jit = zs == "jit"
+        if self._zero_jit:
             if self.shard_map_mode:
                 raise ValueError(
-                    "optimizer.shard_opt_state needs spmd_mode='jit' (XLA "
-                    "owns the update-shard/all-gather pattern; the explicit "
-                    "shard_map path is pure replicated DP)"
+                    "optimizer.zero_sharding='jit' (and the deprecated "
+                    "optimizer.shard_opt_state) needs spmd_mode='jit' — "
+                    "XLA owns the update-shard/all-gather pattern there; "
+                    "the explicit path is optimizer.zero_sharding="
+                    "'shard_map'"
                 )
             if mesh.shape.get("fsdp", 1) <= 1:
                 raise ValueError(
-                    "optimizer.shard_opt_state shards over the fsdp mesh "
+                    "optimizer.zero_sharding='jit' (and the deprecated "
+                    "optimizer.shard_opt_state) shards over the fsdp mesh "
                     "axis — set mesh.fsdp > 1 (it would be a silent no-op "
                     "on this mesh)"
                 )
@@ -218,6 +262,11 @@ class StepBuilder:
         )
         self._state_specs = None
         self._fsdp_dims = None  # params-shaped tree of shard dims (fsdp)
+        self._schedule_wrapper = None
+        # Set by state_specs once param shapes are known (ZeRO only): the
+        # ref tree the weight-decay mask is computed from, since the tx
+        # there runs on flattened shards with path/rank erased.
+        self._decay_mask_ref = None
 
     def set_schedule_wrapper(self, wrapper) -> None:
         """Rebuild tx/schedule with ``wrapper`` applied (the post-rollback
@@ -226,12 +275,23 @@ class StepBuilder:
         only a schedule-agnostic step counter — but the caller must
         rebuild its compiled train step afterwards (the old jit captured
         the old chain)."""
+        self._schedule_wrapper = wrapper
         self.tx, self.schedule = make_optimizer(
             self.config.optimizer, self.config.train.total_steps,
             schedule_wrapper=wrapper,
+            decay_mask_ref=self._decay_mask_ref,
         )
 
     # ------------------------------------------------------------- init --
+    def _ensure_zero_plan(self, params: Any) -> "zero.ZeroPlan":
+        """Build (once) the shard/bucket plan. Only shapes and tree paths
+        are read, so tracers and ShapeDtypeStructs both work — the plan
+        computed inside ``eval_shape`` is identical to the live one."""
+        if self._zero_plan is None:
+            self._zero_plan = zero.build_plan(
+                params, self._zero_n, self.config.optimizer.zero_bucket_mb)
+        return self._zero_plan
+
     def _create_state(self, seed_arr: jax.Array, batch: Any) -> TrainState:
         root = jax.random.key(seed_arr[0])
         init_rng = prng.for_role(root, prng.ROLE_INIT)
@@ -252,16 +312,36 @@ class StepBuilder:
             residual = jax.tree.map(
                 lambda p: jnp.zeros((n_dp,) + p.shape, jnp.float32), params
             )
+        opt_params = None
+        if self._zero:
+            # Slots are born at the stacked (n, chunk) layout — row i is
+            # replica i's shard of the flattened leaf (parallel/zero.py).
+            plan = self._ensure_zero_plan(params)
+            opt_params = zero.stacked_shards(params, plan)
         return TrainState.create(
             params=params, batch_stats=batch_stats, tx=self.tx,
             rng=dropout_root, ema=self.config.optimizer.ema_decay > 0,
-            collective_residual=residual,
+            collective_residual=residual, opt_params=opt_params,
         )
 
     def state_specs(self, sample_batch: Any) -> Any:
         if self._state_specs is None:
             seed = jnp.zeros((1,), jnp.uint32)
             shapes = jax.eval_shape(self._create_state, seed, sample_batch)
+            if self._zero:
+                # Rebuild tx with the weight-decay mask PRECOMPUTED from
+                # the real param tree: the shard-domain update sees
+                # flattened 1-D leaves, so the rank/path-based mask
+                # callable would misclassify every leaf. Mask values do
+                # not change opt-state structure or init values (masked
+                # optax wrappers are stateless), so the eval_shape above
+                # — taken with the callable-mask tx — stays valid.
+                self._decay_mask_ref = shapes.params
+                self.tx, self.schedule = make_optimizer(
+                    self.config.optimizer, self.config.train.total_steps,
+                    schedule_wrapper=self._schedule_wrapper,
+                    decay_mask_ref=self._decay_mask_ref,
+                )
             if self.shard_map_mode:
                 # Pure DP (reference semantics) replicates everything.
                 # Explicit fsdp (mesh.fsdp>1) shards params / optimizer
@@ -295,11 +375,19 @@ class StepBuilder:
                         ema_params=jax.tree.map(leaf_spec,
                                                 shapes.ema_params),
                     )
+                if self._zero:
+                    # Stacked (n, chunk) slots shard their row dim over
+                    # the combined data axes — per-device slot HBM ~1/n.
+                    # Scalars (optax step counters) stay replicated.
+                    specs = specs.replace(opt_state=jax.tree.map(
+                        lambda s: (P(DATA_AXES)
+                                   if getattr(s, "ndim", 0) >= 2 else P()),
+                        shapes.opt_state))
                 if self._use_residual:
                     specs = specs.replace(collective_residual=jax.tree.map(
                         lambda _: P(DATA_AXES), shapes.collective_residual))
                 self._state_specs = specs
-            elif self.config.optimizer.shard_opt_state:
+            elif self._zero_jit:
                 # ZeRO-1 (cross-replica weight-update sharding): params /
                 # BN stats / EMA replicated like pure DP, optimizer slots
                 # sharded over fsdp. XLA partitions the weight update and
@@ -480,6 +568,16 @@ class StepBuilder:
             new_params = optax.apply_updates(state.params, updates)
         metrics = dict(metrics)
         metrics["grad_norm"] = coll.global_norm(grads)
+        return self._finalize_state(state, new_params, new_opt_state,
+                                    metrics, new_model_state)
+
+    def _finalize_state(self, state, new_params, new_opt_state, metrics,
+                        new_model_state):
+        """Shared post-update tail: lr/bubble metrics, EMA, state.replace.
+        Split from _apply_updates so the ZeRO path — whose update runs on
+        shards and produces new_params/new_opt_state its own way — reuses
+        the exact same trailing semantics."""
+        metrics = dict(metrics)
         metrics["learning_rate"] = self.schedule(state.step)
         stages = self.config.model.pipeline_stages
         if stages > 1:
@@ -529,7 +627,61 @@ class StepBuilder:
             return self._apply_updates(state, grads, metrics,
                                        new_model_state)
 
+    def _zero_train_step_replica(self, state: TrainState, batch: Any):
+        """Per-replica ZeRO step (optimizer.zero_sharding='shard_map').
+
+        Replaces the monolithic all-reduce with: bucketed mean
+        reduce-scatter of the grads (reverse layer order — each bucket's
+        collective overlaps the backward of the layers issued after it,
+        parallel/zero.py) → per-replica optax update on this replica's
+        1/n of the flattened weights → bucketed all-gather of the UPDATE
+        values → every replica applies the identical update to its full
+        f32 master params. Params/EMA/BN stay replicated (pure-DP
+        forward); only the slots and the update are sharded.
+        """
+        wire = self._collective_dtype or None
+        block = self._collective_block
+        plan = self._ensure_zero_plan(state.params)
+        grads, metrics, new_model_state = self._loss_and_updates(
+            state, batch)
+        residual = None
+        if self._use_residual:
+            # Local (1, *shape) row of the global (n, *shape) residual —
+            # this replica's carried int8 quantization error.
+            residual = jax.tree.map(
+                lambda r: r[0], state.collective_residual)
+        shard_grads, new_res = zero.bucketed_reduce_scatter(
+            plan, grads, DATA_AXES, wire_dtype=wire, block_size=block,
+            residual=residual)
+        row = coll.linear_axis_index(DATA_AXES)
+        param_shards = zero.local_shards(state.params, plan, row)
+        opt_local = zero.squeeze_slots(state.opt_state)
+        with jax.named_scope("optimizer_update"):
+            updates, new_opt_local = self.tx.update(
+                shard_grads, opt_local, param_shards)
+        full_updates = zero.bucketed_all_gather(
+            plan, updates, DATA_AXES, wire_dtype=wire, block_size=block)
+        new_params = optax.apply_updates(state.params, full_updates)
+        metrics = coll.pmean(metrics, DATA_AXES)
+        if self._has_bn(state):
+            new_model_state = dict(new_model_state)
+            new_model_state["batch_stats"] = coll.pmean(
+                new_model_state["batch_stats"], DATA_AXES)
+        metrics = dict(metrics)
+        # Norm of the full MEAN gradient, from its disjoint shards — the
+        # same quantity the unsharded path logs.
+        metrics["grad_norm"] = zero.shard_global_norm(shard_grads, DATA_AXES)
+        new_state, metrics = self._finalize_state(
+            state, new_params, zero.unsqueeze_slots(new_opt_local),
+            metrics, new_model_state)
+        if new_res is not None:
+            new_state = new_state.replace(collective_residual=jax.tree.map(
+                lambda r: r[None], new_res))
+        return new_state, metrics
+
     def _train_step_replica(self, state: TrainState, batch: Any):
+        if self._zero:
+            return self._zero_train_step_replica(state, batch)
         wire = self._collective_dtype
         block = self._collective_block
         if self._explicit_fsdp:

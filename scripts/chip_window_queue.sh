@@ -136,4 +136,17 @@ run serve-export python -m distributed_tensorflow_framework_tpu.cli.export \
 serve_ab batched 8
 serve_ab unbatched 1
 
+# 11. ZeRO weight-update sharding A/B (docs/PERFORMANCE.md): each dial
+#     runs its OWN replicated-optimizer shard_map baseline on the same
+#     ladder, so the JSON line is self-contained (per-chip opt-state
+#     byte ratio read off the placed shardings + throughput delta).
+#     CPU-verified: f32 update parity vs the monolithic all-reduce is
+#     ~1e-8 and slots land at 1/(data*fsdp) per device — the chip
+#     question is how much step time the bucketed reduce-scatter /
+#     all-gather pair costs once XLA overlaps the reverse-order buckets
+#     with the backward (plan_summary estimates (B-1)/B of RS hidden).
+#     Same exit-3 probe-hang rule as §9: re-land, don't revert.
+run zero-off       env BENCH_ZERO=off python bench.py
+run zero-shard_map env BENCH_ZERO=shard_map python bench.py
+
 echo "=== chip queue done $(date -u +%FT%TZ) ==="

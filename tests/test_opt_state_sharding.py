@@ -95,10 +95,14 @@ def test_zero1_trajectory_matches_pure_dp(devices):
     # Different mesh shapes reduce gradients in different orders; Adam's
     # eps-division amplifies that float noise slightly, so the tolerance
     # is loose enough for reduction-order drift but far below any layout
-    # bug (observed worst case ~1e-5 relative after 5 steps).
+    # bug. atol covers the square-kernel case: pick_fsdp_dim's
+    # deterministic trailing-dim tie-break shards a different dim than
+    # the old scan-order pick, shifting reduction order (observed worst
+    # case one element in 48k at 5.3e-5 absolute after 5 steps; a layout
+    # bug shows up orders of magnitude above that on most elements).
     for a, b in zip(jax.tree.leaves(jax.device_get(s_dp.params)),
                     jax.tree.leaves(jax.device_get(s_z1.params))):
-        np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5)
+        np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-4)
 
 
 def test_shard_opt_state_rejected_without_fsdp_axis(devices):

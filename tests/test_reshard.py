@@ -410,6 +410,110 @@ def test_reshard_8_to_4_folds_residual_sum_preserving(devices, tmp_path):
     _assert_trees_equal(state.params, restored.params)
 
 
+# -- ZeRO stacked opt state across a grid change --------------------------
+def _lenet_state_zero(devices, n, *, seed=0, steps=1):
+    # ISSUE 9: zero_sharding='shard_map' stacks every optimizer slot as
+    # (n, ceil(S/n)) rows over the data×fsdp replicas. A checkpoint
+    # written at one grid must refold host-side to the new replica count
+    # on a resharded restore (ckpt/reshard.refold_zero_opt_state).
+    cfg = load_config(base={
+        "name": "reshard-lenet-zero",
+        "mesh": {"data": n},
+        "model": {"name": "lenet5", "num_classes": 10, "dtype": "float32"},
+        "data": {"name": "synthetic_images", "global_batch_size": 64,
+                 "image_size": 28, "channels": 1},
+        "optimizer": {"name": "adam", "learning_rate": 0.01,
+                      "zero_sharding": "shard_map"},
+        "train": {"total_steps": 4, "spmd_mode": "shard_map"},
+    })
+    mesh = create_mesh(cfg.mesh, devices=devices[:n])
+    builder = StepBuilder(cfg, mesh)
+    batch = to_global(next(get_dataset(cfg.data)), mesh)
+    state = builder.init_state(seed, batch)
+    if steps:
+        step_fn = builder.make_train_step(batch)
+        for _ in range(steps):
+            state, _ = step_fn(state, batch)
+    return cfg, mesh, builder, batch, state
+
+
+def test_zero_opt_state_reshard_8_to_4(devices, tmp_path):
+    from distributed_tensorflow_framework_tpu.parallel import zero
+
+    cfg, mesh, _, _, state = _lenet_state_zero(devices, 8)
+    _save(cfg, mesh, state, str(tmp_path / "ck"))
+    cfg_b, mesh_b, builder_b, batch_b, _ = _lenet_state_zero(
+        devices, 4, seed=9, steps=0)
+    cfg_b.checkpoint.directory = str(tmp_path / "ck")
+    cfg_b.checkpoint.async_save = False
+    cfg_b.checkpoint.allow_reshard = True
+    events = str(tmp_path / "events.jsonl")
+    writer = telemetry.TelemetryWriter(events)
+    mgr = CheckpointManager(
+        cfg_b.checkpoint, telemetry_writer=writer, mesh=mesh_b)
+    restored = mgr.restore(builder_b.init_state(0, batch_b))
+    mgr.close()
+    writer.close()
+    assert restored is not None
+    _assert_trees_equal(state.params, restored.params)
+    kinds = [ev["kind"] for ev in telemetry.read_events(events)]
+    assert telemetry.KIND_CKPT_RESHARDED in kinds
+
+    # Slots refolded to the NEW grid: (4, ceil(S/4)), data-sharded, and
+    # element-for-element equal to the saved values on the true S prefix
+    # (padding is inert by construction — zero grads meet zero params).
+    old_host = jax.device_get(state)
+    new_host = jax.device_get(restored)
+    assert zero.stacked_rows(new_host.opt_state, new_host.params) == 4
+    # map_slots pairs each slot with its param (None for step counters);
+    # old and new opt states share a treedef, so the flatten orders zip.
+    new_pairs = []
+    zero.map_slots(lambda s, p: new_pairs.append((s, p)),
+                   new_host.opt_state, new_host.params)
+    old_leaves = [leaf for _, leaf in
+                  jax.tree_util.tree_flatten_with_path(old_host.opt_state)[0]]
+    assert len(old_leaves) == len(new_pairs)
+    refolded = 0
+    for (new_slot, param), old_slot in zip(new_pairs, old_leaves):
+        if param is None or getattr(old_slot, "ndim", 0) != 2:
+            np.testing.assert_array_equal(
+                np.asarray(new_slot), np.asarray(old_slot))
+            continue
+        size = int(np.prod(param.shape)) if param.shape else 1
+        assert new_slot.shape == (4, -(-size // 4)), new_slot.shape
+        np.testing.assert_array_equal(
+            np.asarray(new_slot).reshape(-1)[:size],
+            np.asarray(old_slot).reshape(-1)[:size])
+        refolded += 1
+    assert refolded >= 10, "adam mu+nu slots should all be refolded"
+
+
+def test_zero_toggle_across_resume_is_rejected(devices, tmp_path):
+    # Saved ZeRO-stacked, restored replicated (same adam optimizer, same
+    # mesh): the slot trees are shape-incompatible and the failure must
+    # name the knob instead of surfacing an orbax tree error.
+    cfg, mesh, _, _, state = _lenet_state_zero(devices, 8)
+    _save(cfg, mesh, state, str(tmp_path / "ck"))
+    cfg_b = load_config(base={
+        "name": "reshard-lenet-zero-off",
+        "mesh": {"data": 8},
+        "model": {"name": "lenet5", "num_classes": 10, "dtype": "float32"},
+        "data": {"name": "synthetic_images", "global_batch_size": 64,
+                 "image_size": 28, "channels": 1},
+        "optimizer": {"name": "adam", "learning_rate": 0.01},
+        "train": {"total_steps": 4, "spmd_mode": "shard_map"},
+    })
+    mesh_b = create_mesh(cfg_b.mesh)
+    builder_b = StepBuilder(cfg_b, mesh_b)
+    batch_b = to_global(next(get_dataset(cfg_b.data)), mesh_b)
+    cfg_b.checkpoint.directory = str(tmp_path / "ck")
+    cfg_b.checkpoint.async_save = False
+    mgr = CheckpointManager(cfg_b.checkpoint)
+    with pytest.raises(ValueError, match="zero_sharding"):
+        mgr.restore(builder_b.init_state(0, batch_b))
+    mgr.close()
+
+
 # -- cross-mesh parity matrix on genuinely sharded states -----------------
 @pytest.mark.slow
 class TestCrossMeshParityMatrix:

@@ -476,6 +476,9 @@ def test_summarize_rolls_up_every_kind(tmp_path):
            time_to_first_step_s=2.5, restored_step=4)
     w.emit(telemetry.KIND_PIPELINE, schedule="gpipe", stages=2,
            microbatches=4, bubble_frac=0.2)
+    w.emit(telemetry.KIND_ZERO_UPDATE, shards=8, buckets=3, bucket_mb=4.0,
+           wire="float32", rs_wire_bytes=1024, ag_wire_bytes=1024,
+           overlap_frac_est=0.6667, hidden_ms_est=0.01)
     w.emit(telemetry.KIND_ANOMALY, step=5,
            health={"anomaly": "loss_spike", "metric": "loss"})
     w.emit(telemetry.KIND_ROLLBACK, step=5,
@@ -522,6 +525,7 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert s["health_events"] == {"moe_collapse": 1}
     assert s["serve"]["requests"] == 1 and s["serve"]["batches"] == 1
     assert s["serve"]["queue_depth_max"] == 2
+    assert s["zero"]["shards"] == 8 and s["zero"]["buckets"] == 3
     text = telemetry.format_run_summary(s)
     assert "run: config_name=lenet" in text
     assert "evals: 1 (last at step 2)" in text
@@ -531,3 +535,4 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert "health events: moe_collapse=1" in text
     assert "serving: 1 requests (2 rows) in 1 batches" in text
     assert "bucket recompiles: 1 (rows2)" in text
+    assert "zero update sharding: 8 shards, 3 buckets" in text
