@@ -45,17 +45,33 @@ import sys
 
 TARGET_PER_CHIP = 10_000 / 64  # BASELINE.json north star on v5e-64
 
-# device_kind → (peak bf16 FLOP/s, HBM bytes/s). Public spec-sheet numbers.
-CHIP_PEAKS: dict[str, tuple[float, float]] = {
-    "TPU v2": (45e12, 700e9),
-    "TPU v3": (123e12, 900e9),
-    "TPU v4": (275e12, 1228e9),
-    "TPU v5 lite": (197e12, 819e9),   # v5e
-    "TPU v5e": (197e12, 819e9),
-    "TPU v5p": (459e12, 2765e9),
-    "TPU v6 lite": (918e12, 1640e9),  # v6e / Trillium
-    "TPU v6e": (918e12, 1640e9),
+GIB = 1024 ** 3
+
+# device_kind → (peak bf16 FLOP/s, HBM bytes/s, HBM capacity bytes/chip).
+# Public spec-sheet numbers.
+CHIP_PEAKS: dict[str, tuple[float, float, float]] = {
+    "TPU v2": (45e12, 700e9, 8 * GIB),
+    "TPU v3": (123e12, 900e9, 16 * GIB),
+    "TPU v4": (275e12, 1228e9, 32 * GIB),
+    "TPU v5 lite": (197e12, 819e9, 16 * GIB),   # v5e
+    "TPU v5e": (197e12, 819e9, 16 * GIB),
+    "TPU v5p": (459e12, 2765e9, 95 * GIB),
+    "TPU v6 lite": (918e12, 1640e9, 32 * GIB),  # v6e / Trillium
+    "TPU v6e": (918e12, 1640e9, 32 * GIB),
 }
+
+
+def chip_hbm_capacity(chip: str) -> float | None:
+    """Per-chip HBM capacity, or host RAM when the chip isn't in the
+    table (the CPU backend: headroom against physical memory is still a
+    meaningful ceiling for the compiled step's working set)."""
+    peak = CHIP_PEAKS.get(chip)
+    if peak:
+        return peak[2]
+    try:
+        return float(os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE"))
+    except (ValueError, OSError, AttributeError):
+        return None
 
 
 def _compile_and_time(builder, state, batch, steps: int, warmup: int) -> dict:
@@ -75,9 +91,18 @@ def _compile_and_time(builder, state, batch, steps: int, warmup: int) -> dict:
     from distributed_tensorflow_framework_tpu.core.profiling import trace
     from distributed_tensorflow_framework_tpu.parallel import collectives as coll
 
+    from distributed_tensorflow_framework_tpu.core import memstats
+
+    # Drill affordability knobs: the observability drill runs the full
+    # bench binary on CPU and only needs the JSON shape, not a stable
+    # rate — let it shrink the timed loop without forking the workloads.
+    steps = int(os.environ.get("BENCH_STEPS") or steps)
+    warmup = int(os.environ.get("BENCH_WARMUP") or warmup)
+
     step = builder.make_train_step(batch)
     flops_per_step = bytes_per_step = None
     collectives = None
+    memory_analysis = None
     trace_dir = os.environ.get("BENCH_TRACE")
     try:
         # Collective byte counters record at JAX *trace* time, and
@@ -103,6 +128,7 @@ def _compile_and_time(builder, state, batch, steps: int, warmup: int) -> dict:
         ca = ca[0] if isinstance(ca, (list, tuple)) else ca
         flops_per_step = float(ca.get("flops", 0.0)) or None
         bytes_per_step = float(ca.get("bytes accessed", 0.0)) or None
+        memory_analysis = memstats.compiled_memory_analysis(compiled)
         step = compiled
     except Exception as e:  # cost model unavailable on some backends
         print(f"bench: cost_analysis unavailable ({type(e).__name__})",
@@ -122,11 +148,18 @@ def _compile_and_time(builder, state, batch, steps: int, warmup: int) -> dict:
             state, metrics = step(state, batch)
         sync(state)
         dt = time.perf_counter() - t0
+    # HBM occupancy AFTER the timed loop: arrays are live, so the device
+    # peak (or host-RSS fallback on CPU) reflects the workload's real
+    # footprint at its largest (core/memstats.py).
+    memory = memstats.device_memory_snapshot()
+    if memory_analysis:
+        memory["analysis"] = memory_analysis
     return {
         "sec_per_step": dt / steps,
         "flops_per_step": flops_per_step,
         "bytes_per_step": bytes_per_step,
         "collectives": collectives,
+        "memory": memory,
     }
 
 
@@ -464,7 +497,7 @@ def _annotate_roofline(out: dict, result: dict, chip: str, n_chips: int,
         intensity = result["flops_per_step"] / result["bytes_per_step"]
         out["arith_intensity"] = round(intensity, 1)
     if peak:
-        peak_flops, hbm_bw = peak
+        peak_flops, hbm_bw = peak[:2]
         out["mfu"] = round(achieved / peak_flops, 4)
         if intensity is not None:
             ridge = peak_flops / hbm_bw
@@ -474,6 +507,37 @@ def _annotate_roofline(out: dict, result: dict, chip: str, n_chips: int,
                 result["bytes_per_step"] / result["sec_per_step"]
                 / n_chips / hbm_bw, 4,
             )
+
+
+def _annotate_memory(out: dict, result: dict, chip: str,
+                     n_chips: int) -> None:
+    """Peak HBM per chip + headroom against the chip's capacity.
+
+    Peak preference order: live device counters (memory_stats peak) →
+    the compiled step's static analysis (args+temps+output — works on
+    CPU where memory_stats returns nothing) → host RSS. Headroom is
+    against CHIP_PEAKS capacity, or host RAM for unknown chips, so the
+    number answers "how much bigger a batch/model fits" on any backend.
+    """
+    mem = result.get("memory") or {}
+    analysis = mem.get("analysis") or {}
+    peak = mem.get("peak_bytes_in_use") or 0
+    source = mem.get("source_kind", "unknown")
+    if source != "device_memory_stats":
+        est = analysis.get("peak_bytes_est") or 0
+        if est:
+            # Static analysis is whole-program; attribute evenly per chip.
+            peak, source = est / max(1, n_chips), "memory_analysis"
+        elif peak:
+            source = "host_rss"
+    if not peak:
+        return
+    out["hbm_peak_bytes_per_chip"] = int(peak)
+    out["hbm_peak_source"] = source
+    cap = chip_hbm_capacity(chip)
+    if cap:
+        out["hbm_capacity_bytes_per_chip"] = int(cap)
+        out["hbm_headroom_frac"] = round(1.0 - peak / cap, 4)
 
 
 def _run_ladder(bench_fn, sizes, failure_metric: str, failure_unit: str,
@@ -725,6 +789,24 @@ def _emit_bench_result(writer, workload: str, out: dict, result: dict) -> None:
     writer.emit(telemetry.KIND_BENCH, metrics=metrics, roofline=roofline,
                 collectives=result.get("collectives"), workload=workload,
                 **extra)
+    mem = result.get("memory")
+    if mem:
+        # The raw snapshot rides as its own KIND_MEMORY event so the
+        # bench trace joins the trainer's memory telemetry stream
+        # (core/memstats.py, docs/OBSERVABILITY.md) by kind, not by
+        # spelunking bench extras.
+        mem_metrics = {k: mem[k] for k in
+                       ("bytes_in_use", "peak_bytes_in_use", "device_count")
+                       if mem.get(k) is not None}
+        mem_extra = {k: out[k] for k in
+                     ("hbm_peak_bytes_per_chip", "hbm_peak_source",
+                      "hbm_capacity_bytes_per_chip", "hbm_headroom_frac")
+                     if k in out}
+        if mem.get("analysis"):
+            mem_extra["analysis"] = mem["analysis"]
+        writer.emit(telemetry.KIND_MEMORY, metrics=mem_metrics or None,
+                    source="bench", source_kind=mem.get("source_kind"),
+                    workload=workload, **mem_extra)
 
 
 # BENCH_COLLECTIVE value → parallel.collective_dtype knob value.
@@ -791,6 +873,7 @@ def _run_collective_ab(writer, mode: str, n_chips: int, chip: str) -> int:
         "run_id": writer.run_id,
     }
     _annotate_roofline(out, target, chip, n_chips)
+    _annotate_memory(out, target, chip, n_chips)
     _emit_bench_result(writer, f"resnet50-collective-{mode}", out, target)
     print(json.dumps(out))
     return 0
@@ -857,6 +940,7 @@ def _run_zero_ab(writer, mode: str, n_chips: int, chip: str) -> int:
         "run_id": writer.run_id,
     }
     _annotate_roofline(out, target, chip, n_chips)
+    _annotate_memory(out, target, chip, n_chips)
     _emit_bench_result(writer, f"resnet50-zero-{mode}", out, target)
     print(json.dumps(out))
     return 0
@@ -1014,6 +1098,7 @@ def _run(writer) -> int:
         }
         _annotate_roofline(out, result, chip, n_chips,
                            accum_scaled=accum > 1)
+        _annotate_memory(out, result, chip, n_chips)
         _emit_bench_result(writer, workload, out, result)
         print(json.dumps(out))
         return 0
@@ -1040,6 +1125,7 @@ def _run(writer) -> int:
             "run_id": writer.run_id,
         }
         _annotate_roofline(out, result, chip, n_chips)
+        _annotate_memory(out, result, chip, n_chips)
         _emit_bench_result(writer, workload, out, result)
         print(json.dumps(out))
         return 0
@@ -1069,6 +1155,7 @@ def _run(writer) -> int:
         "run_id": writer.run_id,
     }
     _annotate_roofline(out, result, chip, n_chips)
+    _annotate_memory(out, result, chip, n_chips)
     _emit_bench_result(writer, workload, out, result)
     print(json.dumps(out))
     return 0

@@ -397,6 +397,18 @@ class TrainConfig:
     # configs. Applied by cli/train.py via platform.enable_compilation_cache
     # BEFORE the first backend use.
     compilation_cache_dir: str = ""
+    # Goodput ledger (core/goodput.py): cumulative KIND_GOODPUT snapshots
+    # at most this often (checked at metric-fetch steps; the final rollup
+    # always fires). 0 emits at every fetch.
+    goodput_interval_s: float = 30.0
+    # HBM sampling (core/memstats.py): periodic KIND_MEMORY
+    # device.memory_stats() samples, same cadence contract.
+    memory_interval_s: float = 60.0
+    # Also capture compiled.memory_analysis() of the train step (one
+    # extra lowering+compile when profiling isn't already doing one —
+    # that cost is why it defaults off; the profile-window path captures
+    # it for free).
+    memory_analysis: bool = False
 
 
 @config_dataclass

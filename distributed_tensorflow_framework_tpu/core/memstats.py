@@ -1,0 +1,206 @@
+"""HBM memory telemetry: where the bytes behind ``hbm_bw_util 0.94`` live.
+
+ROADMAP item 5 (attack the HBM roofline) needs a before/after
+instrument for every byte-moving experiment. Two complementary sources,
+both emitted as ``KIND_MEMORY`` events:
+
+  * ``device_memory_snapshot`` — the allocator's live view
+    (``device.memory_stats()``: bytes_in_use / peak_bytes_in_use per
+    device). TPU/GPU runtimes expose it; the CPU backend returns None,
+    so the snapshot falls back to process RSS (``resource.getrusage``)
+    with ``source_kind`` saying which ruler was used — CPU CI exercises
+    the full pipeline, chips report real HBM.
+  * ``compiled_memory_analysis`` — XLA's static budget for one program
+    (``compiled.memory_analysis()``: argument/output/temp/generated-code
+    bytes). One-shot per compile, works on every backend, and is the
+    number remat/donation experiments move directly.
+
+``MemoryMonitor`` owns the cadence: periodic ``maybe_sample`` from the
+train loop and serve reporter, ``capture_compiled`` when a lowered step
+is at hand, and a no-emit ``snapshot()`` for /healthz.
+"""
+
+from __future__ import annotations
+
+import resource
+import time
+from typing import Any
+
+from distributed_tensorflow_framework_tpu.core import telemetry
+
+# CompiledMemoryStats attribute -> analysis dict key (bytes).
+_ANALYSIS_FIELDS = {
+    "argument_size_in_bytes": "argument_bytes",
+    "output_size_in_bytes": "output_bytes",
+    "temp_size_in_bytes": "temp_bytes",
+    "generated_code_size_in_bytes": "generated_code_bytes",
+    "alias_size_in_bytes": "alias_bytes",
+}
+
+
+def host_rss_bytes() -> tuple[int, int]:
+    """(current, peak) resident-set bytes of this process.
+
+    ``ru_maxrss`` is KiB on Linux; the current figure comes from
+    /proc/self/statm when available, else the peak stands in for both.
+    """
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    current = peak
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        import os
+        current = pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    return current, peak
+
+
+def device_memory_snapshot(devices=None) -> dict:
+    """One sample across ``devices`` (default: ``jax.devices()``).
+
+    Returns per-chip MAXIMA in the top-level fields — the binding
+    constraint on an SPMD program is its worst chip, and that is the
+    number bench.py holds against the chip's HBM capacity:
+
+      {"device_count", "bytes_in_use", "peak_bytes_in_use",
+       "source_kind": "device_memory_stats" | "host_rss",
+       "devices": [{"id", "kind", "bytes_in_use", "peak_bytes_in_use"}]}
+    """
+    if devices is None:
+        import jax
+        devices = jax.devices()
+    per_device = []
+    for d in devices:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:  # backend without allocator stats
+            stats = None
+        if stats:
+            per_device.append({
+                "id": getattr(d, "id", None),
+                "kind": getattr(d, "device_kind", "?"),
+                "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(
+                    stats.get("peak_bytes_in_use",
+                              stats.get("bytes_in_use", 0))),
+            })
+    if per_device:
+        return {
+            "device_count": len(devices),
+            "bytes_in_use": max(d["bytes_in_use"] for d in per_device),
+            "peak_bytes_in_use": max(
+                d["peak_bytes_in_use"] for d in per_device),
+            "source_kind": "device_memory_stats",
+            "devices": per_device,
+        }
+    current, peak = host_rss_bytes()
+    return {
+        "device_count": len(devices),
+        "bytes_in_use": current,
+        "peak_bytes_in_use": peak,
+        "source_kind": "host_rss",
+        "devices": [],
+    }
+
+
+def compiled_memory_analysis(compiled) -> dict | None:
+    """XLA's static memory budget for one compiled program, or None.
+
+    ``peak_bytes_est`` is the classic XLA program-footprint sum
+    (arguments + outputs + temps + generated code) — nonzero on every
+    backend, including CPU, which is what keeps the bench acceptance
+    check meaningful off-chip.
+    """
+    analysis_fn = getattr(compiled, "memory_analysis", None)
+    if analysis_fn is None:
+        return None
+    try:
+        stats = analysis_fn()
+    except Exception:
+        return None
+    if stats is None:
+        return None
+    out: dict[str, int] = {}
+    for attr, key in _ANALYSIS_FIELDS.items():
+        v = getattr(stats, attr, None)
+        if v is not None:
+            out[key] = int(v)
+    if not out:
+        return None
+    out["peak_bytes_est"] = (
+        out.get("argument_bytes", 0) + out.get("output_bytes", 0)
+        + out.get("temp_bytes", 0) + out.get("generated_code_bytes", 0))
+    return out
+
+
+class MemoryMonitor:
+    """Cadenced ``KIND_MEMORY`` emitter for one process.
+
+    ``source`` tags who is sampling ("train", "serve", "bench") so a
+    joined events file keeps the streams apart. Per-device rows ride in
+    the event only up to ``max_device_rows`` — megapod runs must not
+    turn every sample into a kilobyte of JSON.
+    """
+
+    def __init__(self, writer: telemetry.TelemetryWriter | None = None,
+                 *, interval_s: float = 60.0, source: str = "train",
+                 devices=None, max_device_rows: int = 16):
+        self._writer = writer
+        self._interval_s = float(interval_s)
+        self._source = source
+        self._devices = devices
+        self._max_device_rows = max_device_rows
+        self._last_sample = time.perf_counter()
+        self._last_snapshot: dict | None = None
+
+    def snapshot(self) -> dict:
+        """Fresh sample, no emission (the /healthz path)."""
+        snap = device_memory_snapshot(self._devices)
+        self._last_snapshot = snap
+        return snap
+
+    def sample(self, step: int | None = None, *,
+               final: bool = False) -> dict:
+        """Sample and emit one ``KIND_MEMORY`` event."""
+        snap = self.snapshot()
+        self._last_sample = time.perf_counter()
+        if self._writer is not None:
+            self._writer.emit(
+                telemetry.KIND_MEMORY,
+                step=step,
+                metrics={
+                    "bytes_in_use": snap["bytes_in_use"],
+                    "peak_bytes_in_use": snap["peak_bytes_in_use"],
+                    "device_count": snap["device_count"],
+                },
+                source=self._source,
+                source_kind=snap["source_kind"],
+                devices=snap["devices"][: self._max_device_rows] or None,
+                final=final,
+            )
+        return snap
+
+    def maybe_sample(self, step: int | None = None) -> dict | None:
+        if time.perf_counter() - self._last_sample < self._interval_s:
+            return None
+        return self.sample(step)
+
+    def capture_compiled(self, compiled, *, step: int | None = None,
+                         label: str = "train_step") -> dict | None:
+        """One-shot static-budget capture of a compiled program."""
+        analysis = compiled_memory_analysis(compiled)
+        if analysis is None:
+            return None
+        if self._writer is not None:
+            self._writer.emit(
+                telemetry.KIND_MEMORY,
+                step=step,
+                metrics={"peak_bytes_est": analysis["peak_bytes_est"]},
+                source=self._source,
+                source_kind="memory_analysis",
+                program=label,
+                analysis=analysis,
+            )
+        return analysis

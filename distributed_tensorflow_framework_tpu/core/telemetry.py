@@ -114,6 +114,22 @@ KIND_SERVE_BATCH = "serve_batch"
 KIND_SERVE_QUEUE = "serve_queue_depth"
 KIND_SERVE_LATENCY = "serve_latency"
 KIND_SERVE_RECOMPILE = "serve_bucket_recompile"
+# Goodput ledger (core/goodput.py, docs/OBSERVABILITY.md): periodic +
+# end-of-run classification of every wall-clock second into productive
+# step compute vs overhead buckets (infeed wait, recompiles, metric
+# fetches, checkpoint-blocked time, rollbacks, startup). ``metrics``
+# carries wall_s/goodput_frac; the per-bucket seconds ride in
+# ``extra.buckets`` and the event-count tallies in ``extra.counters``.
+# Cross-attempt restart gaps are NOT in the buckets — they are stitched
+# at read time from per-attempt ledgers (goodput.stitch_attempts).
+KIND_GOODPUT = "goodput"
+# HBM memory telemetry (core/memstats.py): periodic device.memory_stats()
+# samples (bytes_in_use / peak_bytes_in_use, per-chip max in ``metrics``)
+# with a host-RSS fallback on backends that expose no allocator stats
+# (``extra.source_kind`` says which), plus one-shot
+# compiled.memory_analysis() captures of a program's argument/output/
+# temp/generated-code bytes in ``extra.analysis``.
+KIND_MEMORY = "memory"
 
 
 def make_run_id() -> str:
@@ -244,6 +260,7 @@ class TelemetryWriter:
         self.run_id = run_id or make_run_id()
         self._fh = None
         self._lock = threading.Lock()
+        self._listeners: list[Any] = []
         self.path = path
         if not (is_chief and path):
             return
@@ -254,6 +271,18 @@ class TelemetryWriter:
     def enabled(self) -> bool:
         return self._fh is not None
 
+    def add_listener(self, fn) -> None:
+        """Register ``fn(event_dict)`` to observe every emitted record.
+
+        This is how in-process accountants join streams without a disk
+        round-trip: the goodput ledger (core/goodput.py) listens for
+        ``ckpt_save`` blocked-ms so checkpoint stalls move out of its
+        residual bucket the moment the saver thread reports them.
+        Listeners run outside the append lock but may be called from any
+        emitting thread; they must be fast and must not raise.
+        """
+        self._listeners.append(fn)
+
     def emit(self, kind: str, **fields: Any) -> dict:
         """Build + append one event; returns the record (even when no-op,
         so callers can reuse it for console/JSON-line output)."""
@@ -262,6 +291,11 @@ class TelemetryWriter:
         with self._lock:
             if self._fh is not None:
                 self._fh.write(line)
+        for fn in self._listeners:
+            try:
+                fn(ev)
+            except Exception:  # a broken observer must never lose the run
+                log.exception("telemetry listener failed on kind=%s", kind)
         return ev
 
     def emit_run_meta(self, **describe: Any) -> dict:
@@ -373,6 +407,16 @@ def summarize_events(path: str) -> dict:
         "recompiles": [], "latency": None,
     }
     last_collectives: dict | None = None
+    # Per-attempt goodput rollups: one ledger per run_id (process); the
+    # final rollup wins over periodic snapshots, else the last seen (a
+    # SIGKILLed attempt never finalizes — its last periodic event is the
+    # truth that survived).
+    goodput_by_run: dict[str, dict] = {}
+    memory = {
+        "samples": 0, "sources": {},
+        "peak_bytes_in_use": 0, "bytes_in_use_last": None,
+        "analysis": None,
+    }
     for ev in read_events(path, strict=False):
         kind = ev["kind"]
         kinds[kind] = kinds.get(kind, 0) + 1
@@ -502,6 +546,32 @@ def summarize_events(path: str) -> dict:
                 "bucket": extra.get("bucket"),
                 "compile_ms": m.get("compile_ms"),
             })
+        elif kind == KIND_GOODPUT:
+            m = ev.get("metrics") or {}
+            snap = {
+                "t0": extra.get("t0"),
+                "wall_s": m.get("wall_s"),
+                "goodput_frac": m.get("goodput_frac"),
+                "buckets": dict(extra.get("buckets") or {}),
+                "counters": dict(extra.get("counters") or {}),
+                "final": bool(extra.get("final")),
+            }
+            prev = goodput_by_run.get(ev.get("run_id"))
+            if prev is None or not prev["final"] or snap["final"]:
+                goodput_by_run[ev.get("run_id")] = snap
+        elif kind == KIND_MEMORY:
+            m = ev.get("metrics") or {}
+            memory["samples"] += 1
+            src = str(extra.get("source", "unknown"))
+            memory["sources"][src] = memory["sources"].get(src, 0) + 1
+            if m.get("peak_bytes_in_use"):
+                memory["peak_bytes_in_use"] = max(
+                    int(memory["peak_bytes_in_use"]),
+                    int(m["peak_bytes_in_use"]))
+            if m.get("bytes_in_use") is not None:
+                memory["bytes_in_use_last"] = int(m["bytes_in_use"])
+            if extra.get("analysis"):
+                memory["analysis"] = dict(extra["analysis"])
         elif kind == KIND_TRAIN_STEP:
             m = ev.get("metrics") or {}
             if pipeline is not None and "pipe_bubble_frac" in m:
@@ -531,6 +601,30 @@ def summarize_events(path: str) -> dict:
                 round(float(logical) / float(total), 3)
                 if total and logical is not None else None),
         }
+    goodput = None
+    if goodput_by_run:
+        # In-process accounting only: restart gaps BETWEEN attempts need
+        # the per-attempt t0 intervals and supervisor classifications —
+        # goodput.stitch_attempts() builds that cross-attempt table.
+        buckets: dict[str, float] = {}
+        counters: dict[str, int] = {}
+        wall = productive = 0.0
+        for snap in goodput_by_run.values():
+            w = float(snap.get("wall_s") or 0.0)
+            wall += w
+            if snap.get("goodput_frac") is not None:
+                productive += w * float(snap["goodput_frac"])
+            for b, s in snap["buckets"].items():
+                buckets[b] = buckets.get(b, 0.0) + float(s)
+            for c, n in snap["counters"].items():
+                counters[c] = counters.get(c, 0) + int(n)
+        goodput = {
+            "attempts": len(goodput_by_run),
+            "wall_s": wall,
+            "goodput_frac": (productive / wall) if wall else None,
+            "buckets": buckets,
+            "counters": counters,
+        }
     return {
         "path": path,
         "run_ids": run_ids,
@@ -551,6 +645,8 @@ def summarize_events(path: str) -> dict:
         "zero": zero,
         "serve": (serve if (serve["requests"] or serve["batches"]
                             or serve["recompiles"]) else None),
+        "goodput": goodput,
+        "memory": (memory if memory["samples"] else None),
         "recovery": {
             "quarantined": quarantined,
             "restore_fallbacks": fallbacks,
@@ -566,6 +662,18 @@ def summarize_events(path: str) -> dict:
             "ckpt_reshards": ckpt_reshards,
         },
     }
+
+
+def fmt_bytes(n: Any) -> str:
+    """``3221225472`` -> ``3.00 GiB`` (human-scale HBM numbers)."""
+    if not isinstance(n, (int, float)):
+        return "?"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.2f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.2f} TiB"
 
 
 def _fmt_axes(axes: dict | None) -> str:
@@ -699,6 +807,41 @@ def format_run_summary(summary: dict) -> str:
             lines.append(
                 f"    bucket recompiles: {len(serve['recompiles'])}"
                 f" ({buckets})"
+            )
+    gp = summary.get("goodput")
+    if gp:  # KIND_GOODPUT rollup (per-attempt ledgers summed)
+        frac = gp.get("goodput_frac")
+        lines.append(
+            f"  goodput: "
+            + (f"{100.0 * float(frac):.1f}%" if frac is not None else "?")
+            + f" of {float(gp.get('wall_s') or 0):.1f} s wall over "
+            f"{gp.get('attempts')} attempt(s)"
+        )
+        buckets = sorted((gp.get("buckets") or {}).items(),
+                         key=lambda kv: -kv[1])
+        if buckets:
+            lines.append("    buckets: " + ", ".join(
+                f"{b} {s:.1f}s" for b, s in buckets))
+    mem = summary.get("memory")
+    if mem:  # KIND_MEMORY rollup
+        srcs = ", ".join(
+            f"{k}={v}" for k, v in sorted(mem.get("sources", {}).items()))
+        peak = mem.get("peak_bytes_in_use")
+        lines.append(
+            f"  memory: {mem['samples']} sample(s)"
+            + (f", peak {fmt_bytes(peak)}/chip in use" if peak else "")
+            + (f" [{srcs}]" if srcs else "")
+        )
+        ana = mem.get("analysis")
+        if ana:
+            lines.append(
+                "    compiled step: args {a} + temps {t} + output {o}"
+                " (+ code {c})".format(
+                    a=fmt_bytes(ana.get("argument_bytes")),
+                    t=fmt_bytes(ana.get("temp_bytes")),
+                    o=fmt_bytes(ana.get("output_bytes")),
+                    c=fmt_bytes(ana.get("generated_code_bytes")),
+                )
             )
     for s in summary.get("startups") or []:
         t = s.get("time_to_first_step_s")

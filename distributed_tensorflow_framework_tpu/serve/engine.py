@@ -37,7 +37,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding
 
-from distributed_tensorflow_framework_tpu.core import telemetry
+from distributed_tensorflow_framework_tpu.core import memstats, telemetry
 from distributed_tensorflow_framework_tpu.core.config import ServeConfig
 from distributed_tensorflow_framework_tpu.core.mesh import (
     MeshConfig,
@@ -179,6 +179,11 @@ class InferenceEngine:
         self._padded_rows = 0
         self._queue_wait_ms = 0.0
         self._compute_ms = 0.0
+        # HBM pressure on the serving mesh (core/memstats.py): sampled by
+        # the reporter thread at report_interval_s, snapshot on /healthz.
+        self._mem = memstats.MemoryMonitor(
+            telemetry_writer, interval_s=serve_cfg.report_interval_s,
+            source="serve", devices=list(self.mesh.devices.flat))
         self._batcher = threading.Thread(
             target=self._batch_loop, name="serve-batcher", daemon=True)
         self._batcher.start()
@@ -298,6 +303,22 @@ class InferenceEngine:
             "compiled_buckets": sorted(str(k) for k in self._compiled),
         }
 
+    def goodput_snapshot(self) -> dict[str, Any]:
+        """Serve-side goodput: the fraction of engine uptime the batcher
+        spent computing vs the request-seconds lost to queueing — the
+        healthz counters load_gen diffs around a bench window."""
+        elapsed = max(time.monotonic() - self._t_start, 1e-9)
+        return {
+            "uptime_s": elapsed,
+            "compute_ms_total": self._compute_ms,
+            "queue_wait_ms_total": self._queue_wait_ms,
+            "compute_frac": (self._compute_ms / 1e3) / elapsed,
+        }
+
+    def memory_snapshot(self) -> dict[str, Any]:
+        """Live device-memory view (no telemetry emission) for /healthz."""
+        return self._mem.snapshot()
+
     def drain(self, timeout: float | None = None) -> bool:
         """Stop admission, serve everything already queued, stop threads.
 
@@ -324,6 +345,8 @@ class InferenceEngine:
         self._stop_reporting.set()
         self._reporter.join(max(1.0, self.cfg.report_interval_s))
         self._emit_latency()  # final cumulative rollup — last one wins
+        if self._tw:
+            self._mem.sample(final=True)
         log.info("engine drained: %d requests in %d batches, %d undrained",
                  self._requests, self._batches, len(leftovers))
         return drained and not leftovers
@@ -478,4 +501,5 @@ class InferenceEngine:
             if self._tw:
                 self._tw.emit(telemetry.KIND_SERVE_QUEUE,
                               metrics={"queue_depth": depth})
+                self._mem.sample()
             self._emit_latency()

@@ -142,6 +142,11 @@ class ServingServer:
             "vocab_size": art.vocab_size,
             "input_spec": art.input_spec,
             "engine": self.engine.stats(),
+            # Live HBM + goodput snapshots: load_gen diffs these across a
+            # bench window to attribute serve-side memory pressure and
+            # compute fraction to its own traffic (docs/OBSERVABILITY.md).
+            "memory": self.engine.memory_snapshot(),
+            "goodput": self.engine.goodput_snapshot(),
         })
 
     # ------------------------------------------------------------- drain

@@ -25,7 +25,8 @@ from typing import Any
 import jax
 
 from distributed_tensorflow_framework_tpu.core.config import ExperimentConfig
-from distributed_tensorflow_framework_tpu.core import faults, profiling, supervision, telemetry
+from distributed_tensorflow_framework_tpu.core import (
+    faults, goodput, memstats, profiling, supervision, telemetry)
 from distributed_tensorflow_framework_tpu.core.mesh import MeshRuntime, initialize_runtime
 from distributed_tensorflow_framework_tpu.core.metrics import MetricWriter, setup_logging
 from distributed_tensorflow_framework_tpu.data import get_dataset
@@ -99,6 +100,24 @@ class Trainer:
                 config.resilience, telemetry_writer=self.writer.telemetry)
             if config.resilience.rollback else None
         )
+        # Wall-clock accountant (core/goodput.py): absorbs StepTimer
+        # phases and listens on the telemetry stream (ckpt_save blocked-ms
+        # from the saver thread), so every second of this process lands in
+        # a KIND_GOODPUT bucket. Backdated to _init_t: the runtime/dataset
+        # build above must be inside the wall the startup bucket charges.
+        self.goodput = goodput.GoodputLedger(
+            self.writer.telemetry,
+            interval_s=config.train.goodput_interval_s,
+            t0_perf=self._init_t)
+        self._startup_accounted = False
+        # Periodic HBM sampling (core/memstats.py): device.memory_stats()
+        # where the backend has it, host RSS where it doesn't.
+        self.memstats = memstats.MemoryMonitor(
+            self.writer.telemetry,
+            interval_s=config.train.memory_interval_s, source="train")
+        # Set by _rebuild_with_rewarmup: the next dispatch re-jits, so its
+        # wall time belongs in the recompile bucket, not step_compute.
+        self._recompile_pending = False
         self.state: Any = None
         self.host_step = 0
         self._ckpt_manager = None
@@ -178,7 +197,8 @@ class Trainer:
         # not for every training launch.
         self.compiled_hlo = None
         tcfg = self.config.train
-        if tcfg.profile_stop > tcfg.profile_start and self.runtime.is_chief:
+        profiled = tcfg.profile_stop > tcfg.profile_start and self.runtime.is_chief
+        if profiled or (tcfg.memory_analysis and self.runtime.is_chief):
             try:
                 # This lower+compile populates the jit call cache, so the
                 # loop's first-dispatch tally would see an already-traced
@@ -186,7 +206,12 @@ class Trainer:
                 with coll.tally() as tly:
                     lowered = self.train_step.lower(self.state, sample)
                 self.collectives_summary = tly.summary()
-                self.compiled_hlo = lowered.compile().as_text()
+                compiled = lowered.compile()
+                if profiled:
+                    self.compiled_hlo = compiled.as_text()
+                # Static memory budget of the step (KIND_MEMORY with
+                # extra.analysis) — free here, the compile is already paid.
+                self.memstats.capture_compiled(compiled, label="train_step")
             except Exception:
                 log.warning("could not capture compiled HLO", exc_info=True)
         # eval_step compiles from the EVAL stream's sample batch (its
@@ -338,6 +363,12 @@ class Trainer:
         # device_get, never block_until_ready (the axon tunnel returns
         # early from the latter — bench.py documents the same rule).
         pending: collections.deque = collections.deque()
+        if not self._startup_accounted:
+            # Construction → loop entry (restore + input/eval build; the
+            # first compile lands in the recompile bucket at dispatch).
+            self._startup_accounted = True
+            self.goodput.add(
+                "startup", time.perf_counter() - self._init_t)
         try:
             while self.host_step < cfg.total_steps:
                 if supervision.preemption_requested():
@@ -371,8 +402,14 @@ class Trainer:
                     with timer.phase("backpressure"):
                         float(jax.device_get(
                             next(iter(pending.popleft().values()))))
-                with timer.phase("dispatch"), profiling.annotate("train_step"):
-                    if self.collectives_summary is None:
+                first_dispatch = self.collectives_summary is None
+                # A dispatch that traces+compiles (first step, or the one
+                # after a rollback rebuild) is recompile overhead in the
+                # goodput ledger, not step compute.
+                compiling = first_dispatch or self._recompile_pending
+                with timer.phase("compile" if compiling else "dispatch"), \
+                        profiling.annotate("train_step"):
+                    if first_dispatch:
                         # First dispatch traces/compiles the step; the
                         # tally sees every collective the executable will
                         # ever run (jit traces once per shape).
@@ -382,6 +419,9 @@ class Trainer:
                         self.collectives_summary = tly.summary()
                     else:
                         self.state, metrics = self.train_step(self.state, batch)
+                if compiling:
+                    self._recompile_pending = False
+                    self.goodput.count("recompiles")
                 if cfg.dispatch_ahead > 0:
                     pending.append(metrics)
                 self.host_step += 1
@@ -413,6 +453,7 @@ class Trainer:
                             for k, v in jax.device_get(metrics).items()
                         }
                     host_metrics.update(timer.means())
+                    self.goodput.absorb_phases(timer.totals)
                     timer.reset()
                     pending.clear()
                     # Recovery ladder rung (train/anomaly.py): a successful
@@ -420,6 +461,8 @@ class Trainer:
                     # reach the hooks (no NaNGuard abort, no poisoned
                     # LoggingHook record) and host_step has been rewound.
                     host_metrics = self._maybe_recover(host_metrics)
+                    self.goodput.maybe_emit(step=self.host_step)
+                    self.memstats.maybe_sample(step=self.host_step)
                     if host_metrics is not None:
                         last_metrics = host_metrics
                 for h in hooks:
@@ -438,6 +481,12 @@ class Trainer:
             # Stop the background producer (async_infeed): it must not
             # keep pulling from the dataset the caller may reuse/restore.
             infeed.close()
+            # Absorb the tail phases accumulated since the last fetch even
+            # on the escalation path (the final rollup below only runs on
+            # clean exit; an escalating or SIGKILLed attempt is covered by
+            # its last periodic snapshot).
+            self.goodput.absorb_phases(timer.totals)
+            timer.reset()
         for h in hooks:
             h.on_end(self)
         if self._ckpt_manager is not None:
@@ -446,6 +495,10 @@ class Trainer:
             # may not include it — never return (and never let the CLI exit
             # rc 83) with a commit still in flight on the saver thread.
             self._ckpt_manager.wait_until_finished()
+        # Finalize AFTER the exit barrier so the last ckpt_save's
+        # blocked-ms lands in the rollup, not past it.
+        self.goodput.finalize(step=self.host_step)
+        self.memstats.sample(step=self.host_step, final=True)
         return last_metrics
 
     # ----------------------------------------------------- recovery ladder --
@@ -506,13 +559,14 @@ class Trainer:
         if not rec.can_rollback():
             rec.exhausted = True
             return host_metrics
-        self.state, snap = rec.rollback(self.state, from_step=self.host_step)
-        # Skip-batch semantics: host_step rewinds, the data iterator does
-        # NOT — the replayed step range consumes fresh batches and the
-        # poisoned region is never re-fed.
-        self.host_step = snap.step
-        if self.config.resilience.lr_rewarmup_steps > 0:
-            self._rebuild_with_rewarmup(snap.step)
+        with self.goodput.timed("rollback"):
+            self.state, snap = rec.rollback(self.state, from_step=self.host_step)
+            # Skip-batch semantics: host_step rewinds, the data iterator
+            # does NOT — the replayed step range consumes fresh batches and
+            # the poisoned region is never re-fed.
+            self.host_step = snap.step
+            if self.config.resilience.lr_rewarmup_steps > 0:
+                self._rebuild_with_rewarmup(snap.step)
         return None
 
     def _rebuild_with_rewarmup(self, resume_step: int) -> None:
@@ -531,6 +585,7 @@ class Trainer:
         self.builder.set_schedule_wrapper(
             lambda sched: schedules.with_rewarmup(sched, resume_step, steps))
         self.train_step = self.builder.make_train_step(self._sample)
+        self._recompile_pending = True
 
     # ---------------------------------------------------------------- eval --
     def _ensure_eval(self):

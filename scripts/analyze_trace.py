@@ -22,20 +22,33 @@ instead prints the run summary: event counts, step span, recovery
 activity — quarantined checkpoints, restore fallbacks, supervisor
 attempts, graceful preemptions (docs/RESILIENCE.md) — plus the
 checkpoint save-stall accounting (loop-blocked vs total save time under
-``checkpoint.async_save``) and restart→first-step startup latency
-(docs/PERFORMANCE.md). Supervisor events (``supervisor_events.jsonl``
-next to it) are summarized too when present.
+``checkpoint.async_save``), restart→first-step startup latency
+(docs/PERFORMANCE.md), and the goodput ledger: every wall-clock second
+across attempts bucketed into step compute vs overhead, restart gaps
+stitched from supervisor events (core/goodput.py). Supervisor events
+(``supervisor_events.jsonl`` next to it) are summarized too when
+present.
+
+In run-summary mode ``--json`` (bare, or ``--json -``) prints the whole
+summary as ONE machine-readable JSON object instead of the text tables
+— drivers parse that; ``--json PATH`` writes the object to PATH and
+still prints the text. In trace mode ``--json PATH`` keeps its original
+meaning: the JSONL sink for the trace_summary event.
 """
 
 import argparse
+import json
 import os
 import pathlib
 import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+from distributed_tensorflow_framework_tpu.core import goodput  # noqa: E402
 from distributed_tensorflow_framework_tpu.core import telemetry  # noqa: E402
 from distributed_tensorflow_framework_tpu.core import trace_analysis as ta  # noqa: E402
+
+RUN_SUMMARY_SCHEMA = "dtf-run-summary/1"
 
 
 def _events_files(target: str) -> list[str]:
@@ -52,16 +65,44 @@ def _events_files(target: str) -> list[str]:
     return []
 
 
-def summarize_run(target: str) -> bool:
+def summarize_run(target: str, json_out: str | None = None) -> bool:
     """Print run summaries for every events JSONL under ``target``; False
-    when there is none (caller falls through to trace analysis)."""
+    when there is none (caller falls through to trace analysis).
+
+    ``json_out``: "-" prints ONLY the machine-readable object; a path
+    writes the object there and still prints the text tables.
+    """
     paths = _events_files(target)
     if not paths:
         return False
-    for i, path in enumerate(paths):
+    runs = []
+    for path in paths:
+        summary = telemetry.summarize_events(path)
+        # Cross-attempt stitch: per-attempt goodput rollups + restart
+        # gaps classified from supervisor_events.jsonl when present.
+        ledger = goodput.stitch_attempts(path)
+        runs.append((path, summary, ledger))
+    if json_out:
+        obj: dict = {"schema": RUN_SUMMARY_SCHEMA}
+        docs = [{"events_path": p, **s,
+                 **({"goodput_ledger": g} if g else {})}
+                for p, s, g in runs]
+        if len(docs) == 1:
+            obj.update(docs[0])
+        else:
+            obj["runs"] = docs
+        text = json.dumps(obj, sort_keys=True, default=str)
+        if json_out == "-":
+            print(text)
+            return True
+        with open(json_out, "w") as fh:
+            fh.write(text + "\n")
+    for i, (path, summary, ledger) in enumerate(runs):
         if i:
             print()
-        print(telemetry.format_run_summary(telemetry.summarize_events(path)))
+        print(telemetry.format_run_summary(summary))
+        if ledger:
+            print(goodput.format_goodput_table(ledger))
     return True
 
 
@@ -71,9 +112,11 @@ def main(argv=None) -> int:
     ap.add_argument("--hlo", default=None,
                     help="optimized HLO text for scope attribution "
                          "(default: auto-discover near the trace)")
-    ap.add_argument("--json", default=None,
-                    help="append the trace_summary event to this JSONL file "
-                         "(default: <trace>.summary.jsonl)")
+    ap.add_argument("--json", nargs="?", const="-", default=None,
+                    help="run-summary mode: print (bare / '-') or write "
+                         "(PATH) the summary as one JSON object; trace "
+                         "mode: append the trace_summary event to this "
+                         "JSONL file (default: <trace>.summary.jsonl)")
     ap.add_argument("--run-id", default=None,
                     help="run id to stamp on the summary event (use the id "
                          "from the run's events.jsonl to make them joinable)")
@@ -83,8 +126,8 @@ def main(argv=None) -> int:
 
     # events.jsonl → run summary (recovery activity); a run DIRECTORY gets
     # both the run summary and, below, its newest trace when one exists.
-    summarized = summarize_run(args.trace)
-    if summarized and os.path.isfile(args.trace):
+    summarized = summarize_run(args.trace, json_out=args.json)
+    if summarized and (os.path.isfile(args.trace) or args.json == "-"):
         return 0
 
     traces = ta.find_xplane_files(args.trace)
@@ -108,7 +151,8 @@ def main(argv=None) -> int:
     if hlo_path and hlo_text:
         print(f"\nhlo: {hlo_path}")
 
-    out = args.json or (trace + ".summary.jsonl")
+    out = (args.json if args.json and args.json != "-"
+           else trace + ".summary.jsonl")
     ta.write_summary_event(report, out, run_id=args.run_id)
     print(f"summary event appended to {out}")
     return 0

@@ -149,4 +149,16 @@ serve_ab unbatched 1
 run zero-off       env BENCH_ZERO=off python bench.py
 run zero-shard_map env BENCH_ZERO=shard_map python bench.py
 
+# 12. HBM memory close-out (ROADMAP item 5, docs/OBSERVABILITY.md): one
+#     stock-bench run with its telemetry pinned to a known sink, then
+#     the machine-readable run summary. The JSON line's
+#     hbm_peak_bytes_per_chip / hbm_headroom_frac say how much batch
+#     headroom the 0.94-bw-util step has left on THIS chip (first
+#     on-chip read of device memory_stats — CPU rehearsals only ever
+#     saw the memory_analysis estimate), and the events file carries
+#     the raw KIND_MEMORY samples for the before/after of any round-6
+#     remat/donation dial.
+run mem-headline env BENCH_JSONL=/tmp/chipq_mem_events.jsonl python bench.py
+run mem-summary  python scripts/analyze_trace.py /tmp/chipq_mem_events.jsonl --json -
+
 echo "=== chip queue done $(date -u +%FT%TZ) ==="

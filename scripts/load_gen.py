@@ -206,7 +206,8 @@ def run_bench(endpoint: str, *, requests: int = 256, concurrency: int = 32,
     if mode in ("open", "both"):
         runs.append(_drive(url, payloads, concurrency=concurrency,
                            rate=rate))
-    engine1 = fetch_healthz(url).get("engine", {})
+    health1 = fetch_healthz(url)
+    engine1 = health1.get("engine", {})
     # Server-side split over the bench window: where did a request's
     # life go — waiting for the admission window, or under compute?
     split = {
@@ -232,6 +233,20 @@ def run_bench(endpoint: str, *, requests: int = 256, concurrency: int = 32,
         "runs": runs,
         "server_split": split,
         "server_latency": engine1.get("latency"),
+        # Healthz deltas across the window: serve-side HBM pressure (peak
+        # growth attributable to this traffic) and the engine's
+        # compute-fraction movement (serve/server.py /healthz).
+        "server_memory": {
+            "before": health.get("memory"),
+            "after": health1.get("memory"),
+            "peak_bytes_delta": (
+                (health1.get("memory") or {}).get("peak_bytes_in_use", 0)
+                - (health.get("memory") or {}).get("peak_bytes_in_use", 0)),
+        },
+        "server_goodput": {
+            "before": health.get("goodput"),
+            "after": health1.get("goodput"),
+        },
     }
 
 

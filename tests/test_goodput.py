@@ -1,0 +1,210 @@
+"""Goodput ledger (core/goodput.py): wall-clock accounting that sums.
+
+Unit-level coverage of the accountant the observability drill
+(tests/test_observability_drill.py) exercises end-to-end: bucket math,
+the TelemetryWriter listener join (ckpt_save blocked-ms → ckpt_blocked),
+periodic/final emission, cross-attempt stitching with supervisor-
+classified restart gaps, and the rendered table's sums-to-100% property.
+"""
+
+import pytest
+
+from distributed_tensorflow_framework_tpu.core import goodput, telemetry
+
+
+def test_snapshot_buckets_sum_to_wall():
+    led = goodput.GoodputLedger()
+    led._t0 -= 1.0  # backdate the clock: 1 s of wall has "elapsed"
+    led.add("startup", 0.01)
+    led.absorb_phases({"dispatch": 0.02, "infeed": 0.005})
+    snap = led.snapshot()
+    assert set(snap["buckets"]) == {
+        "startup", "step_compute", "infeed_wait", "other"}
+    # other is the residual, so the buckets reconstruct the wall exactly
+    # (to rounding) — the invariant the drill asserts across attempts.
+    assert sum(snap["buckets"].values()) == pytest.approx(
+        snap["wall_s"], abs=0.01)
+    assert 0.0 <= snap["goodput_frac"] <= 1.0
+
+
+def test_backdated_clock_keeps_startup_inside_wall():
+    """The Trainer backdates the ledger to its __init__ entry: a startup
+    charge spanning the pre-ledger build must fit inside wall_s instead
+    of overflowing it (which would clamp ``other`` at 0 and break the
+    buckets-sum-to-wall invariant the drill asserts)."""
+    import time
+    t0 = time.perf_counter() - 5.0  # "__init__ started 5 s ago"
+    led = goodput.GoodputLedger(t0_perf=t0)
+    led.add("startup", time.perf_counter() - t0)  # the loop-entry charge
+    snap = led.snapshot()
+    assert snap["wall_s"] >= snap["buckets"]["startup"]
+    assert sum(snap["buckets"].values()) == pytest.approx(
+        snap["wall_s"], abs=0.01)
+    # t0_wall is shifted back by the same amount, so cross-attempt
+    # stitching sees coverage start where the wall actually began.
+    assert time.time() - led.t0_wall == pytest.approx(
+        snap["wall_s"], abs=0.5)
+
+
+def test_absorb_phases_maps_and_preserves_unknown():
+    led = goodput.GoodputLedger()
+    led.absorb_phases({"dispatch": 1.0, "backpressure": 0.5,
+                       "compile": 0.25, "infeed": 0.125,
+                       "metrics_fetch": 0.0625, "mystery_phase": 0.03})
+    snap = led.snapshot()
+    b = snap["buckets"]
+    assert b["step_compute"] == pytest.approx(1.5)  # dispatch+backpressure
+    assert b["recompile"] == pytest.approx(0.25)
+    assert b["infeed_wait"] == pytest.approx(0.125)
+    assert b["metrics_fetch"] == pytest.approx(0.0625)
+    # An unrecognized StepTimer phase must never silently vanish.
+    assert b["mystery_phase"] == pytest.approx(0.03)
+
+
+def test_timed_and_counts():
+    led = goodput.GoodputLedger()
+    with led.timed("rollback"):
+        pass
+    led.count("rollbacks")
+    led.count("batches_skipped", 3)
+    snap = led.snapshot()
+    assert snap["buckets"]["rollback"] >= 0.0
+    assert snap["counters"] == {"rollbacks": 1, "batches_skipped": 3}
+
+
+def test_listener_joins_ckpt_save_blocked_ms(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="led")
+    led = goodput.GoodputLedger(w, interval_s=0.0)
+    w.emit(telemetry.KIND_CKPT_SAVE, step=10,
+           metrics={"ckpt_save_blocked_ms": 1500.0,
+                    "ckpt_save_total_ms": 2000.0})
+    w.emit(telemetry.KIND_INFEED_STALL, step=11, health={"attempt": 2})
+    w.emit(telemetry.KIND_ROLLBACK, step=12,
+           health={"from_step": 12, "to_step": 10})
+    w.emit(telemetry.KIND_BATCH_SKIPPED, step=12, health={"batches": 2})
+    w.close()
+    snap = led.snapshot()
+    assert snap["buckets"]["ckpt_blocked"] == pytest.approx(1.5)
+    assert snap["counters"] == {"ckpt_saves": 1, "infeed_stalls": 1,
+                                "rollbacks": 1, "batches_skipped": 2}
+
+
+def test_finalize_emits_valid_goodput_event(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="led")
+    led = goodput.GoodputLedger(w, interval_s=1e9)
+    led._t0 -= 1.0  # backdate: the event's wall_s must be nonzero
+    led.absorb_phases({"dispatch": 0.5})
+    assert led.maybe_emit(step=1) is None  # interval not elapsed
+    led.finalize(step=2)
+    w.close()
+    evs = list(telemetry.read_events(
+        path, kind=telemetry.KIND_GOODPUT, strict=True))
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["extra"]["final"] is True
+    assert ev["extra"]["buckets"]["step_compute"] == pytest.approx(0.5)
+    assert ev["extra"]["t0"] == pytest.approx(led.t0_wall)
+    assert ev["metrics"]["wall_s"] > 0
+
+
+def _emit_attempt(path, run_id, *, t0, wall_s, buckets, counters=None,
+                  final=True):
+    w = telemetry.TelemetryWriter(path, run_id=run_id)
+    productive = sum(buckets.get(b, 0.0)
+                     for b in goodput.PRODUCTIVE_BUCKETS)
+    w.emit(telemetry.KIND_GOODPUT,
+           metrics={"wall_s": wall_s,
+                    "goodput_frac": productive / wall_s},
+           buckets=buckets, counters=counters or {}, t0=t0, final=final)
+    w.close()
+
+
+def test_stitch_attempts_classified_gaps(tmp_path):
+    ev_path = str(tmp_path / "events.jsonl")
+    # attempt 1: 10 s, 8 productive; crashes. attempt 2 starts 3 s later.
+    _emit_attempt(ev_path, "run-a", t0=1000.0, wall_s=10.0,
+                  buckets={"step_compute": 8.0, "startup": 1.0,
+                           "other": 1.0},
+                  counters={"ckpt_saves": 2})
+    _emit_attempt(ev_path, "run-b", t0=1013.0, wall_s=5.0,
+                  buckets={"step_compute": 4.0, "other": 1.0},
+                  counters={"ckpt_saves": 1})
+    sup = str(tmp_path / "supervisor_events.jsonl")
+    sw = telemetry.TelemetryWriter(sup, run_id="sup")
+    sw.emit(telemetry.KIND_SUPERVISOR_ATTEMPT, attempt=1, rc=137,
+            classification="crashed")
+    sw.close()
+
+    g = goodput.stitch_attempts(ev_path)
+    assert [a["run_id"] for a in g["attempts"]] == ["run-a", "run-b"]
+    assert g["wall_s"] == pytest.approx(18.0)  # 10 + 5 + 3 gap
+    assert g["buckets"]["restart_gap"] == pytest.approx(3.0)
+    assert g["restart_gaps"] == [
+        {"after_attempt": 1, "seconds": pytest.approx(3.0),
+         "classification": "crashed"}]
+    assert g["counters"] == {"ckpt_saves": 3}
+    assert g["goodput_frac"] == pytest.approx(12.0 / 18.0)
+    # The invariant the drill asserts: buckets cover the measured span.
+    assert sum(g["buckets"].values()) == pytest.approx(g["wall_s"])
+
+
+def test_stitch_prefers_final_over_periodic(tmp_path):
+    ev_path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(ev_path, run_id="run-a")
+    w.emit(telemetry.KIND_GOODPUT, metrics={"wall_s": 2.0,
+                                            "goodput_frac": 0.5},
+           buckets={"step_compute": 1.0, "other": 1.0}, counters={},
+           t0=100.0, final=False)
+    w.emit(telemetry.KIND_GOODPUT, metrics={"wall_s": 6.0,
+                                            "goodput_frac": 0.5},
+           buckets={"step_compute": 3.0, "other": 3.0}, counters={},
+           t0=100.0, final=True)
+    # A periodic event written AFTER the final one (out-of-order flush)
+    # must not displace it.
+    w.emit(telemetry.KIND_GOODPUT, metrics={"wall_s": 3.0,
+                                            "goodput_frac": 0.5},
+           buckets={"step_compute": 1.5, "other": 1.5}, counters={},
+           t0=100.0, final=False)
+    w.close()
+    g = goodput.stitch_attempts(ev_path)
+    assert len(g["attempts"]) == 1
+    assert g["wall_s"] == pytest.approx(6.0)
+    assert g["attempts"][0]["final"] is True
+
+
+def test_stitch_returns_none_without_goodput_events(tmp_path):
+    ev_path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(ev_path, run_id="serve")
+    w.emit(telemetry.KIND_SERVE_QUEUE, metrics={"queue_depth": 1})
+    w.close()
+    assert goodput.stitch_attempts(ev_path) is None
+
+
+def test_format_table_sums_to_100_pct(tmp_path):
+    ev_path = str(tmp_path / "events.jsonl")
+    _emit_attempt(ev_path, "run-a", t0=0.0, wall_s=10.0,
+                  buckets={"step_compute": 7.0, "infeed_wait": 2.0,
+                           "other": 1.0})
+    g = goodput.stitch_attempts(ev_path)
+    text = goodput.format_goodput_table(g)
+    assert "goodput ledger: 1 attempt(s), 10.0 s measured wall-clock" in text
+    assert "step_compute         7.00   70.0%" in text
+    assert "TOTAL               10.00  100.0%" in text
+    assert "goodput: 70.0% of wall-clock was productive step compute" in text
+
+
+def test_listener_failure_does_not_break_emit(tmp_path):
+    """A broken listener must never lose the run's telemetry."""
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="led")
+
+    def bad_listener(ev):
+        raise RuntimeError("boom")
+
+    w.add_listener(bad_listener)
+    w.emit(telemetry.KIND_HEALTH, health={"event": "ok"})
+    w.close()
+    evs = list(telemetry.read_events(path, strict=True))
+    assert len(evs) == 1

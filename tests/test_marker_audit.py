@@ -164,6 +164,15 @@ def test_serve_kinds_are_audited():
     assert len(serve_kinds) >= 5
 
 
+def test_observability_kinds_are_audited():
+    """Self-check for the goodput/memory layer (ISSUE 10): both kinds
+    must be extracted by the audit, so the summarized-and-test-referenced
+    requirements above actually bind them — a rename that drops them
+    from telemetry.py would otherwise fall out silently."""
+    kinds = set(_telemetry_kind_names())
+    assert {"KIND_GOODPUT", "KIND_MEMORY"} <= kinds, kinds
+
+
 COLLECTIVES_PY = (TESTS_DIR.parent / "distributed_tensorflow_framework_tpu"
                   / "parallel" / "collectives.py")
 

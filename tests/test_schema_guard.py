@@ -1,0 +1,89 @@
+"""Schema-drift guard: every KIND_* round-trips through the ONE schema.
+
+The telemetry contract (docs/OBSERVABILITY.md) is a single versioned
+record shape shared by every emitter — train loop, bench, supervisor,
+serve, goodput ledger, memory monitor. This guard makes drift a test
+failure instead of a post-mortem surprise:
+
+  * every ``KIND_*`` constant builds a valid event via ``make_event``
+    and survives JSON + ``TelemetryWriter`` → ``read_events(strict=True)``
+    round trips;
+  * the reserved top-level field set is pinned — adding a field without
+    bumping the schema version fails HERE, forcing the conscious choice
+    the RESERVED_FIELDS comment asks for;
+  * unknown top-level fields and mistyped sections are rejected.
+"""
+
+import json
+
+import pytest
+
+from distributed_tensorflow_framework_tpu.core import telemetry
+
+
+def _all_kinds() -> list[str]:
+    kinds = sorted(
+        getattr(telemetry, name)
+        for name in dir(telemetry) if name.startswith("KIND_"))
+    assert len(kinds) >= 25, kinds  # self-check: extraction saw them all
+    return kinds
+
+
+# Kind-shaped payloads: every event gets the common sections plus an
+# extra payload with the nested dicts the new kinds actually carry
+# (goodput buckets, memory analysis) — nesting must survive _to_scalar.
+def _payload(kind: str) -> dict:
+    return {
+        "step": 7,
+        "metrics": {"value": 1.5, "wall_s": 10.0},
+        "health": {"event": "guard"},
+        "buckets": {"step_compute": 8.0, "other": 2.0},
+        "analysis": {"argument_bytes": 10, "nested": {"deep": 1}},
+        "source": "guard",
+    }
+
+
+@pytest.mark.parametrize("kind", _all_kinds())
+def test_every_kind_round_trips_make_validate(kind):
+    ev = telemetry.make_event(kind, run_id="guard", **_payload(kind))
+    assert telemetry.validate_event(ev) == []
+    # The JSON wire trip must preserve validity AND the nested extras.
+    ev2 = json.loads(json.dumps(ev, default=str))
+    assert telemetry.validate_event(ev2) == []
+    assert ev2["kind"] == kind
+    assert ev2["extra"]["buckets"] == {"step_compute": 8.0, "other": 2.0}
+    assert ev2["extra"]["analysis"]["nested"] == {"deep": 1}
+
+
+def test_every_kind_survives_writer_strict_read(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="guard")
+    for kind in _all_kinds():
+        w.emit(kind, **_payload(kind))
+    w.close()
+    seen = [ev["kind"] for ev in telemetry.read_events(path, strict=True)]
+    assert seen == _all_kinds()
+
+
+def test_reserved_fields_are_pinned():
+    """Changing the top-level shape must be a conscious schema decision:
+    update this tuple AND (for additions readers depend on) the schema
+    version, not just RESERVED_FIELDS."""
+    assert telemetry.RESERVED_FIELDS == (
+        "schema", "run_id", "kind", "t", "step", "metrics", "phases",
+        "throughput", "roofline", "collectives", "health", "extra")
+    assert telemetry.SCHEMA == "dtf-telemetry/1"
+
+
+def test_unknown_top_level_field_rejected():
+    ev = telemetry.make_event(telemetry.KIND_GOODPUT, run_id="guard")
+    ev["surprise"] = 1
+    errors = telemetry.validate_event(ev)
+    assert any("surprise" in e for e in errors), errors
+
+
+def test_mistyped_section_rejected():
+    ev = telemetry.make_event(telemetry.KIND_MEMORY, run_id="guard")
+    ev["metrics"] = "not-a-mapping"
+    errors = telemetry.validate_event(ev)
+    assert any("metrics" in e for e in errors), errors

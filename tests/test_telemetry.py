@@ -507,6 +507,16 @@ def test_summarize_rolls_up_every_kind(tmp_path):
            throughput={"requests_per_sec": 10.0, "rows_per_sec": 20.0})
     w.emit(telemetry.KIND_SERVE_RECOMPILE, bucket="rows2",
            metrics={"compile_ms": 50.0})
+    w.emit(telemetry.KIND_GOODPUT, step=5,
+           metrics={"wall_s": 10.0, "goodput_frac": 0.8},
+           buckets={"step_compute": 8.0, "other": 2.0},
+           counters={"ckpt_saves": 1}, t0=1000.0, final=True)
+    w.emit(telemetry.KIND_MEMORY, step=5,
+           metrics={"bytes_in_use": 100, "peak_bytes_in_use": 200,
+                    "device_count": 8},
+           source="train", source_kind="device_memory_stats",
+           analysis={"argument_bytes": 50, "temp_bytes": 25,
+                     "output_bytes": 25, "peak_bytes_est": 100})
     w.close()
 
     s = telemetry.summarize_events(path)
@@ -526,6 +536,10 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert s["serve"]["requests"] == 1 and s["serve"]["batches"] == 1
     assert s["serve"]["queue_depth_max"] == 2
     assert s["zero"]["shards"] == 8 and s["zero"]["buckets"] == 3
+    assert s["goodput"]["attempts"] == 1
+    assert s["goodput"]["goodput_frac"] == pytest.approx(0.8)
+    assert s["memory"]["samples"] == 1
+    assert s["memory"]["peak_bytes_in_use"] == 200
     text = telemetry.format_run_summary(s)
     assert "run: config_name=lenet" in text
     assert "evals: 1 (last at step 2)" in text
@@ -536,3 +550,5 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert "serving: 1 requests (2 rows) in 1 batches" in text
     assert "bucket recompiles: 1 (rows2)" in text
     assert "zero update sharding: 8 shards, 3 buckets" in text
+    assert "goodput: 80.0% of 10.0 s wall over 1 attempt(s)" in text
+    assert "memory: 1 sample(s)" in text
