@@ -459,7 +459,9 @@ def shard_global_norm(shards: Any,
     local = sum(
         jnp.sum(jnp.square(x.astype(jnp.float32)))
         for x in jax.tree.leaves(shards))
-    return jnp.sqrt(lax.psum(local, _axes_list(axis_names)))
+    # coll.psum (not raw lax.psum) so the scalar rides the CollectiveTally
+    # ledger like every other wire transfer in the step.
+    return jnp.sqrt(coll.psum(local, _axes_list(axis_names)))
 
 
 # ------------------------------------------------------------ telemetry --

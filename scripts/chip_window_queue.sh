@@ -21,6 +21,12 @@ run() {
   return $rc
 }
 
+# 0. Preflight: graftcheck static analysis (docs/STATIC_ANALYSIS.md). A
+#    finding here means the tree has an untallied collective / broken
+#    telemetry contract — measuring it would waste the chip window on
+#    numbers the ledger can't explain. Runs on CPU, never touches the chip.
+run graftcheck env JAX_PLATFORMS=cpu python scripts/graftcheck.py || exit 1
+
 # 1. The headline number: driver-format ResNet-50 bench (expect ~2512).
 run resnet python bench.py || exit 1   # if the probe fails, stop — tunnel is down
 

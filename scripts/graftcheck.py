@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Entry point for the graftcheck static-analysis suite.
+
+Pins the CPU runtime env BEFORE jax can initialize, so the jaxpr-layer
+passes get the same 8-device CPU mesh the test suite uses (see
+tests/conftest.py for the rationale), then hands off to
+tools/graftcheck/cli.py. Usage: ``python scripts/graftcheck.py [--help]``.
+"""
+
+import os
+import pathlib
+import sys
+
+_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(_ROOT) not in sys.path:
+    sys.path.insert(0, str(_ROOT))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+from distributed_tensorflow_framework_tpu.core.platform import (  # noqa: E402
+    with_cpu_collective_timeouts,
+)
+
+os.environ["XLA_FLAGS"] = with_cpu_collective_timeouts(_flags)
+
+from tools.graftcheck import cli  # noqa: E402
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    # Default the repo root to this checkout, not the caller's cwd.
+    if not any(a == "--root" or a.startswith("--root=") for a in argv):
+        argv = ["--root", str(_ROOT)] + argv
+    return cli.main(argv)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `graftcheck.py --list-passes | head` closes stdout early; that
+        # is not a failure. Re-point stdout at devnull so the interpreter
+        # shutdown flush doesn't raise again.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,11 @@
+"""Fixture config: ``dead_knob`` is neither read nor documented."""
+
+
+def config_dataclass(cls):
+    return cls
+
+
+@config_dataclass
+class TrainConfig:
+    alpha: float = 0.1       # read by pkg/train.py and documented
+    dead_knob: int = 7       # read nowhere, documented nowhere

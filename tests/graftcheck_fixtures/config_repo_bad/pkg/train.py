@@ -1,0 +1,2 @@
+def step(cfg, x):
+    return x * cfg.alpha

@@ -1,0 +1,11 @@
+"""Fixture config: every knob read and documented."""
+
+
+def config_dataclass(cls):
+    return cls
+
+
+@config_dataclass
+class TrainConfig:
+    alpha: float = 0.1
+    axis_name: str = "data"   # consumed as a string constant in train.py

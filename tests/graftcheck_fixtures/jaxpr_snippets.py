@@ -1,0 +1,35 @@
+"""Traceable functions for the jaxpr-layer audits (tests/test_graftcheck_jaxpr.py).
+
+Imported lazily by the jaxpr tests (never collected by pytest, never
+scanned by the AST passes — this tree is fixture territory). The raw
+``lax.psum`` in ``census_bad`` is the point of the fixture: a collective
+with no CollectiveTally row, exactly what the census pass must catch.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_tensorflow_framework_tpu.parallel import collectives as coll
+
+
+# --- jaxpr-f32-upcast -----------------------------------------------------
+def upcast_bad(x, w):
+    """bf16 operands widened to f32 right before the matmul — the silent
+    full-precision GEMM the pass exists to flag."""
+    return jnp.dot(x.astype(jnp.float32), w.astype(jnp.float32))
+
+
+def upcast_clean(x, w):
+    """The matmul runs at the operands' bf16 dtype."""
+    return jnp.dot(x, w)
+
+
+# --- jaxpr-collective-census ----------------------------------------------
+def census_bad(x):
+    """Raw lax.psum: the jaxpr gets a psum op, the tally gets nothing."""
+    return lax.psum(x, "data")
+
+
+def census_clean(x):
+    """Tallied wrapper: one tally row per psum op in the jaxpr."""
+    return coll.psum(x, "data")

@@ -1,0 +1,9 @@
+"""Fixture: a DRIVER drill module whose test lacks @pytest.mark.slow."""
+
+import pytest  # noqa: F401
+
+DRIVER = "import sys; sys.exit(0)"
+
+
+def test_crash_drill_without_mark(tmp_path):
+    assert DRIVER

@@ -1,0 +1,10 @@
+"""Fixture: the same drill, correctly marked slow."""
+
+import pytest
+
+DRIVER = "import sys; sys.exit(0)"
+
+
+@pytest.mark.slow
+def test_crash_drill_with_mark(tmp_path):
+    assert DRIVER

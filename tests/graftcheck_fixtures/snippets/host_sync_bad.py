@@ -1,0 +1,14 @@
+"""Fixture: host-synchronization patterns banned from step-builder code."""
+
+import numpy as np
+
+import jax
+
+
+def collect_metrics(loss, metrics, x):
+    scalar = loss.item()                    # device→host sync
+    as_float = float(metrics["accuracy"])   # implicit device_get
+    host = np.asarray(x)                    # numpy materializes on host
+    fetched = jax.device_get(metrics)       # explicit fetch
+    x.block_until_ready()                   # queue drain
+    return scalar, as_float, host, fetched

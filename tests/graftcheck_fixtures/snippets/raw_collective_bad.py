@@ -1,0 +1,17 @@
+"""Fixture: every spelling of a raw collective the pass must catch."""
+
+import jax
+from jax import lax
+from jax.lax import psum  # banned import spelling
+
+
+def mean_grads(g):
+    return lax.pmean(g, "data")  # banned: bypasses CollectiveTally
+
+
+def gather_params(p):
+    return jax.lax.all_gather(p, "fsdp", tiled=True)  # banned: jax.lax attr
+
+
+def reduce_direct(x):
+    return psum(x, "data")  # call through the banned import

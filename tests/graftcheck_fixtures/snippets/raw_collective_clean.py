@@ -1,0 +1,15 @@
+"""Fixture: the tallied wrappers — what the pass must NOT flag."""
+
+from distributed_tensorflow_framework_tpu.parallel import collectives as coll
+
+
+def mean_grads(g):
+    return coll.pmean(g, "data")
+
+
+def gather_params(p):
+    return coll.all_gather(p, "fsdp", tiled=True)
+
+
+def shift(x):
+    return coll.ppermute_shift(x, "pipe", shift=1)

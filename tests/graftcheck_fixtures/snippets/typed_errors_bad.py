@@ -1,0 +1,14 @@
+"""Fixture: every typed-error-convention violation."""
+
+
+class BadFailure(ValueError):  # not *Error-named, no docstring
+    pass
+
+
+def check(n):
+    if n < 0:
+        raise Exception("negative")  # anonymous raise
+    try:
+        return 1 / n
+    except:  # noqa: E722 — bare except, swallows SystemExit
+        return 0

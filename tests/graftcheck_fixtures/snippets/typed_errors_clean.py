@@ -1,0 +1,14 @@
+"""Fixture: the typed-error conventions done right."""
+
+
+class NegativeInputError(ValueError):
+    """Raised on negative input; callers map it to a usage exit code."""
+
+
+def check(n):
+    if n < 0:
+        raise NegativeInputError(f"n must be >= 0, got {n}")
+    try:
+        return 1 / n
+    except ZeroDivisionError:
+        return 0

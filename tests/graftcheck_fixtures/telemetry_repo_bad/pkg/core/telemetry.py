@@ -1,0 +1,14 @@
+"""Fixture telemetry: an orphan kind and a duplicate kind value."""
+
+KIND_GOOD = "good"
+KIND_ORPHAN = "orphan"   # in no rollup, in no test
+KIND_DUP_A = "dup"       # same value as KIND_DUP_B — rollups can't
+KIND_DUP_B = "dup"       # tell the two apart
+
+
+def summarize_events(events):
+    return {KIND_GOOD: len(events), KIND_DUP_A: 0, KIND_DUP_B: 0}
+
+
+def format_run_summary(summary):
+    return str(summary)

@@ -1,0 +1,2 @@
+def test_kinds():
+    assert "KIND_GOOD" and "KIND_DUP_A" and "KIND_DUP_B"

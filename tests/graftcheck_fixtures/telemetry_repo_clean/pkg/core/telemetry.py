@@ -1,0 +1,12 @@
+"""Fixture telemetry: every kind summarized and test-referenced."""
+
+KIND_GOOD = "good"
+KIND_OTHER = "other"
+
+
+def summarize_events(events):
+    return {KIND_GOOD: len(events), KIND_OTHER: 0}
+
+
+def format_run_summary(summary):
+    return str(summary)

@@ -1,0 +1,385 @@
+"""graftcheck: AST-layer passes, suppressions, report schema, CLI.
+
+Two jobs (ISSUE 11):
+
+  * prove every pass LIVE — each must produce findings on its ``*_bad``
+    fixture (tests/graftcheck_fixtures/) and stay silent on the clean
+    twin; a lint that never fires is indistinguishable from no lint;
+  * hold the repo itself clean — the self-audit runs the registered
+    passes over this checkout in tier-1 and asserts zero unsuppressed
+    findings, making graftcheck's rules part of the PR gate.
+
+The jaxpr-layer twins live in tests/test_graftcheck_jaxpr.py.
+"""
+
+import ast
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from tools.graftcheck import ast_passes, cli, registry
+from tools.graftcheck.context import RepoContext, git_changed_files
+from tools.graftcheck.findings import (
+    Finding,
+    REPORT_SCHEMA,
+    SEVERITY_INTERNAL,
+    apply_suppressions,
+    build_report,
+    load_suppressions,
+    round_trip,
+    validate_report,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+FIX = pathlib.Path(__file__).resolve().parent / "graftcheck_fixtures"
+SNIP = FIX / "snippets"
+
+
+def _tree(path: pathlib.Path) -> ast.Module:
+    return ast.parse(path.read_text(), filename=str(path))
+
+
+def _fixture_ctx(name: str) -> RepoContext:
+    return RepoContext(FIX / name, package="pkg")
+
+
+# ---------------------------------------------------------------- registry --
+def test_registry_has_the_advertised_pass_set():
+    ids = set(registry.PASSES)
+    assert {"raw-collective", "host-sync-in-step", "config-knob-coverage",
+            "telemetry-kind-coverage", "slow-marker", "typed-errors",
+            "jaxpr-donation", "jaxpr-f32-upcast",
+            "jaxpr-collective-census"} <= ids
+    assert len(ids) >= 8
+    jaxpr = registry.passes_for_layer(registry.LAYER_JAXPR)
+    assert len(jaxpr) >= 2
+
+
+def test_duplicate_pass_id_rejected():
+    with pytest.raises(ValueError, match="duplicate"):
+        registry.register("raw-collective", registry.LAYER_AST, "dup")(
+            lambda ctx: [])
+    with pytest.raises(ValueError, match="unknown layer"):
+        registry.register("brand-new", "nope", "bad layer")(lambda ctx: [])
+
+
+# --------------------------------------------------- per-file pass fixtures --
+def test_raw_collective_pass_fires_on_bad_fixture():
+    path = SNIP / "raw_collective_bad.py"
+    findings = ast_passes.scan_raw_collectives("snip.py", _tree(path))
+    assert len(findings) == 3, [f.message for f in findings]
+    msgs = " ".join(f.message for f in findings)
+    assert "pmean" in msgs and "all_gather" in msgs and "psum" in msgs
+
+
+def test_raw_collective_pass_silent_on_clean_fixture():
+    path = SNIP / "raw_collective_clean.py"
+    assert ast_passes.scan_raw_collectives("snip.py", _tree(path)) == []
+
+
+def test_host_sync_pass_fires_on_bad_fixture():
+    path = SNIP / "host_sync_bad.py"
+    findings = ast_passes.scan_host_sync("snip.py", _tree(path))
+    msgs = " ".join(f.message for f in findings)
+    assert len(findings) == 5, [f.message for f in findings]
+    for marker in (".item", "device_get", "block_until_ready", "numpy",
+                   "float()"):
+        assert marker in msgs, marker
+
+
+def test_host_sync_pass_silent_on_clean_fixture():
+    # float(4) on a literal is NOT a device sync and must not be flagged.
+    path = SNIP / "host_sync_clean.py"
+    assert ast_passes.scan_host_sync("snip.py", _tree(path)) == []
+
+
+def test_typed_errors_pass_fires_on_bad_fixture():
+    path = SNIP / "typed_errors_bad.py"
+    findings = ast_passes.scan_typed_errors("snip.py", _tree(path))
+    msgs = " ".join(f.message for f in findings)
+    assert len(findings) == 4, [f.message for f in findings]
+    assert "raise Exception" in msgs
+    assert "bare" in msgs
+    assert "named" in msgs  # BadFailure must be *Error
+    assert "docstring" in msgs
+
+
+def test_typed_errors_pass_silent_on_clean_fixture():
+    path = SNIP / "typed_errors_clean.py"
+    assert ast_passes.scan_typed_errors("snip.py", _tree(path)) == []
+
+
+# ---------------------------------------------------- mini-repo pass fixtures --
+def test_config_coverage_fires_on_dead_knob():
+    findings = ast_passes.config_coverage_pass(_fixture_ctx("config_repo_bad"))
+    assert len(findings) == 2, [f.message for f in findings]
+    assert all("dead_knob" in f.message for f in findings)
+    kinds = {("never read" in f.message, "nowhere in docs" in f.message)
+             for f in findings}
+    assert kinds == {(True, False), (False, True)}
+
+
+def test_config_coverage_silent_on_clean_repo():
+    # alpha is read as an attribute, axis_name as a string constant — both
+    # count as consumption, both documented.
+    findings = ast_passes.config_coverage_pass(
+        _fixture_ctx("config_repo_clean"))
+    assert findings == [], [f.message for f in findings]
+
+
+def test_telemetry_coverage_fires_on_orphan_and_duplicate_kinds():
+    findings = ast_passes.telemetry_coverage_pass(
+        _fixture_ctx("telemetry_repo_bad"))
+    msgs = [f.message for f in findings]
+    assert len(findings) == 3, msgs
+    assert any("share the string value" in m for m in msgs)
+    assert any("KIND_ORPHAN" in m and "rollup" in m for m in msgs)
+    assert any("KIND_ORPHAN" in m and "no test" in m for m in msgs)
+
+
+def test_telemetry_coverage_silent_on_clean_repo():
+    findings = ast_passes.telemetry_coverage_pass(
+        _fixture_ctx("telemetry_repo_clean"))
+    assert findings == [], [f.message for f in findings]
+
+
+def test_slow_marker_fires_on_unmarked_drill():
+    findings = ast_passes.slow_marker_pass(_fixture_ctx("marker_repo_bad"))
+    assert len(findings) == 1, [f.message for f in findings]
+    assert "test_crash_drill_without_mark" in findings[0].message
+
+
+def test_slow_marker_silent_on_marked_drill():
+    findings = ast_passes.slow_marker_pass(_fixture_ctx("marker_repo_clean"))
+    assert findings == [], [f.message for f in findings]
+
+
+# ------------------------------------------------------------ suppressions --
+def test_suppression_file_parsing(tmp_path):
+    sup = tmp_path / "sup.txt"
+    sup.write_text(
+        "# comment\n"
+        "\n"
+        "raw-collective | tests/foo.py:* | parity reference\n"
+        "only | twofields\n"
+        "typed-errors | pkg/x.py:3 |\n")
+    sups, findings = load_suppressions(sup)
+    assert len(sups) == 1
+    assert sups[0].pass_id == "raw-collective"
+    assert sups[0].justification == "parity reference"
+    # Malformed line + missing justification both become findings.
+    assert len(findings) == 2
+    assert all(f.pass_id == "suppressions" for f in findings)
+
+
+def load_suppressions_from_lines(*lines):
+    import tempfile
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".txt", delete=False) as fh:
+        fh.write("\n".join(lines) + "\n")
+        name = fh.name
+    return load_suppressions(name)
+
+
+def test_suppression_matching_marks_and_copies_justification():
+    f1 = Finding("raw-collective", "tests/foo.py:7", "raw psum")
+    f2 = Finding("raw-collective", "pkg/bar.py:9", "raw psum")
+    sups, _ = load_suppressions_from_lines(
+        "raw-collective | tests/foo.py:* | known parity test")
+    stale = apply_suppressions([f1, f2], sups)
+    assert f1.suppressed and f1.justification == "known parity test"
+    assert not f2.suppressed
+    assert stale == []
+
+
+def test_stale_suppression_is_a_finding():
+    sups, _ = load_suppressions_from_lines(
+        "typed-errors | nowhere.py:* | obsolete")
+    stale = apply_suppressions([], sups, suppression_file="sup.txt")
+    assert len(stale) == 1
+    assert "stale suppression" in stale[0].message
+    assert stale[0].where == "sup.txt:1"
+
+
+def test_stale_check_scoped_to_passes_run():
+    # Partial runs (--layer/--pass) must not call suppressions for unrun
+    # passes stale.
+    sups, _ = load_suppressions_from_lines(
+        "jaxpr-f32-upcast | trace:* | intentional f32 head")
+    stale = apply_suppressions([], sups, stale_check_ids={"raw-collective"})
+    assert stale == []
+    stale = apply_suppressions([], sups,
+                               stale_check_ids={"jaxpr-f32-upcast"})
+    assert len(stale) == 1
+
+
+def test_internal_errors_are_not_suppressible():
+    f = Finding("telemetry-kind-coverage", "core/telemetry.py",
+                "extraction degraded", severity=SEVERITY_INTERNAL)
+    sups, _ = load_suppressions_from_lines(
+        "* | * | sweep everything under the rug")
+    apply_suppressions([f], sups)
+    assert not f.suppressed
+
+
+# ------------------------------------------------------------ report schema --
+def test_report_builds_validates_and_round_trips():
+    findings = [
+        Finding("raw-collective", "pkg/a.py:1", "raw psum"),
+        Finding("raw-collective", "tests/b.py:2", "raw psum",
+                suppressed=True, justification="parity"),
+        Finding("slow-marker", "tests/c.py", "vacuous",
+                severity=SEVERITY_INTERNAL),
+    ]
+    report = build_report(findings, ["raw-collective", "slow-marker"], ROOT)
+    assert report["schema"] == REPORT_SCHEMA
+    assert report["counts"] == {
+        "findings": 2, "suppressed": 1, "internal_errors": 1}
+    assert validate_report(report) == []
+    assert round_trip(report) == json.loads(json.dumps(report))
+    assert Finding.from_dict(report["findings"][0]).fingerprint == \
+        "raw-collective|pkg/a.py:1"
+
+
+def test_report_validation_catches_shape_violations():
+    assert validate_report({}) != []
+    bad = build_report([Finding("p", "w", "m")], ["p"], ROOT)
+    bad["schema"] = "dtf-lint-report/0"
+    bad["findings"][0]["severity"] = "warning"
+    del bad["counts"]["findings"]
+    errs = validate_report(bad)
+    assert any("schema" in e for e in errs)
+    assert any("severity" in e for e in errs)
+    assert any("counts.findings" in e for e in errs)
+
+
+# --------------------------------------------------------------------- CLI --
+def _no_sup(tmp_path):
+    return str(tmp_path / "empty_suppressions.txt")
+
+
+def test_cli_exit_findings_on_bad_repo(tmp_path, capsys):
+    rc = cli.main(["--root", str(FIX / "marker_repo_bad"),
+                   "--pass", "slow-marker",
+                   "--suppressions", _no_sup(tmp_path)])
+    assert rc == cli.EXIT_FINDINGS
+    out = capsys.readouterr().out
+    assert "slow-marker" in out and "1 finding(s)" in out
+
+
+def test_cli_exit_clean_on_clean_repo(tmp_path, capsys):
+    rc = cli.main(["--root", str(FIX / "marker_repo_clean"),
+                   "--pass", "slow-marker",
+                   "--suppressions", _no_sup(tmp_path)])
+    assert rc == cli.EXIT_CLEAN
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_cli_exit_internal_when_a_pass_cannot_run(tmp_path, capsys):
+    # jaxpr passes refuse to run against a repo without the real package —
+    # that's an internal error (exit 2), never a clean bill of health.
+    rc = cli.main(["--root", str(FIX / "marker_repo_bad"),
+                   "--pass", "jaxpr-donation",
+                   "--suppressions", _no_sup(tmp_path)])
+    assert rc == cli.EXIT_INTERNAL
+    assert "[internal]" in capsys.readouterr().out
+
+
+def test_cli_exit_usage_on_unknown_pass(tmp_path, capsys):
+    rc = cli.main(["--root", str(ROOT), "--pass", "no-such-pass",
+                   "--suppressions", _no_sup(tmp_path)])
+    assert rc == cli.EXIT_USAGE
+
+
+def test_cli_exit_usage_on_bad_flag():
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["--no-such-flag"])
+    assert exc.value.code == cli.EXIT_USAGE
+
+
+def test_cli_list_passes(capsys):
+    assert cli.main(["--list-passes"]) == cli.EXIT_CLEAN
+    out = capsys.readouterr().out
+    for pid in registry.PASSES:
+        assert pid in out
+
+
+def test_cli_json_report_is_valid(tmp_path, capsys):
+    report_path = tmp_path / "report.json"
+    rc = cli.main(["--root", str(FIX / "marker_repo_bad"),
+                   "--pass", "slow-marker",
+                   "--suppressions", _no_sup(tmp_path),
+                   "--json", str(report_path), "--format", "json"])
+    assert rc == cli.EXIT_FINDINGS
+    stdout_report = json.loads(capsys.readouterr().out)
+    file_report = json.loads(report_path.read_text())
+    assert validate_report(file_report) == []
+    assert file_report == stdout_report
+    assert file_report["counts"]["findings"] == 1
+    assert file_report["passes_run"] == ["slow-marker"]
+
+
+# ----------------------------------------------------------- changed mode --
+def test_git_changed_files_sees_modified_and_untracked(tmp_path):
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True)
+    git("init", "-q")
+    git("config", "user.email", "t@example.com")
+    git("config", "user.name", "t")
+    (tmp_path / "a.py").write_text("x = 1\n")
+    git("add", "a.py")
+    git("commit", "-qm", "seed")
+    (tmp_path / "a.py").write_text("x = 2\n")
+    (tmp_path / "b.py").write_text("y = 1\n")
+    assert git_changed_files(tmp_path) == {"a.py", "b.py"}
+
+
+def test_changed_mode_skips_unanchored_repo_passes():
+    parser = cli.build_parser()
+    args = parser.parse_args(["--changed"])
+    # An unrelated file: anchored repo-wide passes (config/telemetry/
+    # slow-marker) drop out, per-file passes and jaxpr stay filtered too.
+    ids = {p.pass_id for p in cli.select_passes(args, {"some/other.py"})}
+    assert "config-knob-coverage" not in ids
+    assert "telemetry-kind-coverage" not in ids
+    assert "jaxpr-donation" not in ids  # jaxpr is opt-in under --changed
+    assert "raw-collective" in ids      # per-file: self-restricts
+    # Touching an anchor pulls the repo-wide pass back in.
+    ids = {p.pass_id for p in cli.select_passes(args, {"docs/CONFIG.md"})}
+    assert "config-knob-coverage" in ids
+
+
+def test_changed_mode_restricts_per_file_scan():
+    ctx = RepoContext(ROOT, changed=set())
+    assert ast_passes.raw_collective_pass(ctx) == []
+    assert ast_passes.typed_errors_pass(ctx) == []
+
+
+# -------------------------------------------------------------- self-audit --
+def test_self_audit_repo_is_clean_ast_layer():
+    """Tier-1 gate: every AST pass over this checkout, real suppression
+    file applied — zero unsuppressed findings, zero internal errors, and
+    the suppression file itself parses clean."""
+    ctx = RepoContext(ROOT)
+    findings = []
+    for info in registry.passes_for_layer(registry.LAYER_AST):
+        findings.extend(info.fn(ctx))
+    sups, parse_findings = load_suppressions(cli.DEFAULT_SUPPRESSIONS)
+    assert parse_findings == [], [f.message for f in parse_findings]
+    apply_suppressions(findings, sups)
+    active = [f for f in findings if not f.suppressed]
+    assert active == [], [(f.pass_id, f.where, f.message) for f in active]
+
+
+def test_self_audit_cli_full_run_is_clean():
+    """End-to-end acceptance: the shipped entry point, all layers, exit 0.
+    Subprocess so the env-pinning in scripts/graftcheck.py is exercised."""
+    res = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "graftcheck.py")],
+        capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "0 finding(s)" in res.stdout

@@ -1,153 +1,86 @@
 """Structural audits: pytest markers and telemetry-kind coverage.
 
-Marker audit — subprocess training drills must be tier-2. Tier-1
-(``-m "not slow"``) is the under-15-minute gate every PR runs; a
-subprocess drill that launches real training children (the DRIVER
-template of tests/test_fault_tolerance.py) costs minutes each and belongs
-behind the ``slow`` marker. This audit makes that a checked property
-instead of a review convention, so new drills (e.g. the async crash
-drills) can't silently land in tier-1.
-
-Telemetry audit — every ``KIND_*`` constant in core/telemetry.py must be
-rolled up by ``summarize_events``/``format_run_summary`` and referenced
-by at least one test: an event kind nothing summarizes is invisible in
-exactly the post-mortems it was added for, and one no test references
-can silently rot (ISSUE 6 satellite).
-
-Pure ast — no test collection, no imports of the audited modules.
+Thin shim (ISSUE 11): the ast logic that used to live here was promoted
+into the graftcheck suite — the ``slow-marker`` and
+``telemetry-kind-coverage`` passes in tools/graftcheck/ast_passes.py —
+where it also runs via ``python scripts/graftcheck.py`` and the tier-1
+self-audit in tests/test_graftcheck.py. These tests keep the original
+one-property-per-test entry points (so a regression names the property,
+not just "graftcheck failed") by delegating to the shared pass
+implementations instead of duplicating them.
 """
 
 import ast
 import pathlib
 
+from tools.graftcheck import ast_passes
+from tools.graftcheck.context import RepoContext
+from tools.graftcheck.findings import SEVERITY_INTERNAL
+
 TESTS_DIR = pathlib.Path(__file__).resolve().parent
-TELEMETRY_PY = (TESTS_DIR.parent / "distributed_tensorflow_framework_tpu"
+ROOT = TESTS_DIR.parent
+TELEMETRY_PY = (ROOT / "distributed_tensorflow_framework_tpu"
                 / "core" / "telemetry.py")
 
-# Module-level names that mark a file as a subprocess-training-drill
-# module: the DRIVER template itself, importing it from the fault
-# tolerance suite, or any specialized sibling template named *_DRIVER
-# (e.g. the recovery drills' RECOVERY_DRIVER).
-_DRIVER_NAME = "DRIVER"
+
+def _slow_marker_findings():
+    return ast_passes.slow_marker_pass(RepoContext(ROOT))
 
 
-def _is_driver_name(name: str) -> bool:
-    return name == _DRIVER_NAME or name.endswith("_" + _DRIVER_NAME)
+def _telemetry_findings():
+    return ast_passes.telemetry_coverage_pass(RepoContext(ROOT))
 
 
-def _decorator_marks(fn: ast.FunctionDef) -> set[str]:
-    """Names of pytest.mark.* decorators on a test function."""
-    marks: set[str] = set()
-    for dec in fn.decorator_list:
-        node = dec.func if isinstance(dec, ast.Call) else dec
-        # pytest.mark.<name> is Attribute(Attribute(Name('pytest'),'mark'),name)
-        if (isinstance(node, ast.Attribute)
-                and isinstance(node.value, ast.Attribute)
-                and node.value.attr == "mark"):
-            marks.add(node.attr)
-    return marks
-
-
-def _defines_or_imports_driver(tree: ast.Module) -> bool:
-    for node in tree.body:
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and _is_driver_name(t.id):
-                    return True
-        if isinstance(node, ast.ImportFrom):
-            if any(_is_driver_name(a.name) for a in node.names):
-                return True
-    return False
-
-
-def _uses_driver(fn: ast.FunctionDef) -> bool:
-    """Whether the function references DRIVER (directly or via a local
-    ``from ... import DRIVER``) — the signature of launching a real
-    training child."""
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Name) and _is_driver_name(node.id):
-            return True
-        if isinstance(node, ast.ImportFrom) and \
-                any(_is_driver_name(a.name) for a in node.names):
-            return True
-    return False
+def _kind_names() -> set[str]:
+    tree = ast.parse(TELEMETRY_PY.read_text())
+    return set(ast_passes._module_const_assigns(tree, "KIND_"))
 
 
 def test_subprocess_drills_carry_slow_marker():
-    offenders = []
-    for path in sorted(TESTS_DIR.glob("test_*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        module_wide = _defines_or_imports_driver(tree)
-        for node in tree.body:
-            if not (isinstance(node, ast.FunctionDef)
-                    and node.name.startswith("test_")):
-                continue
-            if not (module_wide or _uses_driver(node)):
-                continue
-            if "slow" not in _decorator_marks(node):
-                offenders.append(f"{path.name}::{node.name}")
-    assert not offenders, (
-        "subprocess training drills missing @pytest.mark.slow (they launch "
-        f"real training children and must stay out of tier-1): {offenders}"
-    )
-
-
-def _telemetry_kind_names() -> list[str]:
-    tree = ast.parse(TELEMETRY_PY.read_text())
-    names = []
-    for node in tree.body:
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id.startswith("KIND_"):
-                    names.append(t.id)
-    return names
-
-
-def _function_source(tree: ast.Module, source: str, name: str) -> str:
-    for node in tree.body:
-        if isinstance(node, ast.FunctionDef) and node.name == name:
-            return ast.get_source_segment(source, node) or ""
-    raise AssertionError(f"{name} not found in {TELEMETRY_PY}")
+    findings = _slow_marker_findings()
+    assert not findings, [f.message for f in findings]
 
 
 def test_every_telemetry_kind_is_summarized():
     """Each KIND_* must appear (by constant name) in the combined source
     of summarize_events + format_run_summary — the rollup surface
     scripts/analyze_trace.py prints."""
-    source = TELEMETRY_PY.read_text()
-    tree = ast.parse(source)
-    rollup_src = (_function_source(tree, source, "summarize_events")
-                  + _function_source(tree, source, "format_run_summary"))
-    kinds = _telemetry_kind_names()
-    assert len(kinds) >= 20, kinds  # self-check: extraction saw them
-    missing = [k for k in kinds if k not in rollup_src]
-    assert not missing, (
-        "telemetry kinds with no summarize_events/format_run_summary "
-        f"rollup: {missing}"
-    )
+    bad = [f for f in _telemetry_findings()
+           if "rollup" in f.message and "KIND_" in f.message]
+    assert not bad, [f.message for f in bad]
 
 
 def test_every_telemetry_kind_is_referenced_by_a_test():
-    corpus = "".join(
-        p.read_text() for p in sorted(TESTS_DIR.glob("test_*.py")))
-    missing = [k for k in _telemetry_kind_names() if k not in corpus]
-    assert not missing, f"telemetry kinds no test references: {missing}"
+    bad = [f for f in _telemetry_findings()
+           if "referenced by no test" in f.message and "KIND_" in f.message]
+    assert not bad, [f.message for f in bad]
+
+
+def test_telemetry_audit_is_not_vacuous():
+    """The pass carries its own vacuity guards (>= 20 kinds extracted,
+    rollup functions found) as internal-error findings — none may fire."""
+    internal = [f for f in _telemetry_findings()
+                if f.severity == SEVERITY_INTERNAL]
+    assert not internal, [f.message for f in internal]
+    assert len(_kind_names()) >= 20
 
 
 def test_audit_sees_the_known_drills():
     """Self-check: the audit must actually recognize the existing drill
-    modules — an audit that matches nothing passes vacuously."""
+    modules — an audit that matches nothing passes vacuously. (The pass
+    itself re-checks test_fault_tolerance.py recognition as an
+    internal-error finding; this pins the full known-drill set.)"""
     ft = ast.parse((TESTS_DIR / "test_fault_tolerance.py").read_text())
-    assert _defines_or_imports_driver(ft)
+    assert ast_passes.module_defines_driver(ft)
     ac = ast.parse((TESTS_DIR / "test_async_ckpt.py").read_text())
     drill = next(n for n in ac.body
                  if isinstance(n, ast.FunctionDef)
                  and n.name == "test_supervised_crash_in_save_drill_async")
-    assert _uses_driver(drill)
-    assert {"slow", "slowest"} <= _decorator_marks(drill)
+    assert ast_passes.function_uses_driver(drill)
+    assert {"slow", "slowest"} <= ast_passes._decorator_marks(drill)
     # Specialized *_DRIVER templates count too (recovery-ladder drills).
     rd = ast.parse((TESTS_DIR / "test_recovery_drills.py").read_text())
-    assert _defines_or_imports_driver(rd)
+    assert ast_passes.module_defines_driver(rd)
 
 
 def test_serve_kinds_are_audited():
@@ -155,8 +88,7 @@ def test_serve_kinds_are_audited():
     events: all five KIND_SERVE_* constants must be extracted (a rename
     that drops the prefix would silently fall out of the serving
     rollup's audit trail)."""
-    serve_kinds = {k for k in _telemetry_kind_names()
-                   if k.startswith("KIND_SERVE_")}
+    serve_kinds = {k for k in _kind_names() if k.startswith("KIND_SERVE_")}
     assert serve_kinds >= {
         "KIND_SERVE_REQUEST", "KIND_SERVE_BATCH", "KIND_SERVE_QUEUE",
         "KIND_SERVE_LATENCY", "KIND_SERVE_RECOMPILE",
@@ -169,44 +101,23 @@ def test_observability_kinds_are_audited():
     must be extracted by the audit, so the summarized-and-test-referenced
     requirements above actually bind them — a rename that drops them
     from telemetry.py would otherwise fall out silently."""
-    kinds = set(_telemetry_kind_names())
-    assert {"KIND_GOODPUT", "KIND_MEMORY"} <= kinds, kinds
-
-
-COLLECTIVES_PY = (TESTS_DIR.parent / "distributed_tensorflow_framework_tpu"
-                  / "parallel" / "collectives.py")
-
-
-def _tally_total_fields() -> list[str]:
-    """The TALLY_TOTAL_FIELDS tuple from parallel/collectives.py, by ast
-    (same no-import discipline as the KIND_* audit)."""
-    tree = ast.parse(COLLECTIVES_PY.read_text())
-    for node in tree.body:
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "TALLY_TOTAL_FIELDS":
-                    return [ast.literal_eval(e) for e in node.value.elts]
-    raise AssertionError(f"TALLY_TOTAL_FIELDS not found in {COLLECTIVES_PY}")
+    assert {"KIND_GOODPUT", "KIND_MEMORY"} <= _kind_names()
 
 
 def test_every_tally_total_field_is_rolled_up():
     """Each grand-total field the CollectiveTally emits must surface in
-    the telemetry rollup (summarize_events/format_run_summary source) —
-    a total the post-mortem summary never prints silently rots, exactly
-    like an unsummarized KIND_*."""
-    fields = _tally_total_fields()
-    assert "total_bytes" in fields and "total_logical_bytes" in fields
-    source = TELEMETRY_PY.read_text()
-    tree = ast.parse(source)
-    rollup_src = (_function_source(tree, source, "summarize_events")
-                  + _function_source(tree, source, "format_run_summary"))
-    missing = [f for f in fields if f not in rollup_src]
-    assert not missing, (
-        f"CollectiveTally total fields with no telemetry rollup: {missing}")
+    the telemetry rollup — a total the post-mortem summary never prints
+    silently rots, exactly like an unsummarized KIND_*. The pass also
+    pins total_bytes/total_logical_bytes staying in TALLY_TOTAL_FIELDS
+    (internal-error finding on loss)."""
+    bad = [f for f in _telemetry_findings()
+           if "CollectiveTally total field" in f.message
+           and "rollup" in f.message]
+    assert not bad, [f.message for f in bad]
 
 
 def test_every_tally_total_field_is_referenced_by_a_test():
-    corpus = "".join(
-        p.read_text() for p in sorted(TESTS_DIR.glob("test_*.py")))
-    missing = [f for f in _tally_total_fields() if f not in corpus]
-    assert not missing, f"tally total fields no test references: {missing}"
+    bad = [f for f in _telemetry_findings()
+           if "CollectiveTally total field" in f.message
+           and "referenced by no test" in f.message]
+    assert not bad, [f.message for f in bad]

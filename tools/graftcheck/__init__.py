@@ -1,0 +1,34 @@
+"""graftcheck — framework-aware static analysis for this repo.
+
+Two layers (docs/STATIC_ANALYSIS.md):
+
+  * **ast** — stdlib ``ast`` passes over the package and tests: raw-collective
+    ban, host-sync-in-step, config-knob coverage, telemetry-kind coverage,
+    slow-marker audit, typed-error conventions.
+  * **jaxpr** — trace audits that jit-trace the real train step on the
+    8-device CPU mesh and walk the ClosedJaxpr: donation elision, f32
+    upcasts of bf16/int8-designated tensors, and the collective-op census
+    cross-checked against the ``CollectiveTally`` the same trace records.
+
+Entry point: ``scripts/graftcheck.py`` (human table + ``dtf-lint-report/1``
+JSON, per-finding suppression file, distinct exit codes). The suite is
+self-enforcing: ``tests/test_graftcheck.py::test_self_audit_repo_is_clean``
+runs it over the repo in tier-1 and asserts zero findings.
+
+Importing this package registers every pass; jax itself is imported lazily
+inside the jaxpr-layer pass bodies so AST-only runs (``--changed``
+pre-commit mode) stay dependency-light and fast.
+"""
+
+from tools.graftcheck.findings import (  # noqa: F401
+    Finding,
+    REPORT_SCHEMA,
+    build_report,
+    load_suppressions,
+    validate_report,
+)
+from tools.graftcheck.registry import PASSES, get_pass, passes_for_layer  # noqa: F401
+
+# Importing the pass modules registers them.
+from tools.graftcheck import ast_passes as _ast_passes  # noqa: E402,F401
+from tools.graftcheck import jaxpr_passes as _jaxpr_passes  # noqa: E402,F401

@@ -1,0 +1,468 @@
+"""Layer-1 (source/AST) passes.
+
+Each pass is registered with the shared registry and reads the repo
+exclusively through a :class:`~tools.graftcheck.context.RepoContext`, so
+the identical logic runs against the real repo (self-audit) and against the
+fixture mini-repos under ``tests/graftcheck_fixtures/``. File-level helpers
+(``scan_raw_collectives`` etc.) are public so the fixture tests exercise
+each rule on a single file without constructing a whole context.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from tools.graftcheck.context import DEFAULT_PACKAGE, RepoContext
+from tools.graftcheck.findings import Finding
+from tools.graftcheck.registry import LAYER_AST, register
+
+# ------------------------------------------------------------------------
+# raw-collective: lax.psum & friends outside parallel/ bypass the
+# CollectiveTally byte accounting (PR 7's wire-byte honesty contract).
+# ------------------------------------------------------------------------
+
+BANNED_COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+    "psum_scatter", "all_to_all", "pbroadcast",
+})
+COLLECTIVE_EXEMPT_SUBDIR = "parallel"
+
+
+def _is_lax(node: ast.expr) -> bool:
+    """``lax`` or ``jax.lax`` (the two in-repo spellings)."""
+    if isinstance(node, ast.Name):
+        return node.id == "lax"
+    return (isinstance(node, ast.Attribute) and node.attr == "lax"
+            and isinstance(node.value, ast.Name) and node.value.id == "jax")
+
+
+def scan_raw_collectives(rel: str, tree: ast.Module) -> list[Finding]:
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute)
+                and node.attr in BANNED_COLLECTIVES and _is_lax(node.value)):
+            out.append(Finding(
+                "raw-collective", f"{rel}:{node.lineno}",
+                f"raw lax.{node.attr} bypasses the CollectiveTally byte "
+                f"accounting — use the parallel/collectives.py wrapper (or "
+                f"add a justified suppression)"))
+        if (isinstance(node, ast.ImportFrom) and node.module == "jax.lax"
+                and any(a.name in BANNED_COLLECTIVES for a in node.names)):
+            names = [a.name for a in node.names if a.name in BANNED_COLLECTIVES]
+            out.append(Finding(
+                "raw-collective", f"{rel}:{node.lineno}",
+                f"importing {names} from jax.lax invites untallied "
+                f"collectives — use parallel/collectives.py wrappers"))
+    return out
+
+
+@register(
+    "raw-collective", LAYER_AST,
+    "ban raw lax collectives outside parallel/ (they bypass the wire-byte "
+    "tally the int8-compression numbers are benchmarked on)")
+def raw_collective_pass(ctx: RepoContext) -> list[Finding]:
+    findings = []
+    exempt = ctx.pkg_dir / COLLECTIVE_EXEMPT_SUBDIR
+    for path in ctx.pkg_files() + ctx.test_files() + ctx.script_files():
+        if path.is_relative_to(exempt) or not ctx.selected(path):
+            continue
+        findings.extend(scan_raw_collectives(ctx.rel(path), ctx.tree(path)))
+    return findings
+
+
+# ------------------------------------------------------------------------
+# host-sync-in-step: host synchronization reachable from the train-step
+# builders stalls the device queue and pollutes the goodput ledger's
+# step_compute bucket (PR 10) with host time.
+# ------------------------------------------------------------------------
+
+HOST_SYNC_FILES = ("train/step.py", "train/losses.py")
+_HOST_SYNC_ATTRS = frozenset({"item", "device_get", "block_until_ready"})
+_NUMPY_NAMES = frozenset({"np", "numpy"})
+
+
+def scan_host_sync(rel: str, tree: ast.Module) -> list[Finding]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in _HOST_SYNC_ATTRS:
+            out.append(Finding(
+                "host-sync-in-step", f"{rel}:{node.lineno}",
+                f".{node.attr} in step-builder code forces a device→host "
+                f"sync inside the hot loop — keep metrics on device and "
+                f"fetch them from the train loop"))
+        elif (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in _NUMPY_NAMES):
+            out.append(Finding(
+                "host-sync-in-step", f"{rel}:{node.lineno}",
+                f"numpy ({node.value.id}.{node.attr}) in step-builder code "
+                f"materializes on host — use jnp so the op stays in the "
+                f"compiled step"))
+        elif (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int")
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)):
+            out.append(Finding(
+                "host-sync-in-step", f"{rel}:{node.lineno}",
+                f"{node.func.id}() on a traced value blocks on the device "
+                f"queue (implicit device_get) — keep it a jnp scalar"))
+    return out
+
+
+@register(
+    "host-sync-in-step", LAYER_AST,
+    "ban .item()/float()/numpy/device_get in the train-step builder "
+    "modules (host syncs there pollute the goodput step_compute bucket)")
+def host_sync_pass(ctx: RepoContext) -> list[Finding]:
+    findings = []
+    for rel_name in HOST_SYNC_FILES:
+        path = ctx.pkg_dir / rel_name
+        if not path.exists() or not ctx.selected(path):
+            continue
+        findings.extend(scan_host_sync(ctx.rel(path), ctx.tree(path)))
+    return findings
+
+
+# ------------------------------------------------------------------------
+# config-knob-coverage: every knob the config system validates must be
+# consumed somewhere in the package AND documented, or it is dead weight
+# that silently diverges from behavior.
+# ------------------------------------------------------------------------
+
+def _config_fields(tree: ast.Module) -> dict[str, list[str]]:
+    """{class_name: [field, ...]} for @config_dataclass classes."""
+    sections: dict[str, list[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        if not any(isinstance(d, ast.Name) and d.id == "config_dataclass"
+                   for d in node.decorator_list):
+            continue
+        fields = [s.target.id for s in node.body
+                  if isinstance(s, ast.AnnAssign)
+                  and isinstance(s.target, ast.Name)
+                  and not s.target.id.startswith("_")]
+        sections[node.name] = fields
+    return sections
+
+
+_WORD = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def _usage_corpus(ctx: RepoContext, config_path: pathlib.Path) -> set[str]:
+    """Identifiers 'read' by the package: attribute accesses plus words in
+    string constants (mesh axes and telemetry field names travel as
+    strings). core/config.py itself is excluded — validation is not
+    consumption."""
+    seen: set[str] = set()
+    for path in ctx.pkg_files() + ctx.script_files():
+        if path.resolve() == config_path.resolve():
+            continue
+        for node in ast.walk(ctx.tree(path)):
+            if isinstance(node, ast.Attribute):
+                seen.add(node.attr)
+            elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+                seen.update(_WORD.findall(node.value))
+    return seen
+
+
+@register(
+    "config-knob-coverage", LAYER_AST,
+    "every validated config knob must be read in the package and mentioned "
+    "in docs/ (undocumented or unread knobs silently diverge from behavior)",
+    anchors=("*/core/config.py", "docs/*.md", "README.md",
+             DEFAULT_PACKAGE + "/*", "scripts/*.py"))
+def config_coverage_pass(ctx: RepoContext) -> list[Finding]:
+    config_path = ctx.pkg_dir / "core" / "config.py"
+    rel = ctx.rel(config_path) if config_path.exists() else "core/config.py"
+    if not config_path.exists():
+        return [Finding("config-knob-coverage", rel,
+                        "core/config.py not found", severity="internal-error")]
+    sections = _config_fields(ctx.tree(config_path))
+    if not sections:
+        return [Finding(
+            "config-knob-coverage", rel,
+            "no @config_dataclass classes found — extraction is broken "
+            "(vacuous pass)", severity="internal-error")]
+    used = _usage_corpus(ctx, config_path)
+    docs = "\n".join(ctx.source(p) for p in ctx.doc_files())
+    findings = []
+    for cls, fields in sections.items():
+        for f in fields:
+            if f not in used:
+                findings.append(Finding(
+                    "config-knob-coverage", f"{rel}:{cls}.{f}",
+                    f"knob {cls}.{f} is never read outside core/config.py — "
+                    f"dead config surface (wire it up or delete it)"))
+            if not re.search(r"\b" + re.escape(f) + r"\b", docs):
+                findings.append(Finding(
+                    "config-knob-coverage", f"{rel}:{cls}.{f}",
+                    f"knob {cls}.{f} appears nowhere in docs/*.md or "
+                    f"README.md — document it (docs/CONFIG.md is the knob "
+                    f"reference)"))
+    return findings
+
+
+# ------------------------------------------------------------------------
+# telemetry-kind-coverage: every KIND_* event and every CollectiveTally
+# grand-total field must be rolled up by the summary surface and pinned by
+# at least one test (promoted from tests/test_marker_audit.py).
+# ------------------------------------------------------------------------
+
+def _module_const_assigns(tree: ast.Module, prefix: str) -> dict[str, object]:
+    out: dict[str, object] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id.startswith(prefix):
+                    try:
+                        out[t.id] = ast.literal_eval(node.value)
+                    except ValueError:
+                        out[t.id] = None
+    return out
+
+
+def _function_source(tree: ast.Module, source: str, name: str) -> str | None:
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return ast.get_source_segment(source, node) or ""
+    return None
+
+
+@register(
+    "telemetry-kind-coverage", LAYER_AST,
+    "every KIND_* telemetry constant and CollectiveTally total field must "
+    "be summarized by the rollup surface and referenced by a test",
+    anchors=("*/core/telemetry.py", "*/parallel/collectives.py",
+             "tests/test_*.py"))
+def telemetry_coverage_pass(ctx: RepoContext) -> list[Finding]:
+    telem = ctx.pkg_dir / "core" / "telemetry.py"
+    coll = ctx.pkg_dir / "parallel" / "collectives.py"
+    findings: list[Finding] = []
+    if not telem.exists():
+        return [Finding("telemetry-kind-coverage", "core/telemetry.py",
+                        "telemetry module not found",
+                        severity="internal-error")]
+    rel = ctx.rel(telem)
+    source = ctx.source(telem)
+    tree = ctx.tree(telem)
+    kinds = _module_const_assigns(tree, "KIND_")
+    is_real_repo = ctx.package == DEFAULT_PACKAGE
+    if is_real_repo and len(kinds) < 20:
+        findings.append(Finding(
+            "telemetry-kind-coverage", rel,
+            f"KIND_* extraction saw only {len(kinds)} constants (expected "
+            f">= 20) — the audit is degraded, not the repo clean",
+            severity="internal-error"))
+    by_value: dict[object, list[str]] = {}
+    for name, value in kinds.items():
+        by_value.setdefault(value, []).append(name)
+    for value, names in by_value.items():
+        if len(names) > 1:
+            findings.append(Finding(
+                "telemetry-kind-coverage", f"{rel}:{'/'.join(sorted(names))}",
+                f"telemetry kinds {sorted(names)} share the string value "
+                f"{value!r} — rollups cannot distinguish them"))
+    rollup_parts = [
+        _function_source(tree, source, "summarize_events"),
+        _function_source(tree, source, "format_run_summary"),
+    ]
+    if any(p is None for p in rollup_parts):
+        findings.append(Finding(
+            "telemetry-kind-coverage", rel,
+            "summarize_events/format_run_summary not found — the rollup "
+            "surface moved; update the pass", severity="internal-error"))
+        return findings
+    rollup_src = "".join(p for p in rollup_parts if p)
+    corpus = "".join(ctx.source(p) for p in ctx.test_files())
+    for name in kinds:
+        if name not in rollup_src:
+            findings.append(Finding(
+                "telemetry-kind-coverage", f"{rel}:{name}",
+                f"{name} has no summarize_events/format_run_summary rollup "
+                f"— the event is invisible in exactly the post-mortems it "
+                f"was added for"))
+        if name not in corpus:
+            findings.append(Finding(
+                "telemetry-kind-coverage", f"{rel}:{name}",
+                f"{name} is referenced by no test — it can silently rot"))
+    if coll.exists():
+        crel = ctx.rel(coll)
+        fields = _module_const_assigns(
+            ctx.tree(coll), "TALLY_TOTAL_FIELDS").get("TALLY_TOTAL_FIELDS")
+        if not fields:
+            if is_real_repo:
+                findings.append(Finding(
+                    "telemetry-kind-coverage", crel,
+                    "TALLY_TOTAL_FIELDS not found in parallel/collectives.py",
+                    severity="internal-error"))
+        else:
+            if is_real_repo and not {"total_bytes",
+                                     "total_logical_bytes"} <= set(fields):
+                findings.append(Finding(
+                    "telemetry-kind-coverage", crel,
+                    f"TALLY_TOTAL_FIELDS lost its core fields: {fields}",
+                    severity="internal-error"))
+            for f in fields:
+                if f not in rollup_src:
+                    findings.append(Finding(
+                        "telemetry-kind-coverage", f"{crel}:{f}",
+                        f"CollectiveTally total field {f!r} has no telemetry "
+                        f"rollup — an unprinted total silently rots"))
+                if f not in corpus:
+                    findings.append(Finding(
+                        "telemetry-kind-coverage", f"{crel}:{f}",
+                        f"CollectiveTally total field {f!r} is referenced by "
+                        f"no test"))
+    return findings
+
+
+# ------------------------------------------------------------------------
+# slow-marker: subprocess training drills (the DRIVER template family)
+# must be tier-2 — tier-1 is the under-15-minute per-PR gate.
+# ------------------------------------------------------------------------
+
+_DRIVER_NAME = "DRIVER"
+
+
+def _is_driver_name(name: str) -> bool:
+    return name == _DRIVER_NAME or name.endswith("_" + _DRIVER_NAME)
+
+
+def _decorator_marks(fn: ast.FunctionDef) -> set[str]:
+    marks: set[str] = set()
+    for dec in fn.decorator_list:
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "mark"):
+            marks.add(node.attr)
+    return marks
+
+
+def module_defines_driver(tree: ast.Module) -> bool:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and _is_driver_name(t.id):
+                    return True
+        if isinstance(node, ast.ImportFrom):
+            if any(_is_driver_name(a.name) for a in node.names):
+                return True
+    return False
+
+
+def function_uses_driver(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and _is_driver_name(node.id):
+            return True
+        if isinstance(node, ast.ImportFrom) and \
+                any(_is_driver_name(a.name) for a in node.names):
+            return True
+    return False
+
+
+def scan_slow_markers(rel: str, tree: ast.Module) -> list[Finding]:
+    out = []
+    module_wide = module_defines_driver(tree)
+    for node in tree.body:
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name.startswith("test_")):
+            continue
+        if not (module_wide or function_uses_driver(node)):
+            continue
+        if "slow" not in _decorator_marks(node):
+            out.append(Finding(
+                "slow-marker", f"{rel}:{node.lineno}",
+                f"{node.name} launches real training children (DRIVER "
+                f"template) but lacks @pytest.mark.slow — subprocess "
+                f"drills must stay out of tier-1"))
+    return out
+
+
+@register(
+    "slow-marker", LAYER_AST,
+    "subprocess training drills (DRIVER template) must carry "
+    "@pytest.mark.slow so they stay out of the tier-1 gate",
+    anchors=("tests/test_*.py",))
+def slow_marker_pass(ctx: RepoContext) -> list[Finding]:
+    findings = []
+    recognized_known_drill = False
+    sentinel = ctx.tests_dir / "test_fault_tolerance.py"
+    for path in ctx.test_files():
+        tree = ctx.tree(path)
+        if path == sentinel and module_defines_driver(tree):
+            recognized_known_drill = True
+        findings.extend(scan_slow_markers(ctx.rel(path), tree))
+    if sentinel.exists() and not recognized_known_drill:
+        findings.append(Finding(
+            "slow-marker", ctx.rel(sentinel),
+            "audit no longer recognizes the known DRIVER drill module — "
+            "the pass is matching nothing (vacuous)",
+            severity="internal-error"))
+    return findings
+
+
+# ------------------------------------------------------------------------
+# typed-errors: failures must be typed (the supervisor maps exception
+# types to exit codes — rc 84 elastic refit rides MeshSizeError) and
+# documented; anonymous Exception raises and bare excepts defeat that.
+# ------------------------------------------------------------------------
+
+_EXC_BASE_SUFFIXES = ("Error", "Exception")
+
+
+def _base_name(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def scan_typed_errors(rel: str, tree: ast.Module) -> list[Finding]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            target = node.exc.func if isinstance(node.exc, ast.Call) else node.exc
+            name = _base_name(target)
+            if name in ("Exception", "BaseException"):
+                out.append(Finding(
+                    "typed-errors", f"{rel}:{node.lineno}",
+                    f"raise {name} is untyped — callers (and the "
+                    f"supervisor's rc mapping) cannot dispatch on it; raise "
+                    f"a *Error subclass"))
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            out.append(Finding(
+                "typed-errors", f"{rel}:{node.lineno}",
+                "bare 'except:' swallows SystemExit/KeyboardInterrupt — "
+                "catch Exception (or narrower) explicitly"))
+        elif isinstance(node, ast.ClassDef):
+            base_names = [_base_name(b) for b in node.bases]
+            if any(n and n.endswith(_EXC_BASE_SUFFIXES) for n in base_names):
+                if not node.name.endswith("Error"):
+                    out.append(Finding(
+                        "typed-errors", f"{rel}:{node.lineno}",
+                        f"exception class {node.name} must be named "
+                        f"*Error (repo typed-error convention)"))
+                if not ast.get_docstring(node):
+                    out.append(Finding(
+                        "typed-errors", f"{rel}:{node.lineno}",
+                        f"exception class {node.name} needs a docstring "
+                        f"saying when it fires and who catches it"))
+    return out
+
+
+@register(
+    "typed-errors", LAYER_AST,
+    "package failures must be typed *Error classes with docstrings; no "
+    "anonymous 'raise Exception' or bare 'except:'")
+def typed_errors_pass(ctx: RepoContext) -> list[Finding]:
+    findings = []
+    for path in ctx.pkg_files():
+        if not ctx.selected(path):
+            continue
+        findings.extend(scan_typed_errors(ctx.rel(path), ctx.tree(path)))
+    return findings

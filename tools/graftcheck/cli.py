@@ -1,0 +1,170 @@
+"""graftcheck CLI — run passes, apply suppressions, report, exit.
+
+Exit codes (scripts consume these — scripts/chip_window_queue.sh gates the
+chip window on 0):
+
+  * ``0`` — clean (every finding suppressed or none at all)
+  * ``1`` — unsuppressed findings
+  * ``2`` — internal errors (a pass crashed or detected its own vacuity);
+    never suppressible, because a broken audit must not read as a clean repo
+  * ``3`` — usage error (bad flag, unknown pass)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from tools.graftcheck import findings as fmod
+from tools.graftcheck import registry
+from tools.graftcheck.context import RepoContext, git_changed_files
+from tools.graftcheck.findings import Finding
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_INTERNAL = 2
+EXIT_USAGE = 3
+
+DEFAULT_SUPPRESSIONS = pathlib.Path(__file__).with_name("suppressions.txt")
+
+
+class _Parser(argparse.ArgumentParser):
+    def error(self, message):  # argparse defaults to exit code 2
+        self.exit(EXIT_USAGE, f"{self.prog}: error: {message}\n")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = _Parser(
+        prog="graftcheck",
+        description="framework-aware static analysis: AST lints + jaxpr "
+                    "trace audits (docs/STATIC_ANALYSIS.md)")
+    p.add_argument("--root", default=".", help="repo root (default: cwd)")
+    p.add_argument("--layer", choices=registry.LAYERS,
+                   help="run only this layer's passes")
+    p.add_argument("--pass", dest="passes", action="append", default=[],
+                   metavar="PASS_ID", help="run only the named pass "
+                   "(repeatable); overrides --layer")
+    p.add_argument("--changed", action="store_true",
+                   help="fast pre-commit mode: scan only files changed vs "
+                   "HEAD; anchored repo-wide passes run only when an anchor "
+                   "changed; jaxpr passes are skipped unless named with "
+                   "--pass or --layer jaxpr")
+    p.add_argument("--list-passes", action="store_true",
+                   help="list registered passes and exit")
+    p.add_argument("--json", metavar="FILE",
+                   help="also write the dtf-lint-report/1 JSON here "
+                   "('-' for stdout)")
+    p.add_argument("--format", choices=("table", "json"), default="table",
+                   help="stdout format (default: table)")
+    p.add_argument("--suppressions", default=str(DEFAULT_SUPPRESSIONS),
+                   help="suppression file (default: tools/graftcheck/"
+                   "suppressions.txt)")
+    return p
+
+
+def select_passes(args, changed: set[str] | None) -> list[registry.PassInfo]:
+    if args.passes:
+        return [registry.get_pass(pid) for pid in args.passes]
+    infos = list(registry.PASSES.values())
+    if args.layer:
+        infos = [p for p in infos if p.layer == args.layer]
+    elif args.changed:
+        # jaxpr probes cost seconds; the fast pre-commit loop is AST-only
+        # unless the caller asks for the trace audits explicitly.
+        infos = [p for p in infos if p.layer == registry.LAYER_AST]
+    if changed is not None:
+        infos = [p for p in infos if p.relevant_for_changed(changed)]
+    return infos
+
+
+def run_passes(ctx: RepoContext,
+               infos: list[registry.PassInfo]) -> list[Finding]:
+    findings: list[Finding] = []
+    for info in infos:
+        try:
+            findings.extend(info.fn(ctx))
+        except Exception as exc:  # a crashed audit must not read as clean
+            findings.append(Finding(
+                info.pass_id, "pass", f"pass crashed: {exc!r}",
+                severity=fmod.SEVERITY_INTERNAL))
+    return findings
+
+
+def format_table(report: dict, infos: list[registry.PassInfo]) -> str:
+    lines = []
+    rows = [f for f in report["findings"] if not f["suppressed"]]
+    sup = [f for f in report["findings"] if f["suppressed"]]
+    if rows:
+        w_pass = max(len(f["pass_id"]) for f in rows)
+        w_where = max(len(f["where"]) for f in rows)
+        for f in sorted(rows, key=lambda f: (f["pass_id"], f["where"])):
+            tag = " [internal]" if f["severity"] == fmod.SEVERITY_INTERNAL else ""
+            lines.append(f"{f['pass_id']:<{w_pass}}  {f['where']:<{w_where}}"
+                         f"  {f['message']}{tag}")
+        lines.append("")
+    c = report["counts"]
+    lines.append(
+        f"graftcheck: {len(infos)} pass(es) run, {c['findings']} finding(s)"
+        f" ({c['internal_errors']} internal), {c['suppressed']} suppressed")
+    for f in sup:
+        lines.append(f"  suppressed: {f['pass_id']} {f['where']} — "
+                     f"{f['justification']}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for info in sorted(registry.PASSES.values(),
+                           key=lambda p: (p.layer, p.pass_id)):
+            print(f"{info.pass_id:<26} [{info.layer}]  {info.description}")
+        return EXIT_CLEAN
+
+    root = pathlib.Path(args.root).resolve()
+    changed = None
+    if args.changed:
+        try:
+            changed = git_changed_files(root)
+        except RuntimeError as exc:
+            print(f"graftcheck: --changed needs git: {exc}", file=sys.stderr)
+            return EXIT_USAGE
+
+    try:
+        infos = select_passes(args, changed)
+    except KeyError as exc:
+        print(f"graftcheck: {exc.args[0]}", file=sys.stderr)
+        return EXIT_USAGE
+
+    ctx = RepoContext(root, changed=changed)
+    findings = run_passes(ctx, infos)
+    sups, sup_findings = fmod.load_suppressions(args.suppressions)
+    findings.extend(sup_findings)
+    full_run = (changed is None and not args.passes and not args.layer)
+    stale = fmod.apply_suppressions(
+        findings, sups, suppression_file=pathlib.Path(args.suppressions).name,
+        stale_check_ids=None if full_run else {i.pass_id for i in infos})
+    if changed is None:  # --changed sees partial files; can't judge staleness
+        findings.extend(stale)
+
+    report = fmod.build_report(findings, [i.pass_id for i in infos], root)
+    if args.format == "json":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(format_table(report, infos))
+    if args.json:
+        payload = json.dumps(report, indent=2, sort_keys=True)
+        if args.json == "-":
+            if args.format != "json":
+                print(payload)
+        else:
+            pathlib.Path(args.json).write_text(payload + "\n")
+
+    if report["counts"]["internal_errors"]:
+        return EXIT_INTERNAL
+    if report["counts"]["findings"]:
+        return EXIT_FINDINGS
+    return EXIT_CLEAN

@@ -1,0 +1,111 @@
+"""RepoContext — the filesystem view every pass reads through.
+
+Centralizes path layout (package dir, tests, docs, scripts), caches parsed
+ASTs, and carries the ``--changed`` file filter. The package name is a
+parameter so pass logic can be exercised against the fixture mini-repos
+under ``tests/graftcheck_fixtures/`` with zero special-casing.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import subprocess
+
+DEFAULT_PACKAGE = "distributed_tensorflow_framework_tpu"
+
+
+class RepoContext:
+    def __init__(
+        self,
+        root: str | pathlib.Path,
+        package: str = DEFAULT_PACKAGE,
+        changed: set[str] | None = None,
+    ):
+        self.root = pathlib.Path(root).resolve()
+        self.package = package
+        self.changed = changed  # repo-relative posix paths; None = everything
+        self._src: dict[pathlib.Path, str] = {}
+        self._ast: dict[pathlib.Path, ast.Module] = {}
+
+    # ------------------------------------------------------------ layout --
+    @property
+    def pkg_dir(self) -> pathlib.Path:
+        return self.root / self.package
+
+    @property
+    def tests_dir(self) -> pathlib.Path:
+        return self.root / "tests"
+
+    @property
+    def docs_dir(self) -> pathlib.Path:
+        return self.root / "docs"
+
+    def rel(self, path: pathlib.Path) -> str:
+        return path.resolve().relative_to(self.root).as_posix()
+
+    # ------------------------------------------------------------- files --
+    def pkg_files(self) -> list[pathlib.Path]:
+        return sorted(p for p in self.pkg_dir.rglob("*.py")
+                      if "__pycache__" not in p.parts)
+
+    def test_files(self) -> list[pathlib.Path]:
+        """Top-level test modules only — fixture mini-repos under
+        tests/graftcheck_fixtures/ deliberately contain violating code and
+        must never be scanned as part of the real repo."""
+        if not self.tests_dir.is_dir():
+            return []
+        return sorted(self.tests_dir.glob("test_*.py"))
+
+    def script_files(self) -> list[pathlib.Path]:
+        files = sorted((self.root / "scripts").glob("*.py"))
+        for name in ("bench.py", "train.py"):
+            p = self.root / name
+            if p.exists():
+                files.append(p)
+        return files
+
+    def doc_files(self) -> list[pathlib.Path]:
+        files = sorted(self.docs_dir.glob("*.md")) if self.docs_dir.is_dir() else []
+        readme = self.root / "README.md"
+        if readme.exists():
+            files.append(readme)
+        return files
+
+    def selected(self, path: pathlib.Path) -> bool:
+        """Changed-mode filter for per-file passes."""
+        if self.changed is None:
+            return True
+        return self.rel(path) in self.changed
+
+    # ------------------------------------------------------------ parsing --
+    def source(self, path: pathlib.Path) -> str:
+        path = path.resolve()
+        if path not in self._src:
+            self._src[path] = path.read_text()
+        return self._src[path]
+
+    def tree(self, path: pathlib.Path) -> ast.Module:
+        path = path.resolve()
+        if path not in self._ast:
+            self._ast[path] = ast.parse(self.source(path), filename=str(path))
+        return self._ast[path]
+
+
+def git_changed_files(root: str | pathlib.Path) -> set[str]:
+    """Working-tree delta for ``--changed`` mode: unstaged + staged +
+    untracked (git's own exclude rules keep __pycache__ etc. out)."""
+    root = str(root)
+    out: set[str] = set()
+    for args in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        res = subprocess.run(args, cwd=root, capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(args)} failed (rc={res.returncode}): "
+                f"{res.stderr.strip()}")
+        out.update(line.strip() for line in res.stdout.splitlines()
+                   if line.strip())
+    return out

@@ -1,0 +1,181 @@
+"""Finding/suppression model and the ``dtf-lint-report/1`` JSON schema.
+
+A finding is (pass_id, where, message): ``where`` is a repo-relative
+``path:line`` for AST-layer findings and a ``trace:<name_stack>`` provenance
+string for jaxpr-layer ones. Suppressions live in a pipe-separated file
+(default ``tools/graftcheck/suppressions.txt``); every entry carries a
+REQUIRED justification string and must match at least one live finding —
+stale entries are themselves findings, so the file can't rot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+import pathlib
+from dataclasses import dataclass, field
+
+REPORT_SCHEMA = "dtf-lint-report/1"
+
+SEVERITY_ERROR = "error"
+SEVERITY_INTERNAL = "internal-error"
+_SEVERITIES = (SEVERITY_ERROR, SEVERITY_INTERNAL)
+
+# The suppression machinery reports its own problems under this pass id.
+SUPPRESSIONS_PASS = "suppressions"
+
+
+@dataclass
+class Finding:
+    pass_id: str
+    where: str
+    message: str
+    severity: str = SEVERITY_ERROR
+    suppressed: bool = False
+    justification: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.pass_id}|{self.where}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(**d)
+
+
+@dataclass
+class Suppression:
+    pass_id: str          # exact pass id, or "*"
+    pattern: str          # fnmatch glob over Finding.where
+    justification: str
+    line_no: int
+    uses: int = field(default=0)
+
+    def matches(self, f: Finding) -> bool:
+        if self.pass_id != "*" and self.pass_id != f.pass_id:
+            return False
+        return fnmatch.fnmatchcase(f.where, self.pattern)
+
+
+def load_suppressions(
+    path: str | pathlib.Path,
+) -> tuple[list[Suppression], list[Finding]]:
+    """Parse the suppression file. Malformed lines (wrong field count or a
+    missing justification) come back as findings — a suppression without a
+    recorded reason is exactly the silent convention this tool replaces."""
+    path = pathlib.Path(path)
+    sups: list[Suppression] = []
+    findings: list[Finding] = []
+    if not path.exists():
+        return sups, findings
+    for i, raw in enumerate(path.read_text().splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = [p.strip() for p in line.split("|")]
+        where = f"{path.name}:{i}"
+        if len(parts) != 3:
+            findings.append(Finding(
+                SUPPRESSIONS_PASS, where,
+                f"malformed suppression (want 'pass-id | where-glob | "
+                f"justification'): {line!r}"))
+            continue
+        pass_id, pattern, justification = parts
+        if not pass_id or not pattern or not justification:
+            findings.append(Finding(
+                SUPPRESSIONS_PASS, where,
+                f"suppression missing a field (the justification is "
+                f"mandatory): {line!r}"))
+            continue
+        sups.append(Suppression(pass_id, pattern, justification, i))
+    return sups, findings
+
+
+def apply_suppressions(
+    findings: list[Finding],
+    sups: list[Suppression],
+    suppression_file: str = "suppressions.txt",
+    stale_check_ids: set[str] | None = None,
+) -> list[Finding]:
+    """Mark suppressed findings in place; return extra findings for stale
+    (never-matched) suppression entries. ``stale_check_ids`` limits the
+    staleness report to suppressions for those pass ids (partial runs —
+    ``--layer``/``--pass`` — can't judge entries for passes that didn't
+    run); None means a full run, where every entry must earn its keep."""
+    for f in findings:
+        if f.severity == SEVERITY_INTERNAL:
+            continue  # infrastructure failures cannot be suppressed
+        for s in sups:
+            if s.matches(f):
+                f.suppressed = True
+                f.justification = s.justification
+                s.uses += 1
+                break
+    extra = []
+    for s in sups:
+        if stale_check_ids is not None and s.pass_id not in stale_check_ids:
+            continue  # "*" entries are only judged on full runs
+        if s.uses == 0:
+            extra.append(Finding(
+                SUPPRESSIONS_PASS, f"{suppression_file}:{s.line_no}",
+                f"stale suppression — no live finding matches "
+                f"({s.pass_id} | {s.pattern}); delete it"))
+    return extra
+
+
+def build_report(
+    findings: list[Finding],
+    passes_run: list[str],
+    root: str | pathlib.Path,
+) -> dict:
+    active = [f for f in findings if not f.suppressed]
+    return {
+        "schema": REPORT_SCHEMA,
+        "root": str(root),
+        "passes_run": sorted(passes_run),
+        "findings": [f.to_dict() for f in findings],
+        "counts": {
+            "findings": len(active),
+            "suppressed": sum(1 for f in findings if f.suppressed),
+            "internal_errors": sum(
+                1 for f in active if f.severity == SEVERITY_INTERNAL),
+        },
+    }
+
+
+def validate_report(d: dict) -> list[str]:
+    """Structural validation of a dtf-lint-report/1 object (the shape
+    consumers like CI dashboards may rely on). Returns problem strings."""
+    errs: list[str] = []
+    if d.get("schema") != REPORT_SCHEMA:
+        errs.append(f"schema must be {REPORT_SCHEMA!r}, got {d.get('schema')!r}")
+    for key, typ in (("root", str), ("passes_run", list),
+                     ("findings", list), ("counts", dict)):
+        if not isinstance(d.get(key), typ):
+            errs.append(f"{key} must be {typ.__name__}")
+    for i, f in enumerate(d.get("findings") or []):
+        if not isinstance(f, dict):
+            errs.append(f"findings[{i}] must be an object")
+            continue
+        for key in ("pass_id", "where", "message", "severity"):
+            if not isinstance(f.get(key), str) or not f.get(key):
+                errs.append(f"findings[{i}].{key} must be a non-empty string")
+        if f.get("severity") not in _SEVERITIES:
+            errs.append(
+                f"findings[{i}].severity must be one of {_SEVERITIES}")
+        if not isinstance(f.get("suppressed"), bool):
+            errs.append(f"findings[{i}].suppressed must be a bool")
+    counts = d.get("counts") or {}
+    for key in ("findings", "suppressed", "internal_errors"):
+        if not isinstance(counts.get(key), int):
+            errs.append(f"counts.{key} must be an int")
+    return errs
+
+
+def round_trip(d: dict) -> dict:
+    """JSON-encode and decode (the report must survive serialization)."""
+    return json.loads(json.dumps(d, sort_keys=True))

@@ -1,0 +1,329 @@
+"""Layer-2 (jaxpr/trace) passes.
+
+These audits do not read source text — they jit-trace the REAL train step
+on the 8-device CPU mesh and inspect what the compiler will actually be
+handed:
+
+  * ``jaxpr-donation`` — lower the jit train step and count
+    ``tf.aliasing_output`` markers: every state leaf must be donor-aliased
+    (``donate_argnums=(0,)``), or the optimizer doubles its HBM footprint.
+  * ``jaxpr-f32-upcast`` — walk the ClosedJaxpr of a bf16-configured step
+    and flag ``convert_element_type`` ops that widen bf16/int8 tensors to
+    f32 *feeding a dot/conv* (matmuls silently running in f32 defeat the
+    mixed-precision config; intentional widenings carry a suppression).
+  * ``jaxpr-collective-census`` — trace shard_map steps under
+    ``collectives.tally()`` and require the jaxpr's collective-op counts to
+    equal what the tally rows predict, in BOTH directions: an op the tally
+    missed is an unaccounted wire transfer (the int8-compression numbers
+    are benchmarked on that ledger), a tally row with no op is fiction.
+
+Probes are traced once per process and memoized (``_PROBE_CACHE``) so the
+tier-1 self-audit and the dedicated tests share the work. jax is imported
+lazily so AST-only runs never pay for it.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+from tools.graftcheck.context import DEFAULT_PACKAGE, RepoContext
+from tools.graftcheck.findings import Finding
+from tools.graftcheck.registry import LAYER_JAXPR, register
+
+ALIAS_MARKER = "tf.aliasing_output"
+
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "all_gather", "ppermute", "all_to_all", "reduce_scatter",
+    "pmin", "pmax",
+})
+
+# Tally kind → jaxpr primitives ONE wrapper call emits, for the wire
+# formats the census probes below are configured with (full-precision
+# gathers/scatters; int8 only via the q8 grad kinds, which emit two
+# primitives each: payload + block scales).
+KIND_TO_PRIMS: dict[str, tuple[tuple[str, int], ...]] = {
+    "allreduce_grads_pmean": (("psum", 1),),          # pmean lowers to psum
+    "allreduce_grads_pmean_narrow": (("psum", 1),),
+    "allreduce_grads_scatter_f32": (("reduce_scatter", 1),),
+    "allreduce_grads_gather_narrow": (("all_gather", 1),),
+    "allreduce_grads_q8_scatter": (("all_to_all", 2),),
+    "allreduce_grads_q8_gather": (("all_gather", 2),),
+    "psum": (("psum", 1),),
+    "pmean": (("psum", 1),),
+    "all_gather": (("all_gather", 1),),
+    "reduce_scatter": (("reduce_scatter", 1),),
+    "ppermute": (("ppermute", 1),),
+    "zero_reduce_scatter": (("reduce_scatter", 1),),  # psum_scatter prim name
+    "zero_all_gather": (("all_gather", 1),),
+}
+
+# ----------------------------------------------------------------- probes --
+_BASE = {
+    "name": "graftcheck-probe",
+    "model": {"name": "lenet5", "num_classes": 10, "dtype": "float32"},
+    "data": {"name": "synthetic_images", "global_batch_size": 64,
+             "image_size": 28, "channels": 1},
+    "optimizer": {"name": "sgd_momentum", "learning_rate": 0.05},
+    "train": {"total_steps": 5, "spmd_mode": "jit"},
+}
+
+PROBE_CONFIGS: dict[str, dict] = {
+    # Donation audit: the plain jit path (train/step.py make_train_step).
+    "jit_f32": {},
+    # Upcast audit: same step with a bf16 model — every matmul should run
+    # in bf16 except the deliberately-f32 logits head.
+    "jit_bf16": {"model": {"dtype": "bfloat16"}},
+    # Census A: explicit dp×fsdp collectives (grad pmean + param gathers).
+    "shard_dp_fsdp": {"mesh": {"data": 4, "fsdp": 2},
+                      "train": {"spmd_mode": "shard_map"}},
+    # Census B: int8 block-scaled all-reduce with error feedback — the
+    # probe that pins the q8 kinds to 2 wire ops each.
+    "shard_q8_ef": {"mesh": {"data": 8},
+                    "parallel": {"collective_dtype": "int8"},
+                    "train": {"spmd_mode": "shard_map"}},
+    # Census C: ZeRO weight-update sharding (bucketed RS/AG + the shard
+    # grad-norm psum).
+    "shard_zero": {"mesh": {"data": 8},
+                   "optimizer": {"zero_sharding": "shard_map"},
+                   "train": {"spmd_mode": "shard_map"}},
+}
+
+CENSUS_PROBES = ("shard_dp_fsdp", "shard_q8_ef", "shard_zero")
+
+_PROBE_CACHE: dict[tuple[str, str], dict] = {}
+
+
+def _merge(base: dict, over: dict) -> dict:
+    out = {k: dict(v) if isinstance(v, dict) else v for k, v in base.items()}
+    for k, v in over.items():
+        if isinstance(v, dict):
+            out.setdefault(k, {})
+            out[k] = {**out[k], **v}
+        else:
+            out[k] = v
+    return out
+
+
+def _require_runtime(ctx: RepoContext):
+    """Import jax + the package; the CLI shim / tests set the CPU-mesh env
+    before jax initializes. Raises RuntimeError on an unusable runtime
+    (surfaced by the runner as an internal-error finding)."""
+    if ctx.package != DEFAULT_PACKAGE or not ctx.pkg_dir.is_dir():
+        raise RuntimeError(
+            "jaxpr passes trace the real train step and only run against "
+            f"the {DEFAULT_PACKAGE} package (got {ctx.package!r})")
+    root = str(ctx.root)
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    n = jax.device_count()
+    if n != 8:
+        raise RuntimeError(
+            f"jaxpr passes need the 8-device CPU mesh "
+            f"(XLA_FLAGS=--xla_force_host_platform_device_count=8 before "
+            f"jax import); got {n} devices")
+    return jax
+
+
+def get_probe(ctx: RepoContext, name: str) -> dict:
+    """Build (once per process) the traced/lowered artifacts for a probe:
+
+    ``n_state_leaves`` always; ``alias_count`` for jit probes (from the
+    lowered StableHLO text); ``jaxpr`` (ClosedJaxpr) for all probes;
+    ``tally_calls`` (kind → call count) for shard_map probes.
+    """
+    key = (str(ctx.root), name)
+    if key in _PROBE_CACHE:
+        return _PROBE_CACHE[key]
+    jax = _require_runtime(ctx)
+    import jax.numpy as jnp
+    from distributed_tensorflow_framework_tpu.core.config import load_config
+    from distributed_tensorflow_framework_tpu.core.mesh import create_mesh
+    from distributed_tensorflow_framework_tpu.parallel import collectives as coll
+    from distributed_tensorflow_framework_tpu.train.step import StepBuilder
+
+    cfg = load_config(base=_merge(_BASE, PROBE_CONFIGS[name]))
+    mesh = create_mesh(cfg.mesh)
+    sb = StepBuilder(cfg, mesh)
+    batch = {"image": jax.ShapeDtypeStruct((64, 28, 28, 1), jnp.float32),
+             "label": jax.ShapeDtypeStruct((64,), jnp.int32)}
+    seed = jax.ShapeDtypeStruct((1,), jnp.uint32)
+    state_shapes = jax.eval_shape(sb._create_state, seed, batch)
+    probe: dict = {
+        "config": cfg,
+        "builder": sb,
+        "batch": batch,
+        "state_shapes": state_shapes,
+        "n_state_leaves": len(jax.tree.leaves(state_shapes)),
+    }
+    with coll.tally() as t:
+        step = sb.make_train_step(batch)
+        traced = step.trace(state_shapes, batch)
+    probe["jaxpr"] = traced.jaxpr
+    probe["tally_calls"] = dict(t.calls)
+    if name.startswith("jit"):
+        probe["alias_count"] = count_output_aliases(
+            step.lower(state_shapes, batch).as_text())
+    _PROBE_CACHE[key] = probe
+    return probe
+
+
+# ---------------------------------------------------------------- walkers --
+def iter_eqns(jaxpr):
+    """Depth-first over a Jaxpr and every sub-jaxpr in eqn params
+    (pjit/shard_map/scan/cond bodies)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for item in (v if isinstance(v, (list, tuple)) else [v]):
+                if hasattr(item, "jaxpr"):        # ClosedJaxpr
+                    yield from iter_eqns(item.jaxpr)
+                elif hasattr(item, "eqns"):       # Jaxpr
+                    yield from iter_eqns(item)
+
+
+def count_output_aliases(stablehlo_text: str) -> int:
+    """Donated inputs show up as ``tf.aliasing_output`` attributes on the
+    entry computation's parameters."""
+    return stablehlo_text.count(ALIAS_MARKER)
+
+
+def collective_census(closed_jaxpr) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name in COLLECTIVE_PRIMS:
+            counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+    return counts
+
+
+def expected_census(tally_calls: dict[str, int]
+                    ) -> tuple[dict[str, int], list[str]]:
+    """Predict the jaxpr collective counts from tally rows; unknown kinds
+    are returned separately (a new wrapper kind must be added to
+    KIND_TO_PRIMS before it can pass the census)."""
+    expected: dict[str, int] = {}
+    unknown = []
+    for kind, n in tally_calls.items():
+        if kind not in KIND_TO_PRIMS:
+            unknown.append(kind)
+            continue
+        for prim, mult in KIND_TO_PRIMS[kind]:
+            expected[prim] = expected.get(prim, 0) + mult * n
+    return expected, unknown
+
+
+def collect_upcasts(closed_jaxpr) -> list[tuple[str, str]]:
+    """(consumer_prim, name_stack) for each convert_element_type that
+    widens a bf16/int8 tensor to f32 and feeds a dot/conv."""
+    import jax.numpy as jnp
+    narrow = (jnp.bfloat16, jnp.int8)
+    hits: list[tuple[str, str]] = []
+
+    def rec(jaxpr):
+        converts: set = set()
+        for eqn in jaxpr.eqns:
+            if (eqn.primitive.name == "convert_element_type"
+                    and getattr(eqn.invars[0].aval, "dtype", None) in narrow
+                    and eqn.params.get("new_dtype") == jnp.float32):
+                converts.add(eqn.outvars[0])
+            elif eqn.primitive.name in ("dot_general", "conv_general_dilated"):
+                if any(iv in converts for iv in eqn.invars):
+                    hits.append((eqn.primitive.name,
+                                 str(eqn.source_info.name_stack)))
+            for v in eqn.params.values():
+                for item in (v if isinstance(v, (list, tuple)) else [v]):
+                    if hasattr(item, "jaxpr"):
+                        rec(item.jaxpr)
+                    elif hasattr(item, "eqns"):
+                        rec(item)
+
+    rec(closed_jaxpr.jaxpr)
+    return hits
+
+
+# ----------------------------------------------------------------- passes --
+def audit_donation(alias_count: int, n_state_leaves: int,
+                   where: str) -> list[Finding]:
+    """Pure verdict (shared with the seeded-regression test): every state
+    leaf must be donor-aliased to an output."""
+    if alias_count >= n_state_leaves:
+        return []
+    return [Finding(
+        "jaxpr-donation", where,
+        f"only {alias_count} of {n_state_leaves} train-state leaves are "
+        f"donor-aliased ({ALIAS_MARKER}) in the lowered step — "
+        f"donate_argnums=(0,) was dropped or defeated, doubling the "
+        f"optimizer-state HBM footprint")]
+
+
+@register(
+    "jaxpr-donation", LAYER_JAXPR,
+    "lower the jit train step and require every state leaf donor-aliased "
+    "(donation elision doubles the state HBM footprint)",
+    anchors=("*/train/step.py", "*/train/state.py"))
+def donation_pass(ctx: RepoContext) -> list[Finding]:
+    probe = get_probe(ctx, "jit_f32")
+    return audit_donation(probe["alias_count"], probe["n_state_leaves"],
+                          "trace:jit_f32/make_train_step")
+
+
+@register(
+    "jaxpr-f32-upcast", LAYER_JAXPR,
+    "trace a bf16-configured step and flag bf16/int8→f32 widenings that "
+    "feed a dot/conv (silent f32 matmuls defeat the mixed-precision "
+    "config); intentional widenings carry suppressions",
+    anchors=("*/train/step.py", "*/models/*.py", "*/train/losses.py"))
+def f32_upcast_pass(ctx: RepoContext) -> list[Finding]:
+    probe = get_probe(ctx, "jit_bf16")
+    findings = []
+    seen = set()
+    for prim, stack in collect_upcasts(probe["jaxpr"]):
+        where = f"trace:{stack}"
+        if (prim, where) in seen:
+            continue
+        seen.add((prim, where))
+        findings.append(Finding(
+            "jaxpr-f32-upcast", where,
+            f"{prim} consumes a bf16/int8 tensor widened to f32 at "
+            f"{stack} — the matmul runs full-precision despite "
+            f"model.dtype=bfloat16 (suppress with a justification if "
+            f"intentional)"))
+    return findings
+
+
+@register(
+    "jaxpr-collective-census", LAYER_JAXPR,
+    "trace shard_map steps under collectives.tally() and require jaxpr "
+    "collective-op counts == tally-predicted counts, both directions "
+    "(the wire-byte ledger must account for every collective)",
+    anchors=("*/parallel/*.py", "*/train/step.py"))
+def collective_census_pass(ctx: RepoContext) -> list[Finding]:
+    findings = []
+    for name in CENSUS_PROBES:
+        probe = get_probe(ctx, name)
+        actual = collective_census(probe["jaxpr"])
+        expected, unknown = expected_census(probe["tally_calls"])
+        for kind in unknown:
+            findings.append(Finding(
+                "jaxpr-collective-census", f"trace:{name}/{kind}",
+                f"tally kind {kind!r} is not in KIND_TO_PRIMS — teach the "
+                f"census the wrapper's wire ops before shipping it",
+                severity="internal-error"))
+        for prim in sorted(set(actual) | set(expected)):
+            a, e = actual.get(prim, 0), expected.get(prim, 0)
+            if a == e:
+                continue
+            if a > e:
+                msg = (f"{a - e} {prim} op(s) in the traced step have no "
+                       f"CollectiveTally row (probe {name}: jaxpr={a}, "
+                       f"tally predicts {e}) — an untallied collective is "
+                       f"an unaccounted wire transfer")
+            else:
+                msg = (f"tally predicts {e} {prim} op(s) but the jaxpr has "
+                       f"{a} (probe {name}) — a tally row with no op "
+                       f"overstates wire bytes")
+            findings.append(Finding(
+                "jaxpr-collective-census", f"trace:{name}/{prim}", msg))
+    return findings

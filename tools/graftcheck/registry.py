@@ -1,0 +1,64 @@
+"""Pass registry — a pass is a named callable ``fn(ctx) -> list[Finding]``.
+
+Registration happens at import of the pass modules (tools/graftcheck
+``__init__``). ``anchors`` are repo-relative glob patterns naming the files
+a repo-wide pass derives its verdict from: in ``--changed`` mode a
+repo-wide pass runs only when one of its anchors changed, while per-file
+passes (empty anchors) simply restrict their scan to the changed files.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass
+from typing import Callable
+
+LAYER_AST = "ast"
+LAYER_JAXPR = "jaxpr"
+LAYERS = (LAYER_AST, LAYER_JAXPR)
+
+
+@dataclass
+class PassInfo:
+    pass_id: str
+    layer: str
+    description: str
+    fn: Callable
+    anchors: tuple[str, ...] = ()   # () → per-file pass
+
+    def relevant_for_changed(self, changed: set[str]) -> bool:
+        if not self.anchors:
+            return True  # per-file passes self-restrict to changed files
+        return any(
+            fnmatch.fnmatch(path, pat)
+            for path in changed for pat in self.anchors
+        )
+
+
+PASSES: dict[str, PassInfo] = {}
+
+
+def register(pass_id: str, layer: str, description: str,
+             anchors: tuple[str, ...] = ()):
+    if layer not in LAYERS:
+        raise ValueError(f"unknown layer {layer!r} for pass {pass_id!r}")
+
+    def deco(fn):
+        if pass_id in PASSES:
+            raise ValueError(f"duplicate pass id {pass_id!r}")
+        PASSES[pass_id] = PassInfo(pass_id, layer, description, fn, anchors)
+        return fn
+
+    return deco
+
+
+def get_pass(pass_id: str) -> PassInfo:
+    try:
+        return PASSES[pass_id]
+    except KeyError:
+        raise KeyError(
+            f"unknown pass {pass_id!r}; known: {sorted(PASSES)}") from None
+
+
+def passes_for_layer(layer: str) -> list[PassInfo]:
+    return [p for p in PASSES.values() if p.layer == layer]
