@@ -129,6 +129,17 @@ def _compile_and_time(builder, state, batch, steps: int, warmup: int) -> dict:
         flops_per_step = float(ca.get("flops", 0.0)) or None
         bytes_per_step = float(ca.get("bytes accessed", 0.0)) or None
         memory_analysis = memstats.compiled_memory_analysis(compiled)
+        if memory_analysis is not None:
+            # Donation survival on the program actually being timed: the
+            # count of input_output_alias entries in the optimized module
+            # (tools/graftcheck audits the same number against the state
+            # leaf count).
+            try:
+                from tools.graftcheck.hlo_passes import count_alias_entries
+                memory_analysis["donated_alias_entries"] = \
+                    count_alias_entries(compiled.as_text())
+            except Exception:  # bench must not depend on the lint tooling
+                pass
         step = compiled
     except Exception as e:  # cost model unavailable on some backends
         print(f"bench: cost_analysis unavailable ({type(e).__name__})",

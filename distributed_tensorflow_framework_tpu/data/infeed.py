@@ -100,7 +100,7 @@ class _BackgroundInfeed:
             put(_EOF)
 
         self._thread = threading.Thread(target=produce, daemon=True,
-                                        name="infeed-prefetch")
+                                        name="dtf-infeed-prefetch")
         self._thread.start()
 
     def __iter__(self):
@@ -168,7 +168,7 @@ class _SyncInfeed:
         self._pending = None
         if deadline_s > 0:
             self._pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="infeed-pull")
+                max_workers=1, thread_name_prefix="dtf-infeed-pull")
 
     def _pull_raw(self):
         """One ``next(dataset)`` → host batch or _EOF; stall-guarded when

@@ -61,6 +61,18 @@ class ServeError(RuntimeError):
     HTTP statuses; everything else is a 500)."""
 
 
+class ServeReporterError(RuntimeError):
+    """The telemetry reporter thread died. Stored by the reporter and
+    re-raised on :meth:`InferenceEngine.drain` — a silent telemetry
+    outage must not read as a healthy engine (the async-saver contract:
+    background failures surface on the owning thread)."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(
+            f"serve reporter thread failed: {type(cause).__name__}: {cause}")
+        self.__cause__ = cause
+
+
 class OversizeRequestError(ServeError):
     """Request has more rows than ``serve.max_batch_size`` — it could
     never be admitted whole. Split it client-side or raise the knob."""
@@ -91,6 +103,22 @@ def serving_mesh(data: int = 1):
     if n > len(devices):
         raise MeshSizeError({"data": n}, n, len(devices))
     return create_mesh(MeshConfig(data=n), devices=devices[:n])
+
+
+def make_forward(model, mesh):
+    """The jitted serve forward: apply under the serving mesh, logits
+    out. Module-level (not an engine method) so graftcheck's compiled-HLO
+    audits can lower/compile the REAL serving path without standing up an
+    engine — the same callable the batcher thread executes."""
+
+    def _forward(variables, inputs):
+        with mesh:
+            logits = model.apply(variables, *inputs, train=False)
+        if isinstance(logits, dict):
+            logits = logits["logits"]
+        return logits
+
+    return jax.jit(_forward)
 
 
 def pick_bucket(value: int, buckets: list[int]) -> int:
@@ -163,7 +191,7 @@ class InferenceEngine:
             self._variables["batch_stats"] = shd.shard_pytree(
                 artifact.batch_stats, stat_specs, self.mesh)
         self._batch_sharding = NamedSharding(self.mesh, batch_spec(self.mesh))
-        self._fn = jax.jit(self._forward)
+        self._fn = make_forward(self.model, self.mesh)
         self._compiled: set[tuple] = set()
 
         self._cond = threading.Condition()
@@ -184,25 +212,17 @@ class InferenceEngine:
         self._mem = memstats.MemoryMonitor(
             telemetry_writer, interval_s=serve_cfg.report_interval_s,
             source="serve", devices=list(self.mesh.devices.flat))
+        self._reporter_error: ServeReporterError | None = None
         self._batcher = threading.Thread(
-            target=self._batch_loop, name="serve-batcher", daemon=True)
+            target=self._batch_loop, name="dtf-serve-batcher", daemon=True)
         self._batcher.start()
         self._reporter = threading.Thread(
-            target=self._report_loop, name="serve-reporter", daemon=True)
+            target=self._report_loop, name="dtf-serve-reporter", daemon=True)
         self._reporter.start()
         log.info(
             "engine up: task=%s step=%d dp=%d row_buckets=%s seq_buckets=%s",
             self.task, artifact.step, self.dp, self.row_buckets,
             self.seq_buckets)
-
-    # ---------------------------------------------------------- forward
-
-    def _forward(self, variables, inputs):
-        with self.mesh:
-            logits = self.model.apply(variables, *inputs, train=False)
-        if isinstance(logits, dict):
-            logits = logits["logits"]
-        return logits
 
     # ------------------------------------------------------- validation
 
@@ -349,6 +369,10 @@ class InferenceEngine:
             self._mem.sample(final=True)
         log.info("engine drained: %d requests in %d batches, %d undrained",
                  self._requests, self._batches, len(leftovers))
+        with self._cond:
+            reporter_error, self._reporter_error = self._reporter_error, None
+        if reporter_error is not None:
+            raise reporter_error
         return drained and not leftovers
 
     # ---------------------------------------------------------- batcher
@@ -495,11 +519,17 @@ class InferenceEngine:
                         "rows_per_sec": self._rows / elapsed})
 
     def _report_loop(self) -> None:
-        while not self._stop_reporting.wait(self.cfg.report_interval_s):
+        try:
+            while not self._stop_reporting.wait(self.cfg.report_interval_s):
+                with self._cond:
+                    depth = len(self._queue)
+                if self._tw:
+                    self._tw.emit(telemetry.KIND_SERVE_QUEUE,
+                                  metrics={"queue_depth": depth})
+                    self._mem.sample()
+                self._emit_latency()
+        except BaseException as e:  # surface on drain(), never just stderr
+            log.error("serve reporter thread failed", exc_info=True)
             with self._cond:
-                depth = len(self._queue)
-            if self._tw:
-                self._tw.emit(telemetry.KIND_SERVE_QUEUE,
-                              metrics={"queue_depth": depth})
-                self._mem.sample()
-            self._emit_latency()
+                if self._reporter_error is None:
+                    self._reporter_error = ServeReporterError(e)

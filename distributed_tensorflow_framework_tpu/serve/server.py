@@ -44,6 +44,19 @@ log = logging.getLogger(__name__)
 _MAX_BODY = 64 * 1024 * 1024  # refuse absurd request bodies outright
 
 
+class ServeDrainError(RuntimeError):
+    """The signal-triggered drain thread failed. Stored by the drain
+    thread and re-raised when :meth:`ServingServer.serve_forever`
+    returns — without it a drain failure leaves ``serve_forever`` and
+    every ``shutdown()`` waiter blocked forever with the error lost to a
+    daemon thread's stderr."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(
+            f"serve drain failed: {type(cause).__name__}: {cause}")
+        self.__cause__ = cause
+
+
 class ServingServer:
     """Engine + ThreadingHTTPServer, owning the drain choreography."""
 
@@ -54,6 +67,7 @@ class ServingServer:
         self._tw = telemetry_writer
         self._draining = threading.Event()
         self._done = threading.Event()
+        self._drain_error: ServeDrainError | None = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -179,11 +193,23 @@ class ServingServer:
         requirement). The handler only spawns the drain thread — all real
         work happens off the signal path."""
 
+        def _drain():
+            try:
+                self.shutdown("sigterm")
+            except BaseException as e:  # noqa: BLE001 — surface, don't hang
+                log.error("sigterm drain failed", exc_info=True)
+                self._drain_error = ServeDrainError(e)
+                # A failure inside shutdown() can fire before it reaches
+                # httpd.shutdown()/_done.set(); do both here so
+                # serve_forever() and shutdown() waiters unblock and the
+                # stored error surfaces instead of the process hanging.
+                self._done.set()
+                self.httpd.shutdown()
+
         def _on_term(signum, frame):
             del signum, frame
             threading.Thread(
-                target=self.shutdown, args=("sigterm",),
-                name="serve-drain", daemon=True).start()
+                target=_drain, name="dtf-serve-drain", daemon=True).start()
 
         signal.signal(signal.SIGTERM, _on_term)
         signal.signal(signal.SIGINT, _on_term)
@@ -194,3 +220,5 @@ class ServingServer:
                  self.host, self.port)
         self.httpd.serve_forever()
         self.httpd.server_close()
+        if self._drain_error is not None:
+            raise self._drain_error

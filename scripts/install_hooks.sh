@@ -1,0 +1,26 @@
+#!/bin/bash
+# Opt-in git hooks for this repo. Run once:
+#
+#   bash scripts/install_hooks.sh
+#
+# Installs a pre-commit hook that runs the fast graftcheck path —
+# `scripts/graftcheck.py --changed` — AST passes over the files in your
+# diff only (milliseconds; the jaxpr/hlo trace passes are skipped with a
+# notice; see docs/STATIC_ANALYSIS.md). Bypass a single commit with
+# `git commit --no-verify`; uninstall by deleting .git/hooks/pre-commit.
+set -eu
+cd "$(dirname "$0")/.."
+
+HOOK=.git/hooks/pre-commit
+if [ -e "$HOOK" ] && ! grep -q graftcheck "$HOOK" 2>/dev/null; then
+  echo "install_hooks: $HOOK exists and is not ours — not overwriting" >&2
+  exit 1
+fi
+
+cat > "$HOOK" <<'EOF'
+#!/bin/sh
+# Installed by scripts/install_hooks.sh — fast graftcheck over the diff.
+exec env JAX_PLATFORMS=cpu python scripts/graftcheck.py --changed
+EOF
+chmod +x "$HOOK"
+echo "install_hooks: wrote $HOOK (graftcheck --changed; --no-verify bypasses)"

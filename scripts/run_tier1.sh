@@ -1,0 +1,31 @@
+#!/bin/bash
+# Tier-1 wrapper: the ROADMAP.md verify command plus the graftcheck
+# static-analysis gate, with both artifacts archived side by side so CI
+# keeps the dtf-lint-report/1 JSON next to the pytest log.
+#
+#   bash scripts/run_tier1.sh [ARTIFACT_DIR]     (default /tmp/tier1)
+#
+# Exit code: non-zero if EITHER pytest or graftcheck fails. graftcheck
+# runs first — it is seconds, and a finding there (untallied collective,
+# dead donation, busted thread contract) explains test failures better
+# than the tests do.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+ART="${1:-/tmp/tier1}"
+mkdir -p "$ART"
+
+echo "=== graftcheck (full run, JSON → $ART/graftcheck.json) ==="
+env JAX_PLATFORMS=cpu python scripts/graftcheck.py \
+    --json "$ART/graftcheck.json" | tee "$ART/graftcheck.log"
+gc_rc=${PIPESTATUS[0]}
+
+echo "=== tier-1 pytest (log → $ART/pytest.log) ==="
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+    | tee "$ART/pytest.log"
+py_rc=${PIPESTATUS[0]}
+
+echo "=== tier-1 summary: graftcheck rc=$gc_rc pytest rc=$py_rc ==="
+[ "$gc_rc" -eq 0 ] && [ "$py_rc" -eq 0 ]

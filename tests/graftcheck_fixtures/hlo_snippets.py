@@ -1,0 +1,22 @@
+"""Traceable functions for the compiled-HLO audits
+(tests/test_graftcheck_hlo.py).
+
+``reshard_bad`` contracts a matmul over a dimension the test shards —
+GSPMD must insert an all-reduce to produce the replicated result, and
+that compiler-inserted collective (against ZERO jaxpr-declared ones) is
+exactly what hlo-reshard-census flags. ``reshard_clean`` is elementwise
+over identically-sharded operands: no communication needed, none
+inserted.
+"""
+
+import jax.numpy as jnp
+
+
+def reshard_bad(x, w):
+    """dot over a sharded contracting dimension → GSPMD all-reduce."""
+    return jnp.dot(x, w)
+
+
+def reshard_clean(x, y):
+    """Elementwise over aligned shardings → zero collectives."""
+    return x + y

@@ -1,0 +1,51 @@
+"""Fixture: fields racing between the main/API group and a background
+thread's group — written bare in a lock-owning class (per-site findings)
+and in a lockless class (class-level finding)."""
+
+import threading
+
+
+class Racy:
+    """Owns a lock but writes the shared field outside it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._t = threading.Thread(target=self._run, daemon=True,
+                                   name="dtf-racy")
+        self._t.start()
+
+    def _run(self):
+        try:
+            while True:
+                self.count += 1          # bg write, no lock
+        except BaseException as e:
+            self.fail(e)
+
+    def fail(self, e):
+        pass
+
+    def bump(self):
+        self.count += 1                  # main write, no lock
+
+
+class Lockless:
+    """No lock at all — the class-level finding."""
+
+    def __init__(self):
+        self.total = 0
+        self._t = threading.Thread(target=self._run, daemon=True,
+                                   name="dtf-lockless")
+        self._t.start()
+
+    def _run(self):
+        try:
+            self.total += 1
+        except BaseException as e:
+            self.fail(e)
+
+    def fail(self, e):
+        pass
+
+    def add(self, n):
+        self.total += n

@@ -1,0 +1,37 @@
+"""Fixture: the same shapes as lock_discipline_bad, made clean the three
+accepted ways — writes under ``with self.<lock>``, writes inside
+``*_locked`` methods, thread-safe handoff types, and single-writer
+fields (which never need a lock)."""
+
+import queue
+import threading
+
+
+class Disciplined:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._q = queue.Queue()
+        self._stop = threading.Event()
+        self.count = 0
+        self.bg_only = 0
+        self._t = threading.Thread(target=self._run, daemon=True,
+                                   name="dtf-disciplined")
+        self._t.start()
+
+    def _run(self):
+        try:
+            while not self._stop.is_set():
+                with self._cond:
+                    self.count += 1       # bg write under the lock
+                self.bg_only += 1         # single-writer: only this thread
+        except BaseException as e:
+            self._q.put(e)                # Queue handoff is exempt
+
+    def bump(self):
+        self._bump_locked()
+
+    def _bump_locked(self):
+        self.count += 1                   # *_locked naming convention
+
+    def close(self):
+        self._stop.set()                  # Event is exempt

@@ -1,0 +1,43 @@
+"""Fixture: every way a background thread can break the lifecycle
+contract (name, daemon/join, exception funnel) — one violation per
+thread so the test can count findings per rule."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def _quiet_worker():
+    try:
+        do_work()
+    except Exception:  # log-and-vanish: the bound exception never escapes
+        print("oops")
+
+
+def _busy_worker():
+    while True:
+        do_work()
+
+
+def do_work():
+    pass
+
+
+class Owner:
+    def __init__(self, label):
+        # 1. No name= at all.
+        threading.Thread(target=_quiet_worker, daemon=True).start()
+        # 2. Name present but not statically resolvable (runtime f-string).
+        threading.Thread(target=_quiet_worker, daemon=True,
+                         name=f"dtf-{label}").start()
+        # 3. Resolvable name without the dtf- prefix.
+        threading.Thread(target=_quiet_worker, daemon=True,
+                         name="helper").start()
+        # 4. Neither daemon=True nor joined anywhere in this module.
+        self._t = threading.Thread(target=_quiet_worker, name="dtf-leaky")
+        self._t.start()
+        # 5. Target has no broad except handler whose exception escapes
+        #    (_quiet_worker above also trips this: it only logs).
+        threading.Thread(target=_busy_worker, daemon=True,
+                         name="dtf-nofunnel").start()
+        # 6. Executor workers without a dtf- thread_name_prefix.
+        self._pool = ThreadPoolExecutor(max_workers=1)

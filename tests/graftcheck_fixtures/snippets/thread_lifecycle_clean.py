@@ -1,0 +1,48 @@
+"""Fixture: the async-saver lifecycle contract, satisfied every way the
+pass accepts — literal names, module-constant names, __init__-default
+names, daemon and joined threads, and a funneled target."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+WORKER_NAME = "dtf-fixture-worker"
+
+
+class WorkerError(RuntimeError):
+    """Typed wrapper re-raised on the owning thread."""
+
+    def __init__(self, cause):
+        super().__init__(f"worker failed: {cause!r}")
+        self.__cause__ = cause
+
+
+class Owner:
+    def __init__(self, *, name: str = WORKER_NAME):
+        self._name = name
+        self._lock = threading.Lock()
+        self._error = None
+        # Joined non-daemon thread, name via __init__ parameter default.
+        self._t = threading.Thread(target=self._run, name=self._name)
+        self._t.start()
+        # Daemon thread, name via module constant.
+        threading.Thread(target=self._run, daemon=True,
+                         name=WORKER_NAME).start()
+        # Daemon thread, literal name.
+        threading.Thread(target=self._run, daemon=True,
+                         name="dtf-fixture-aux").start()
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="dtf-fixture-pool")
+
+    def _run(self):
+        try:
+            pass
+        except BaseException as e:  # funneled: stored, surfaced on join
+            with self._lock:
+                self._error = WorkerError(e)
+
+    def close(self):
+        self._t.join()
+        with self._lock:
+            error, self._error = self._error, None
+        if error is not None:
+            raise error

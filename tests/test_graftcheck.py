@@ -51,11 +51,16 @@ def test_registry_has_the_advertised_pass_set():
     ids = set(registry.PASSES)
     assert {"raw-collective", "host-sync-in-step", "config-knob-coverage",
             "telemetry-kind-coverage", "slow-marker", "typed-errors",
+            "thread-lifecycle", "lock-discipline",
             "jaxpr-donation", "jaxpr-f32-upcast",
-            "jaxpr-collective-census"} <= ids
-    assert len(ids) >= 8
+            "jaxpr-collective-census",
+            "hlo-reshard-census", "hlo-donation-survival",
+            "hlo-memory-budget"} <= ids
+    assert len(ids) >= 14
     jaxpr = registry.passes_for_layer(registry.LAYER_JAXPR)
     assert len(jaxpr) >= 2
+    hlo = registry.passes_for_layer(registry.LAYER_HLO)
+    assert len(hlo) == 3
 
 
 def test_duplicate_pass_id_rejected():
@@ -351,6 +356,25 @@ def test_changed_mode_skips_unanchored_repo_passes():
     # Touching an anchor pulls the repo-wide pass back in.
     ids = {p.pass_id for p in cli.select_passes(args, {"docs/CONFIG.md"})}
     assert "config-knob-coverage" in ids
+
+
+def test_changed_mode_skips_trace_layers_unless_trace_flag():
+    """--changed drops the jaxpr/hlo trace passes (seconds of compile
+    time) with an explicit skip list; --trace opts them back in."""
+    parser = cli.build_parser()
+    step = {"distributed_tensorflow_framework_tpu/train/step.py"}
+    args = parser.parse_args(["--changed"])
+    ids = {p.pass_id for p in cli.select_passes(args, step)}
+    assert "jaxpr-donation" not in ids
+    assert "hlo-donation-survival" not in ids
+    skipped = {p.pass_id for p in cli.skipped_trace_passes(args, step)}
+    assert {"jaxpr-donation", "hlo-donation-survival"} <= skipped
+    # Unanchored change: nothing relevant was skipped, no notice.
+    assert cli.skipped_trace_passes(args, {"docs/README.md"}) == []
+    args = parser.parse_args(["--changed", "--trace"])
+    ids = {p.pass_id for p in cli.select_passes(args, step)}
+    assert {"jaxpr-donation", "hlo-donation-survival"} <= ids
+    assert cli.skipped_trace_passes(args, step) == []
 
 
 def test_changed_mode_restricts_per_file_scan():

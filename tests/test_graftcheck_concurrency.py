@@ -1,0 +1,169 @@
+"""graftcheck concurrency-contract passes (thread-lifecycle,
+lock-discipline) — fixture fire/silent proofs plus regression pins for
+the real fixes the passes flushed out of the threaded modules (ISSUE 12):
+
+  * every background thread in the package now carries a ``dtf-*`` name
+    (serve batcher/reporter/drain, infeed prefetch/pull);
+  * the serve reporter thread funnels failures into the typed
+    ``ServeReporterError`` surfaced on ``drain()``;
+  * a failed SIGTERM drain surfaces as ``ServeDrainError`` from
+    ``serve_forever()`` instead of hanging the process with the error
+    lost to a daemon thread.
+
+These are pinned HERE, not suppressed — the shipped suppression file
+carries no thread-lifecycle/lock-discipline entries.
+"""
+
+import ast
+import pathlib
+import signal
+import threading
+import time
+
+import pytest
+
+from tools.graftcheck import cli
+from tools.graftcheck.concurrency_passes import (
+    scan_lock_discipline,
+    scan_thread_lifecycle,
+)
+from tools.graftcheck.findings import load_suppressions
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+SNIP = pathlib.Path(__file__).resolve().parent / "graftcheck_fixtures" / "snippets"
+PKG = ROOT / "distributed_tensorflow_framework_tpu"
+
+THREADED_MODULES = (
+    "ckpt/async_saver.py",
+    "serve/engine.py",
+    "serve/server.py",
+    "data/infeed.py",
+    "core/telemetry.py",
+    "core/goodput.py",
+    "core/faults.py",
+)
+
+
+def _tree(path: pathlib.Path) -> ast.Module:
+    return ast.parse(path.read_text(), filename=str(path))
+
+
+# --------------------------------------------------------- thread-lifecycle --
+def test_thread_lifecycle_fires_on_bad_fixture():
+    findings = scan_thread_lifecycle(
+        "snip.py", _tree(SNIP / "thread_lifecycle_bad.py"))
+    msgs = " ".join(f.message for f in findings)
+    # One finding per broken rule, several threads tripping the funnel:
+    assert "without name=" in msgs
+    assert "not statically resolvable" in msgs
+    assert "lacks the 'dtf-' prefix" in msgs
+    assert "neither daemon=True nor joined" in msgs
+    assert "does not funnel" in msgs
+    assert "ThreadPoolExecutor needs thread_name_prefix" in msgs
+    assert len(findings) >= 6, [f.message for f in findings]
+
+
+def test_thread_lifecycle_silent_on_clean_fixture():
+    findings = scan_thread_lifecycle(
+        "snip.py", _tree(SNIP / "thread_lifecycle_clean.py"))
+    assert findings == [], [f.message for f in findings]
+
+
+# ---------------------------------------------------------- lock-discipline --
+def test_lock_discipline_fires_on_bad_fixture():
+    findings = scan_lock_discipline(
+        "snip.py", _tree(SNIP / "lock_discipline_bad.py"))
+    msgs = [f.message for f in findings]
+    # Racy.count: two bare write sites (bg + API); Lockless.total: one
+    # class-level no-lock finding.
+    assert len(findings) == 3, msgs
+    assert sum("Racy.count" in m and "outside" in m for m in msgs) == 2
+    assert sum("Lockless.total" in m and "owns no lock" in m
+               for m in msgs) == 1
+
+
+def test_lock_discipline_silent_on_clean_fixture():
+    findings = scan_lock_discipline(
+        "snip.py", _tree(SNIP / "lock_discipline_clean.py"))
+    assert findings == [], [f.message for f in findings]
+
+
+# ----------------------------------------- regression pins for the real fixes --
+@pytest.mark.parametrize("rel", THREADED_MODULES)
+def test_threaded_module_passes_both_contracts(rel):
+    """The seven threaded modules are clean under BOTH passes with no
+    suppressions — this pins the dtf-* renames and the exception funnels
+    (pre-fix serve/engine.py, serve/server.py and data/infeed.py all
+    produced findings)."""
+    tree = _tree(PKG / rel)
+    tl = scan_thread_lifecycle(rel, tree)
+    ld = scan_lock_discipline(rel, tree)
+    assert tl == [], [f.message for f in tl]
+    assert ld == [], [f.message for f in ld]
+
+
+def test_no_concurrency_suppressions_shipped():
+    sups, _ = load_suppressions(cli.DEFAULT_SUPPRESSIONS)
+    assert not any(s.pass_id in ("thread-lifecycle", "lock-discipline")
+                   for s in sups)
+
+
+def test_thread_names_are_the_documented_ones():
+    """The exact dtf-* names, greppable in a thread dump."""
+    src = (PKG / "serve" / "engine.py").read_text()
+    assert '"dtf-serve-batcher"' in src
+    assert '"dtf-serve-reporter"' in src
+    assert '"dtf-serve-drain"' in (PKG / "serve" / "server.py").read_text()
+    infeed = (PKG / "data" / "infeed.py").read_text()
+    assert '"dtf-infeed-prefetch"' in infeed
+    assert '"dtf-infeed-pull"' in infeed
+
+
+class _FailingEngine:
+    """Minimal engine whose drain always fails."""
+
+    def stats(self):
+        return {"queue_depth": 0}
+
+    def drain(self, timeout):
+        raise RuntimeError("seeded drain failure")
+
+
+def test_failed_sigterm_drain_surfaces_instead_of_hanging():
+    """Pre-fix, a drain-thread failure left serve_forever() blocked
+    forever (httpd.shutdown() never ran, _done never set) with the error
+    on a daemon thread's stderr. Now it must surface as ServeDrainError
+    from serve_forever() within the join budget."""
+    from distributed_tensorflow_framework_tpu.core.config import ServeConfig
+    from distributed_tensorflow_framework_tpu.serve.server import (
+        ServeDrainError,
+        ServingServer,
+    )
+
+    cfg = ServeConfig(port=0, drain_timeout_s=1.0)
+    server = ServingServer(_FailingEngine(), cfg)
+    old_term = signal.getsignal(signal.SIGTERM)
+    old_int = signal.getsignal(signal.SIGINT)
+    raised: list[BaseException] = []
+
+    def run():
+        try:
+            server.serve_forever()
+        except BaseException as e:  # noqa: BLE001 — the assertion target
+            raised.append(e)
+
+    t = threading.Thread(target=run, daemon=True, name="dtf-test-serve")
+    try:
+        server.install_sigterm_drain()
+        t.start()
+        time.sleep(0.2)
+        signal.raise_signal(signal.SIGTERM)  # handler runs on this thread
+        t.join(timeout=15)
+        assert not t.is_alive(), \
+            "serve_forever still blocked after a failed drain"
+        assert len(raised) == 1 and isinstance(raised[0], ServeDrainError)
+        assert isinstance(raised[0].__cause__, RuntimeError)
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+        server.httpd.server_close()

@@ -1,0 +1,253 @@
+"""graftcheck compiled-HLO layer against the REAL compiled artifacts.
+
+Acceptance pins from ISSUE 12 — each pass catches its seeded regression:
+
+  * ``hlo-reshard-census`` — compiling a program whose shardings force a
+    GSPMD-inserted collective (a matmul contracting over a sharded dim,
+    i.e. a dropped/wrong sharding constraint) produces the finding with
+    shape/bytes/sharding detail; the aligned twin is silent; the real
+    probes and the serve forward are clean.
+  * ``hlo-donation-survival`` — compiling the same step WITHOUT
+    ``donate_argnums`` drops the executable's input_output_alias table
+    and the audit fires; the real compiled step keeps one alias per
+    state leaf.
+  * ``hlo-memory-budget`` — the shrunken/inflated fixture budget trips
+    both sides of the tolerance band against a fixed analysis dict; the
+    checked-in configs/hlo_budgets.json gates the real programs clean.
+
+Compiled artifacts are memoized per process (hlo_passes._COMPILED_CACHE
+over jaxpr_passes._PROBE_CACHE), so these tests and the tier-1
+self-audit share the compile work.
+"""
+
+import importlib.util
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tools.graftcheck import cli, hlo_passes as hp, jaxpr_passes as jp, registry
+from tools.graftcheck.context import RepoContext
+from tools.graftcheck.findings import apply_suppressions, load_suppressions
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+FIX = pathlib.Path(__file__).resolve().parent / "graftcheck_fixtures"
+
+
+def _snippets():
+    spec = importlib.util.spec_from_file_location(
+        "graftcheck_hlo_snippets", FIX / "hlo_snippets.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def ctx(devices):
+    return RepoContext(ROOT)
+
+
+def _mesh_1d(devices):
+    return Mesh(np.array(devices).reshape(8), ("data",))
+
+
+# ------------------------------------------------------------- HLO parsing --
+def test_shape_bytes_reads_tuples_and_dtypes():
+    assert hp.shape_bytes("f32[64,128]{1,0}") == 64 * 128 * 4
+    assert hp.shape_bytes("(bf16[8,4], s8[16])") == 8 * 4 * 2 + 16
+    assert hp.shape_bytes("token[]") == 0
+
+
+def test_collect_collectives_counts_async_pairs_once():
+    text = (
+        "  %ag-start = f32[8]{0} all-gather-start(f32[1]{0} %p), dims={0}\n"
+        "  %ag-done = f32[8]{0} all-gather-done(f32[8]{0} %ag-start)\n"
+        "  ROOT %ar = f32[8]{0} all-reduce(f32[8]{0} %x), to_apply=%add\n")
+    instrs = hp.collect_collectives(text)
+    assert [i["op"] for i in instrs] == ["all-gather", "all-reduce"]
+
+
+# ---------------------------------------------------------- reshard census --
+def test_reshard_census_fires_on_seeded_sharding_mismatch(devices):
+    """The seeded regression: contracting a matmul over a sharded dim —
+    what dropping the step's sharding constraint does — forces GSPMD to
+    insert an all-reduce the jaxpr never declared."""
+    snip = _snippets()
+    mesh = _mesh_1d(devices)
+    xs = jax.ShapeDtypeStruct((64, 256), jnp.float32,
+                              sharding=NamedSharding(mesh, P(None, "data")))
+    ws = jax.ShapeDtypeStruct((256, 128), jnp.float32,
+                              sharding=NamedSharding(mesh, P("data", None)))
+    text = jax.jit(snip.reshard_bad).lower(xs, ws).compile().as_text()
+    instrs = hp.collect_collectives(text)
+    assert any(i["op"] == "all-reduce" for i in instrs), instrs
+    findings = hp.audit_reshard_census("seeded", instrs, {})
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "GSPMD inserted" in msg
+    assert "f32[64,128]" in msg and "32768 bytes" in msg
+
+
+def test_reshard_census_silent_on_aligned_twin(devices):
+    snip = _snippets()
+    mesh = _mesh_1d(devices)
+    a = jax.ShapeDtypeStruct((64, 256), jnp.float32,
+                             sharding=NamedSharding(mesh, P("data", None)))
+    text = jax.jit(snip.reshard_clean).lower(a, a).compile().as_text()
+    instrs = hp.collect_collectives(text)
+    assert instrs == []
+    assert hp.audit_reshard_census("clean", instrs, {}) == []
+
+
+def test_reshard_census_tolerates_fused_and_decomposed_collectives():
+    # XLA lowered all all_to_alls away on CPU (q8 probe) — fewer than
+    # declared must NOT fire; only extras are reshards.
+    assert hp.audit_reshard_census(
+        "x", [], {"all-to-all": 20, "all-gather": 20}) == []
+
+
+def test_reshard_census_pass_clean_on_real_probes(ctx):
+    findings = hp.reshard_census_pass(ctx)
+    assert findings == [], [(f.where, f.message) for f in findings]
+
+
+def test_serve_forward_compiles_with_zero_collectives(ctx):
+    """Replicated params over the dp serving mesh: nothing to reshard."""
+    compiled = hp.get_compiled(ctx, "serve")
+    assert hp.collect_collectives(compiled["text"]) == []
+    assert compiled["analysis"] is not None
+
+
+# -------------------------------------------------------- donation survival --
+def test_donation_survives_to_the_compiled_executable(ctx):
+    for name in hp.DONATION_PROBES:
+        probe = jp.get_probe(ctx, name)
+        entries = hp.count_alias_entries(hp.get_compiled(ctx, name)["text"])
+        assert entries >= probe["n_state_leaves"] > 0, \
+            (name, entries, probe["n_state_leaves"])
+
+
+def test_donation_survival_catches_seeded_regression(ctx):
+    """Compile (not just lower) the same step WITHOUT donate_argnums: the
+    executable's input_output_alias table vanishes and the audit fires."""
+    probe = jp.get_probe(ctx, "jit_f32")
+    undonated = jax.jit(probe["builder"]._train_step_jit)
+    text = undonated.lower(
+        probe["state_shapes"], probe["batch"]).compile().as_text()
+    entries = hp.count_alias_entries(text)
+    assert entries < probe["n_state_leaves"]
+    findings = hp.audit_donation_survival(
+        entries, probe["n_state_leaves"], "hlo:seeded_no_donate")
+    assert len(findings) == 1
+    assert "died in lowering" in findings[0].message
+
+
+def test_donation_survival_pass_clean_on_real_step(ctx):
+    findings = hp.donation_survival_pass(ctx)
+    assert findings == [], [(f.where, f.message) for f in findings]
+
+
+# ----------------------------------------------------------- memory budget --
+_FAKE_ANALYSIS = {
+    "argument_bytes": 1000000,
+    "output_bytes": 500000,
+    "temp_bytes": 750000,
+    "peak_bytes_est": 2000000,
+}
+
+
+def _fixture_entry(which: str) -> dict:
+    data = json.loads((FIX / f"hlo_budgets_{which}.json").read_text())
+    assert data["schema"] == hp.BUDGETS_SCHEMA
+    return data["programs"]["train_step:fixture"]
+
+
+def test_budget_audit_fires_on_seeded_regression_and_staleness():
+    findings = hp.audit_budget_entry(
+        "train_step:fixture", _FAKE_ANALYSIS, _fixture_entry("bad"),
+        tolerance=0.1)
+    msgs = [f.message for f in findings]
+    assert len(findings) == 2, msgs
+    # peak shrunk below actual → regression; output inflated → stale.
+    assert any("peak_bytes_est regressed" in m for m in msgs)
+    assert any("output_bytes budget is stale" in m for m in msgs)
+
+
+def test_budget_audit_silent_on_clean_twin():
+    findings = hp.audit_budget_entry(
+        "train_step:fixture", _FAKE_ANALYSIS, _fixture_entry("clean"),
+        tolerance=0.1)
+    assert findings == [], [f.message for f in findings]
+
+
+def test_budget_band_has_an_absolute_floor():
+    # A 10-byte program must not flap on a 12-byte wobble.
+    entry = {f: 10 for f in hp.BUDGET_FIELDS}
+    analysis = {f: 22 for f in hp.BUDGET_FIELDS}
+    assert hp.audit_budget_entry("x", analysis, entry, tolerance=0.1) == []
+
+
+def test_missing_budgets_file_is_an_internal_error(tmp_path):
+    ctx = RepoContext(tmp_path)
+    findings = hp.memory_budget_pass(ctx)
+    assert len(findings) == 1
+    assert findings[0].severity == "internal-error"
+    assert "--update-budgets" in findings[0].message
+
+
+def test_jax_version_drift_asks_for_regeneration_not_noise(tmp_path):
+    path = tmp_path / "configs" / "hlo_budgets.json"
+    path.parent.mkdir()
+    data = json.loads((FIX / "hlo_budgets_clean.json").read_text())
+    path.write_text(json.dumps(data))
+    findings = hp.memory_budget_pass(RepoContext(tmp_path))
+    assert len(findings) == 1  # one notice, not one per program/field
+    assert "jax fixture" in findings[0].message
+    assert "--update-budgets" in findings[0].message
+
+
+def test_checked_in_budgets_gate_the_real_programs_clean(ctx):
+    """The committed configs/hlo_budgets.json covers every budgeted
+    program with a matching probe-config digest and passes the gate."""
+    budgets = hp.load_budgets(hp.budgets_path(ctx))
+    assert set(budgets["programs"]) == set(hp.BUDGET_PROGRAMS)
+    for program, probe_name in hp.BUDGET_PROGRAMS.items():
+        assert budgets["programs"][program]["config_sha256"] == \
+            hp.probe_config_digest(probe_name), program
+    findings = hp.memory_budget_pass(ctx)
+    assert findings == [], [(f.where, f.message) for f in findings]
+
+
+def test_update_budgets_round_trips(ctx, tmp_path):
+    out = tmp_path / "budgets.json"
+    hp.write_budgets(ctx, out)
+    written = hp.load_budgets(out)
+    assert written["provenance"]["jax"] == jax.__version__
+    assert set(written["programs"]) == set(hp.BUDGET_PROGRAMS)
+    for program in hp.BUDGET_PROGRAMS:
+        entry = written["programs"][program]
+        findings = hp.audit_budget_entry(
+            program, entry, entry, written["tolerance_frac"])
+        assert findings == []
+
+
+# -------------------------------------------------------------- self-audit --
+def test_registry_advertises_the_hlo_layer():
+    hlo = registry.passes_for_layer(registry.LAYER_HLO)
+    assert {p.pass_id for p in hlo} == {
+        "hlo-reshard-census", "hlo-donation-survival", "hlo-memory-budget"}
+    assert registry.LAYER_HLO in registry.TRACE_LAYERS
+
+
+def test_self_audit_hlo_layer_clean(ctx):
+    findings = []
+    for info in registry.passes_for_layer(registry.LAYER_HLO):
+        findings.extend(info.fn(ctx))
+    sups, _ = load_suppressions(cli.DEFAULT_SUPPRESSIONS)
+    apply_suppressions(findings, sups)
+    active = [f for f in findings if not f.suppressed]
+    assert active == [], [(f.pass_id, f.where, f.message) for f in active]

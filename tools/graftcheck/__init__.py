@@ -1,14 +1,19 @@
 """graftcheck — framework-aware static analysis for this repo.
 
-Two layers (docs/STATIC_ANALYSIS.md):
+Three layers (docs/STATIC_ANALYSIS.md):
 
   * **ast** — stdlib ``ast`` passes over the package and tests: raw-collective
     ban, host-sync-in-step, config-knob coverage, telemetry-kind coverage,
-    slow-marker audit, typed-error conventions.
+    slow-marker audit, typed-error conventions, and the concurrency
+    contracts (thread-lifecycle, lock-discipline).
   * **jaxpr** — trace audits that jit-trace the real train step on the
     8-device CPU mesh and walk the ClosedJaxpr: donation elision, f32
     upcasts of bf16/int8-designated tensors, and the collective-op census
     cross-checked against the ``CollectiveTally`` the same trace records.
+  * **hlo** — compiled-artifact audits that ``lower().compile()`` the real
+    train step and serve forward and read the optimized module: GSPMD
+    reshard census, input_output_alias donation survival, and
+    ``memory_analysis()`` bytes gated against ``configs/hlo_budgets.json``.
 
 Entry point: ``scripts/graftcheck.py`` (human table + ``dtf-lint-report/1``
 JSON, per-finding suppression file, distinct exit codes). The suite is
@@ -31,4 +36,6 @@ from tools.graftcheck.registry import PASSES, get_pass, passes_for_layer  # noqa
 
 # Importing the pass modules registers them.
 from tools.graftcheck import ast_passes as _ast_passes  # noqa: E402,F401
+from tools.graftcheck import concurrency_passes as _concurrency_passes  # noqa: E402,F401
 from tools.graftcheck import jaxpr_passes as _jaxpr_passes  # noqa: E402,F401
+from tools.graftcheck import hlo_passes as _hlo_passes  # noqa: E402,F401

@@ -38,8 +38,9 @@ class _Parser(argparse.ArgumentParser):
 def build_parser() -> argparse.ArgumentParser:
     p = _Parser(
         prog="graftcheck",
-        description="framework-aware static analysis: AST lints + jaxpr "
-                    "trace audits (docs/STATIC_ANALYSIS.md)")
+        description="framework-aware static analysis: AST lints, jaxpr "
+                    "trace audits, compiled-HLO audits "
+                    "(docs/STATIC_ANALYSIS.md)")
     p.add_argument("--root", default=".", help="repo root (default: cwd)")
     p.add_argument("--layer", choices=registry.LAYERS,
                    help="run only this layer's passes")
@@ -49,8 +50,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--changed", action="store_true",
                    help="fast pre-commit mode: scan only files changed vs "
                    "HEAD; anchored repo-wide passes run only when an anchor "
-                   "changed; jaxpr passes are skipped unless named with "
-                   "--pass or --layer jaxpr")
+                   "changed; trace passes (jaxpr/hlo) are skipped with a "
+                   "notice unless --trace, --pass, or --layer opts them in")
+    p.add_argument("--trace", action="store_true",
+                   help="with --changed: run the jaxpr/hlo trace passes "
+                   "too (seconds of compile time) instead of skipping them")
+    p.add_argument("--update-budgets", action="store_true",
+                   help="fresh-compile every budgeted program and rewrite "
+                   "configs/hlo_budgets.json (provenance: jax version, "
+                   "mesh, config digest) — budget bumps land as reviewable "
+                   "diffs, never silently")
     p.add_argument("--list-passes", action="store_true",
                    help="list registered passes and exit")
     p.add_argument("--json", metavar="FILE",
@@ -70,13 +79,25 @@ def select_passes(args, changed: set[str] | None) -> list[registry.PassInfo]:
     infos = list(registry.PASSES.values())
     if args.layer:
         infos = [p for p in infos if p.layer == args.layer]
-    elif args.changed:
-        # jaxpr probes cost seconds; the fast pre-commit loop is AST-only
-        # unless the caller asks for the trace audits explicitly.
+    elif args.changed and not getattr(args, "trace", False):
+        # Trace layers (jaxpr/hlo) compile the real step — seconds, not
+        # milliseconds; the fast pre-commit loop is AST-only unless the
+        # caller opts back in with --trace (main() prints the skip count).
         infos = [p for p in infos if p.layer == registry.LAYER_AST]
     if changed is not None:
         infos = [p for p in infos if p.relevant_for_changed(changed)]
     return infos
+
+
+def skipped_trace_passes(args, changed: set[str]) -> list[registry.PassInfo]:
+    """The trace (jaxpr/hlo) passes a --changed run dropped — the explicit
+    notice keeps the fast path honest about what it did NOT check."""
+    if not args.changed or args.layer or args.passes \
+            or getattr(args, "trace", False):
+        return []
+    return [p for p in registry.PASSES.values()
+            if p.layer in registry.TRACE_LAYERS
+            and p.relevant_for_changed(changed)]
 
 
 def run_passes(ctx: RepoContext,
@@ -125,6 +146,19 @@ def main(argv: list[str] | None = None) -> int:
         return EXIT_CLEAN
 
     root = pathlib.Path(args.root).resolve()
+
+    if args.update_budgets:
+        from tools.graftcheck import hlo_passes
+        ctx = RepoContext(root)
+        try:
+            path = hlo_passes.write_budgets(ctx)
+        except Exception as exc:
+            print(f"graftcheck: --update-budgets failed: {exc!r}",
+                  file=sys.stderr)
+            return EXIT_INTERNAL
+        print(f"graftcheck: wrote {path} — review and commit the diff")
+        return EXIT_CLEAN
+
     changed = None
     if args.changed:
         try:
@@ -138,6 +172,13 @@ def main(argv: list[str] | None = None) -> int:
     except KeyError as exc:
         print(f"graftcheck: {exc.args[0]}", file=sys.stderr)
         return EXIT_USAGE
+
+    if changed is not None:
+        skipped = skipped_trace_passes(args, changed)
+        if skipped:
+            print(f"graftcheck: {len(skipped)} trace passes skipped in "
+                  f"--changed mode ({', '.join(sorted(p.pass_id for p in skipped))})"
+                  f" — add --trace to run them")
 
     ctx = RepoContext(root, changed=changed)
     findings = run_passes(ctx, infos)

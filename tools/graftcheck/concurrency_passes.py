@@ -1,0 +1,562 @@
+"""Layer-1 (AST) concurrency-contract passes.
+
+Four package modules spawn background threads (ckpt/async_saver,
+serve/engine, serve/server, data/infeed) and three more share state with
+them under locks (core/telemetry, core/goodput, core/faults) — all under
+conventions no ordinary linter knows about. These two passes make the
+async-saver contract — the reference implementation in
+``ckpt/async_saver.py`` — machine-checked across the package:
+
+  * ``thread-lifecycle`` — every ``threading.Thread`` must (1) carry a
+    ``dtf-*`` name (statically resolvable, so ``ps``/py-spy dumps read as
+    ours), (2) be daemon or joined somewhere in its module (a non-daemon
+    unjoined thread hangs process exit on a stuck write), and (3) have a
+    target that funnels exceptions into a typed error surfaced on the
+    owning thread — a broad except handler whose bound exception ESCAPES
+    (stored, passed to a call, or re-raised), not one that only logs.
+    ``ThreadPoolExecutor`` gets the name rule via ``thread_name_prefix``.
+  * ``lock-discipline`` — within a class that starts threads, a field
+    assigned from two or more thread groups (the main/API group plus each
+    thread target's reachable methods) must only be written under one of
+    the class's locks (``with self.<lock>`` lexically, or inside a method
+    named ``*_locked`` — the repo's held-lock naming convention) or be an
+    inherently thread-safe handoff type (``queue.Queue``, ``Event``, …).
+    Single-writer fields stay unflagged: the contract is about racing
+    writers, not about wrapping every counter.
+
+Both are pure-``ast`` passes (no jax import) and run in the ``--changed``
+pre-commit loop. File-level helpers (``scan_thread_lifecycle``,
+``scan_lock_discipline``) are public for the fixture tests.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.context import RepoContext
+from tools.graftcheck.findings import Finding
+from tools.graftcheck.registry import LAYER_AST, register
+
+THREAD_NAME_PREFIX = "dtf-"
+
+_LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition"})
+_EXEMPT_FACTORIES = frozenset({
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier",
+})
+_BROAD_EXC = frozenset({"Exception", "BaseException"})
+_LOG_ROOTS = frozenset({"log", "logger", "logging"})
+_LOCKED_SUFFIX = "_locked"
+
+
+# ----------------------------------------------------------- AST helpers --
+def _call_name(node: ast.expr) -> str | None:
+    """Trailing name of a call target: ``threading.Thread`` → "Thread"."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _kwarg(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_self_attr(node: ast.expr) -> ast.Attribute | None:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node
+    return None
+
+
+def _module_str_consts(tree: ast.Module) -> dict[str, str]:
+    out = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _init_param_defaults(cls: ast.ClassDef) -> dict[str, ast.expr]:
+    """kwarg name → default expr for the class's ``__init__``."""
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            a = node.args
+            out: dict[str, ast.expr] = {}
+            pos = a.posonlyargs + a.args
+            for arg, default in zip(pos[len(pos) - len(a.defaults):],
+                                    a.defaults):
+                out[arg.arg] = default
+            for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+                if default is not None:
+                    out[arg.arg] = default
+            return out
+    return {}
+
+
+def _init_self_assigns(cls: ast.ClassDef) -> dict[str, ast.expr]:
+    """``self.X = expr`` assignments in ``__init__``."""
+    out: dict[str, ast.expr] = {}
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    attr = _is_self_attr(sub.targets[0])
+                    if attr is not None:
+                        out[attr.attr] = sub.value
+    return out
+
+
+def resolve_thread_name(expr: ast.expr, tree: ast.Module,
+                        cls: ast.ClassDef | None) -> str | None:
+    """Statically resolve a ``name=``/``thread_name_prefix=`` expression:
+    literal → module constant → ``self.attr`` set in ``__init__`` from a
+    parameter default (the async_saver chain). None = not resolvable."""
+    consts = _module_str_consts(tree)
+    defaults = _init_param_defaults(cls) if cls is not None else {}
+    self_assigns = _init_self_assigns(cls) if cls is not None else {}
+
+    def resolve(node: ast.expr, depth: int) -> str | None:
+        if depth > 4 or node is None:
+            return None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id in consts:
+                return consts[node.id]
+            if node.id in defaults:
+                return resolve(defaults[node.id], depth + 1)
+            return None
+        attr = _is_self_attr(node)
+        if attr is not None and attr.attr in self_assigns:
+            return resolve(self_assigns[attr.attr], depth + 1)
+        return None
+
+    return resolve(expr, 0)
+
+
+def _enclosing_maps(tree: ast.Module):
+    """(node → enclosing ClassDef, node → enclosing FunctionDef chain,
+    innermost first). The chain matters: a Thread() call inside a signal
+    handler may target a sibling defined one function up."""
+    cls_of: dict[ast.AST, ast.ClassDef] = {}
+    fns_of: dict[ast.AST, tuple[ast.FunctionDef, ...]] = {}
+
+    def walk(node, cls, fns):
+        for child in ast.iter_child_nodes(node):
+            c, f = cls, fns
+            if isinstance(child, ast.ClassDef):
+                c = child
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                f = (child,) + fns
+            if cls is not None:
+                cls_of[child] = cls
+            if fns:
+                fns_of[child] = fns
+            walk(child, c, f)
+
+    walk(tree, None, ())
+    return cls_of, fns_of
+
+
+def _resolve_target_fn(target: ast.expr, tree: ast.Module,
+                       cls: ast.ClassDef | None,
+                       enclosing_fns: tuple[ast.FunctionDef, ...]
+                       ) -> ast.FunctionDef | None:
+    """The FunctionDef a ``target=`` expression names: ``self.meth``, a
+    nested function in any enclosing function (innermost scope wins), or
+    a module-level def."""
+    attr = _is_self_attr(target)
+    if attr is not None and cls is not None:
+        for node in cls.body:
+            if isinstance(node, ast.FunctionDef) and node.name == attr.attr:
+                return node
+    if isinstance(target, ast.Name):
+        scopes = [fn.body for fn in enclosing_fns]
+        scopes.append(tree.body)
+        for body in scopes:
+            for node in body:
+                if (isinstance(node, ast.FunctionDef)
+                        and node.name == target.id):
+                    return node
+    return None
+
+
+def _is_log_call(call: ast.Call) -> bool:
+    func = call.func
+    return (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in _LOG_ROOTS)
+
+
+def _exception_escapes(handler: ast.ExceptHandler) -> bool:
+    """Does the bound exception leave the handler — assigned somewhere,
+    passed into a (non-logging) call, or re-raised? Logging alone is the
+    silent-daemon-stderr failure mode the contract forbids."""
+    bound = handler.name
+    if not bound:
+        return False
+
+    def contains_bound(node: ast.AST) -> bool:
+        return any(isinstance(n, ast.Name) and n.id == bound
+                   for n in ast.walk(node))
+
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                if contains_bound(node.value):
+                    return True
+            elif isinstance(node, ast.Raise):
+                if ((node.exc is not None and contains_bound(node.exc))
+                        or (node.cause is not None
+                            and contains_bound(node.cause))):
+                    return True
+            elif isinstance(node, ast.Call) and not _is_log_call(node):
+                if any(contains_bound(a) for a in node.args):
+                    return True
+    return False
+
+
+def _own_nodes(fn: ast.FunctionDef):
+    """Nodes of ``fn`` excluding nested function bodies (those are
+    separate audit targets)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _has_exception_funnel(fn: ast.FunctionDef) -> bool:
+    """A broad except handler (Exception/BaseException) in the target
+    whose bound exception escapes."""
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            types = []
+            if isinstance(handler.type, ast.Tuple):
+                types = [_call_name(e) for e in handler.type.elts]
+            elif handler.type is not None:
+                types = [_call_name(handler.type)]
+            if not set(types) & _BROAD_EXC:
+                continue
+            if _exception_escapes(handler):
+                return True
+    return False
+
+
+# ---------------------------------------------------- thread-lifecycle --
+def scan_thread_lifecycle(rel: str, tree: ast.Module) -> list[Finding]:
+    out = []
+    cls_of, fns_of = _enclosing_maps(tree)
+    source_has_join: set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            owner = node.func.value
+            attr = _is_self_attr(owner)
+            if attr is not None:
+                source_has_join.add(attr.attr)
+            elif isinstance(owner, ast.Name):
+                source_has_join.add(owner.id)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _call_name(node.func)
+        where = f"{rel}:{node.lineno}"
+        cls = cls_of.get(node)
+        enclosing = fns_of.get(node, ())
+
+        if callee == "ThreadPoolExecutor":
+            prefix = _kwarg(node, "thread_name_prefix")
+            resolved = (resolve_thread_name(prefix, tree, cls)
+                        if prefix is not None else None)
+            if resolved is None or not resolved.startswith(
+                    THREAD_NAME_PREFIX):
+                out.append(Finding(
+                    "thread-lifecycle", where,
+                    f"ThreadPoolExecutor needs thread_name_prefix="
+                    f"'{THREAD_NAME_PREFIX}*' (got "
+                    f"{resolved!r}) so its workers read as ours in "
+                    f"thread dumps"))
+            continue
+        if callee != "Thread":
+            continue
+
+        name_expr = _kwarg(node, "name")
+        if name_expr is None:
+            out.append(Finding(
+                "thread-lifecycle", where,
+                f"threading.Thread without name= — background threads "
+                f"must carry a '{THREAD_NAME_PREFIX}*' name so thread "
+                f"dumps attribute them"))
+        else:
+            resolved = resolve_thread_name(name_expr, tree, cls)
+            if resolved is None:
+                out.append(Finding(
+                    "thread-lifecycle", where,
+                    f"thread name is not statically resolvable — use a "
+                    f"'{THREAD_NAME_PREFIX}*' literal, module constant, "
+                    f"or __init__ parameter default"))
+            elif not resolved.startswith(THREAD_NAME_PREFIX):
+                out.append(Finding(
+                    "thread-lifecycle", where,
+                    f"thread name {resolved!r} lacks the "
+                    f"'{THREAD_NAME_PREFIX}' prefix the module contract "
+                    f"requires"))
+
+        daemon = _kwarg(node, "daemon")
+        is_daemon = (isinstance(daemon, ast.Constant)
+                     and daemon.value is True)
+        if not is_daemon:
+            # Non-daemon is fine only when the module joins the thread:
+            # find the binding this Thread lands in.
+            joined = False
+            parent_assign = None
+            for cand in ast.walk(tree):
+                if isinstance(cand, ast.Assign) and any(
+                        n is node for n in ast.walk(cand.value)):
+                    parent_assign = cand
+                    break
+            if parent_assign is not None:
+                for tgt in parent_assign.targets:
+                    attr = _is_self_attr(tgt)
+                    if attr is not None and attr.attr in source_has_join:
+                        joined = True
+                    elif (isinstance(tgt, ast.Name)
+                          and tgt.id in source_has_join):
+                        joined = True
+            if not joined:
+                out.append(Finding(
+                    "thread-lifecycle", where,
+                    "thread is neither daemon=True nor joined in this "
+                    "module — a stuck write would hang process exit"))
+
+        target = _kwarg(node, "target")
+        if target is None:
+            out.append(Finding(
+                "thread-lifecycle", where,
+                "Thread without target= cannot be audited for the "
+                "exception-funnel contract"))
+            continue
+        target_fn = _resolve_target_fn(target, tree, cls, enclosing)
+        if target_fn is None:
+            out.append(Finding(
+                "thread-lifecycle", where,
+                "thread target is not statically resolvable (method, "
+                "nested function, or module function) — the "
+                "exception-funnel contract cannot be audited"))
+        elif not _has_exception_funnel(target_fn):
+            out.append(Finding(
+                "thread-lifecycle", where,
+                f"thread target {target_fn.name!r} does not funnel "
+                f"exceptions: it needs a broad except handler whose bound "
+                f"exception escapes into a typed error surfaced on the "
+                f"owning thread (ckpt/async_saver.py is the reference), "
+                f"not a log-and-vanish"))
+    return out
+
+
+# ------------------------------------------------------ lock-discipline --
+def _self_calls(fn: ast.FunctionDef) -> set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            attr = _is_self_attr(node.func)
+            if attr is not None:
+                out.add(attr.attr)
+    return out
+
+
+def _attr_writes(fn: ast.FunctionDef, lock_attrs: set[str]
+                 ) -> list[tuple[str, int, bool]]:
+    """(attr, lineno, under_lock) for every ``self.X = ...`` store in
+    ``fn``, excluding nested defs. ``under_lock`` is lexical: inside a
+    ``with self.<lock>`` block, or the whole method when its name carries
+    the ``*_locked`` held-lock convention."""
+    writes: list[tuple[str, int, bool]] = []
+    held_by_name = fn.name.endswith(_LOCKED_SUFFIX)
+
+    def targets_of(stmt) -> list[ast.expr]:
+        if isinstance(stmt, ast.Assign):
+            flat = []
+            for t in stmt.targets:
+                flat.extend(t.elts if isinstance(t, ast.Tuple) else [t])
+            return flat
+        if isinstance(stmt, ast.AugAssign):
+            return [stmt.target]
+        return []
+
+    def visit(node, under: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            now = under
+            if isinstance(child, ast.With):
+                for item in child.items:
+                    attr = _is_self_attr(item.context_expr)
+                    if attr is not None and attr.attr in lock_attrs:
+                        now = True
+            for tgt in targets_of(child):
+                attr = _is_self_attr(tgt)
+                if attr is not None:
+                    writes.append((attr.attr, child.lineno,
+                                   now or held_by_name))
+            visit(child, now)
+
+    visit(fn, held_by_name)
+    return writes
+
+
+def scan_lock_discipline(rel: str, tree: ast.Module) -> list[Finding]:
+    out = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, ast.FunctionDef)}
+        # Lock and exempt attrs from any `self.X = factory()` assignment.
+        lock_attrs: set[str] = set()
+        exempt_attrs: set[str] = set()
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            attr = _is_self_attr(node.targets[0])
+            if attr is None or not isinstance(node.value, ast.Call):
+                continue
+            factory = _call_name(node.value.func)
+            if factory in _LOCK_FACTORIES:
+                lock_attrs.add(attr.attr)
+            elif factory in _EXEMPT_FACTORIES:
+                exempt_attrs.add(attr.attr)
+
+        # Background entries: Thread(target=self.M | nested fn) started in
+        # this class. Nested-function targets are audited as their own
+        # group.
+        entries: list[ast.FunctionDef] = []
+        for node in ast.walk(cls):
+            if (isinstance(node, ast.Call)
+                    and _call_name(node.func) == "Thread"):
+                target = _kwarg(node, "target")
+                if target is None:
+                    continue
+                attr = _is_self_attr(target)
+                if attr is not None and attr.attr in methods:
+                    entries.append(methods[attr.attr])
+                elif isinstance(target, ast.Name):
+                    for meth in methods.values():
+                        for sub in ast.walk(meth):
+                            if (isinstance(sub, ast.FunctionDef)
+                                    and sub.name == target.id):
+                                entries.append(sub)
+        if not entries:
+            continue
+
+        def reachable(fn: ast.FunctionDef) -> set[str]:
+            seen: set[str] = set()
+            frontier = [fn]
+            while frontier:
+                cur = frontier.pop()
+                for callee in _self_calls(cur):
+                    if callee not in seen and callee in methods:
+                        seen.add(callee)
+                        frontier.append(methods[callee])
+            return seen
+
+        bg_names = [{e.name} | reachable(e) for e in entries]
+        all_bg = set().union(*bg_names)
+        # Main group: public surface — methods that are not thread
+        # entries — plus everything they reach. __init__ is excluded:
+        # it runs before any thread starts.
+        entry_names = {e.name for e in entries}
+        seeds = [m for name, m in methods.items()
+                 if name not in entry_names and name != "__init__"
+                 and name not in all_bg]
+        main_names: set[str] = set()
+        for seed in seeds:
+            main_names |= {seed.name} | reachable(seed)
+        main_names -= entry_names
+        main_names.discard("__init__")
+
+        # attr → {group index} and the write sites (lineno → under_lock);
+        # a method shared by several groups records each site once.
+        groups_of: dict[str, set[int]] = {}
+        sites: dict[str, dict[int, bool]] = {}
+
+        def record(fn: ast.FunctionDef, group: int):
+            for attr, lineno, under in _attr_writes(fn, lock_attrs):
+                if attr in lock_attrs or attr in exempt_attrs:
+                    continue
+                groups_of.setdefault(attr, set()).add(group)
+                sites.setdefault(attr, {})[lineno] = under
+
+        for name in main_names:
+            record(methods[name], 0)
+        for i, (entry, names) in enumerate(zip(entries, bg_names), start=1):
+            if entry.name in methods:
+                for name in names:
+                    record(methods[name], i)
+            else:  # nested-function target: its body plus reached methods
+                record(entry, i)
+                for name in reachable(entry):
+                    record(methods[name], i)
+
+        for attr in sorted(groups_of):
+            if len(groups_of[attr]) < 2:
+                continue
+            if not lock_attrs:
+                out.append(Finding(
+                    "lock-discipline",
+                    f"{rel}:{min(sites[attr])}",
+                    f"{cls.name}.{attr} is written from {len(groups_of[attr])} "
+                    f"thread groups but the class owns no lock "
+                    f"(threading.Lock/RLock/Condition) to serialize them"))
+                continue
+            for lineno, under in sorted(sites[attr].items()):
+                if not under:
+                    out.append(Finding(
+                        "lock-discipline", f"{rel}:{lineno}",
+                        f"{cls.name}.{attr} is written from multiple "
+                        f"threads but this write is outside `with "
+                        f"self.<lock>` (and not in a *{_LOCKED_SUFFIX} "
+                        f"method) — racing writers corrupt the field"))
+    return out
+
+
+# ----------------------------------------------------------------- passes --
+@register(
+    "thread-lifecycle", LAYER_AST,
+    "every threading.Thread is daemon-or-joined, carries a dtf-* name, "
+    "and its target funnels exceptions into a typed error on the owning "
+    "thread (the async-saver contract, generalized)")
+def thread_lifecycle_pass(ctx: RepoContext) -> list[Finding]:
+    findings = []
+    for path in ctx.pkg_files():
+        if not ctx.selected(path):
+            continue
+        findings.extend(scan_thread_lifecycle(ctx.rel(path), ctx.tree(path)))
+    return findings
+
+
+@register(
+    "lock-discipline", LAYER_AST,
+    "fields written from >=2 thread groups in a class must be written "
+    "under the class's lock (or be Queue/Event handoff types)")
+def lock_discipline_pass(ctx: RepoContext) -> list[Finding]:
+    findings = []
+    for path in ctx.pkg_files():
+        if not ctx.selected(path):
+            continue
+        findings.extend(scan_lock_discipline(ctx.rel(path), ctx.tree(path)))
+    return findings

@@ -1,0 +1,474 @@
+"""Layer-3 (compiled-HLO) passes.
+
+The jaxpr layer stops where XLA starts: GSPMD resharding, donation that
+dies in lowering, and the program's real byte footprint are all decided
+AFTER the trace. These audits ``lower().compile()`` the real train step
+(and the serve forward) on the 8-device CPU mesh and read the optimized
+artifact itself:
+
+  * ``hlo-reshard-census`` — every all-gather/all-reduce/all-to-all/
+    collective-permute in the optimized module must map to a
+    jaxpr-declared collective. XLA may FUSE or decompose declared
+    collectives (fewer ops than the jaxpr is fine — e.g. CPU lowers
+    all-to-all away entirely), but an EXTRA op is a GSPMD-inserted
+    reshard: reported with its shape, byte count, and the sharding/op
+    provenance the compiler recorded for it.
+  * ``hlo-donation-survival`` — the ``input_output_alias`` table of the
+    compiled executable must carry one entry per train-state leaf.
+    Donation can survive tracing (the jaxpr-layer check) and still be
+    dropped in lowering; this is the check against the artifact that
+    actually runs.
+  * ``hlo-memory-budget`` — ``compiled.memory_analysis()`` byte figures
+    (the same fields core/memstats.py reports) gated against the
+    checked-in ``configs/hlo_budgets.json`` with a two-sided tolerance
+    band: over budget is an HBM regression caught before a chip window
+    is spent, far under budget is a stale budget that must be
+    regenerated (``scripts/graftcheck.py --update-budgets``) so the bump
+    shows up as a reviewable diff.
+
+Compiled artifacts are built once per process (``_COMPILED_CACHE``) on
+top of the jaxpr layer's probe cache, so the tier-1 self-audit and the
+dedicated tests share the compile work.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+import re
+
+from tools.graftcheck import jaxpr_passes as jp
+from tools.graftcheck.context import RepoContext
+from tools.graftcheck.findings import SEVERITY_INTERNAL, Finding
+from tools.graftcheck.registry import LAYER_HLO, register
+
+BUDGETS_SCHEMA = "dtf-hlo-budgets/1"
+BUDGETS_RELPATH = pathlib.Path("configs") / "hlo_budgets.json"
+DEFAULT_TOLERANCE = 0.10
+# Absolute slack floor so a few-KiB layout wobble on a small program
+# can't flap the gate (the band is max(budget*tol, this)).
+MIN_SLACK_BYTES = 64 * 1024
+
+BUDGET_FIELDS = ("argument_bytes", "output_bytes", "temp_bytes",
+                 "peak_bytes_est")
+
+# Probes whose compiled module gets the reshard census: shard_map probes
+# declare every collective in the jaxpr, so an extra optimized-HLO
+# collective is compiler-inserted. jit-mode probes hand XLA an
+# unpartitioned program where the grad all-reduce is legitimately
+# compiler-owned — a census there would be all noise.
+CENSUS_PROBES = jp.CENSUS_PROBES
+# Probes whose compiled module must keep the donation aliases.
+DONATION_PROBES = ("jit_f32",) + jp.CENSUS_PROBES
+# program name in hlo_budgets.json → probe ("serve" = the serve forward).
+BUDGET_PROGRAMS = {
+    "train_step:jit_f32": "jit_f32",
+    "train_step:shard_dp_fsdp": "shard_dp_fsdp",
+    "train_step:shard_q8_ef": "shard_q8_ef",
+    "train_step:shard_zero": "shard_zero",
+    "serve_forward:lenet5": "serve",
+}
+
+# jaxpr collective primitive → optimized-HLO opcode.
+PRIM_TO_HLO_OP = {
+    "psum": "all-reduce",
+    "pmin": "all-reduce",
+    "pmax": "all-reduce",
+    "all_gather": "all-gather",
+    "reduce_scatter": "reduce-scatter",
+    "all_to_all": "all-to-all",
+    "ppermute": "collective-permute",
+}
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_INSTR = re.compile(
+    r"^\s*(?:ROOT )?%?[\w.-]+ = (?P<rtype>.*?) "
+    r"(?P<op>all-reduce-start|all-gather-start|collective-permute-start|"
+    r"all-reduce|all-gather|all-to-all|collective-permute|reduce-scatter)"
+    r"\(", re.M)
+_SHAPE = re.compile(r"(\w+)\[([\d,]*)\]")
+_SHARDING = re.compile(r"sharding=(\{[^}]*\})")
+_OP_NAME = re.compile(r'op_name="([^"]+)"')
+
+
+# ------------------------------------------------------------ HLO parsing --
+def shape_bytes(rtype: str) -> int:
+    """Total bytes of an HLO result type (sums tuple elements)."""
+    total = 0
+    for dtype, dims in _SHAPE.findall(rtype):
+        if dtype not in _DTYPE_BYTES:
+            continue  # token[...] that isn't a shape (e.g. layout braces)
+        n = 1
+        for d in dims.split(","):
+            if d.strip():
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collect_collectives(hlo_text: str) -> list[dict]:
+    """Collective instructions of the optimized module, with the result
+    shape, byte count, and the sharding/op_name provenance XLA kept.
+    ``*-start`` async forms count once (the ``*-done`` halves don't
+    match), so fused/async lowering can't double-count."""
+    out = []
+    for m in _INSTR.finditer(hlo_text):
+        line = hlo_text[m.start():hlo_text.find("\n", m.start())]
+        sharding = _SHARDING.search(line)
+        op_name = _OP_NAME.search(line)
+        out.append({
+            "op": m.group("op").replace("-start", ""),
+            "rtype": m.group("rtype"),
+            "bytes": shape_bytes(m.group("rtype")),
+            "sharding": sharding.group(1) if sharding else None,
+            "op_name": op_name.group(1) if op_name else None,
+        })
+    return out
+
+
+def count_alias_entries(hlo_text: str) -> int:
+    """Entries of the module-header ``input_output_alias={...}`` table —
+    one per donated (parameter, output) pair in the compiled executable."""
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return 0
+    i = hlo_text.find("{", start)
+    depth, j = 0, i
+    while j < len(hlo_text):
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        j += 1
+    region = hlo_text[i:j + 1]
+    # Each entry reads ``{out_index}: (param, {param_index}, kind)``.
+    return len(re.findall(r"\}:\s*\(", region))
+
+
+def expected_hlo_census(jaxpr_census: dict[str, int]) -> dict[str, int]:
+    """Map a jaxpr-primitive census onto optimized-HLO opcode counts."""
+    out: dict[str, int] = {}
+    for prim, n in jaxpr_census.items():
+        op = PRIM_TO_HLO_OP.get(prim)
+        if op is not None:
+            out[op] = out.get(op, 0) + n
+    return out
+
+
+# ---------------------------------------------------------------- verdicts --
+def audit_reshard_census(name: str, instrs: list[dict],
+                         expected: dict[str, int]) -> list[Finding]:
+    """Pure verdict: extra optimized-HLO collectives beyond the
+    jaxpr-declared counts are GSPMD-inserted reshards. Fewer is fine —
+    XLA fuses and decomposes declared collectives."""
+    findings = []
+    actual: dict[str, list[dict]] = {}
+    for ins in instrs:
+        actual.setdefault(ins["op"], []).append(ins)
+    for op in sorted(actual):
+        got, want = len(actual[op]), expected.get(op, 0)
+        if got <= want:
+            continue
+        examples = []
+        for ins in actual[op][:3]:
+            examples.append(
+                f"{ins['rtype']} (~{ins['bytes']} bytes, sharding="
+                f"{ins['sharding'] or 'unannotated'}, from "
+                f"{ins['op_name'] or 'unattributed op'})")
+        findings.append(Finding(
+            "hlo-reshard-census", f"hlo:{name}/{op}",
+            f"{got - want} {op} op(s) in the optimized module beyond the "
+            f"{want} the jaxpr declares — GSPMD inserted reshard(s) for a "
+            f"sharding mismatch the step never asked for: "
+            f"{'; '.join(examples)}"))
+    return findings
+
+
+def audit_donation_survival(alias_entries: int, n_state_leaves: int,
+                            where: str) -> list[Finding]:
+    """Pure verdict: the compiled executable must alias one input-output
+    pair per state leaf, or donation died in lowering."""
+    if alias_entries >= n_state_leaves:
+        return []
+    return [Finding(
+        "hlo-donation-survival", where,
+        f"compiled executable aliases only {alias_entries} of "
+        f"{n_state_leaves} train-state leaves (input_output_alias) — "
+        f"donation survived tracing but died in lowering, so the state is "
+        f"double-buffered in HBM")]
+
+
+def audit_budget_entry(program: str, analysis: dict, entry: dict,
+                       tolerance: float) -> list[Finding]:
+    """Pure verdict (shared with the fixture tests): gate measured bytes
+    against one budget entry with a two-sided band of
+    ``max(budget*tolerance, MIN_SLACK_BYTES)``."""
+    findings = []
+    for fld in BUDGET_FIELDS:
+        if fld not in entry:
+            findings.append(Finding(
+                "hlo-memory-budget", f"hlo:{program}/{fld}",
+                f"budget entry has no {fld!r} — regenerate with "
+                f"scripts/graftcheck.py --update-budgets"))
+            continue
+        budget = int(entry[fld])
+        actual = int(analysis.get(fld, 0))
+        slack = max(int(budget * tolerance), MIN_SLACK_BYTES)
+        if actual > budget + slack:
+            findings.append(Finding(
+                "hlo-memory-budget", f"hlo:{program}/{fld}",
+                f"{fld} regressed: compiled program needs {actual} bytes, "
+                f"budget is {budget} (+{slack} tolerance) — an HBM "
+                f"regression the chip would pay for; if intentional, "
+                f"regenerate configs/hlo_budgets.json with "
+                f"--update-budgets so the bump is a reviewed diff"))
+        elif actual < budget - slack:
+            findings.append(Finding(
+                "hlo-memory-budget", f"hlo:{program}/{fld}",
+                f"{fld} budget is stale: compiled program needs {actual} "
+                f"bytes but the budget reserves {budget} (-{slack} "
+                f"tolerance) — regenerate with --update-budgets so the "
+                f"gate stays tight"))
+    return findings
+
+
+# ----------------------------------------------------------------- probes --
+_COMPILED_CACHE: dict[tuple[str, str], dict] = {}
+
+
+def probe_config_digest(name: str) -> str:
+    """Digest of the probe's effective config — budgets record it so a
+    probe-config edit without a budget regeneration is detectable."""
+    if name == "serve":
+        cfg = jp._merge(jp._BASE, {"serve": {"probe": "forward"}})
+    else:
+        cfg = jp._merge(jp._BASE, jp.PROBE_CONFIGS[name])
+    return hashlib.sha256(
+        json.dumps(cfg, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def get_compiled(ctx: RepoContext, name: str) -> dict:
+    """Compile (once per process) a probe's step — or the serve forward
+    for ``name="serve"`` — and keep ``{"text", "analysis", "mesh"}``."""
+    key = (str(ctx.root), name)
+    if key in _COMPILED_CACHE:
+        return _COMPILED_CACHE[key]
+    if name == "serve":
+        compiled, mesh_desc = _compile_serve_forward(ctx)
+    else:
+        probe = jp.get_probe(ctx, name)
+        step = probe["builder"].make_train_step(probe["batch"])
+        compiled = step.lower(probe["state_shapes"], probe["batch"]).compile()
+        mesh_desc = ",".join(
+            f"{a}={s}" for a, s in probe["builder"].mesh.shape.items()
+            if s > 1)
+    from distributed_tensorflow_framework_tpu.core import memstats
+    entry = {
+        "text": compiled.as_text(),
+        "analysis": memstats.compiled_memory_analysis(compiled),
+        "mesh": mesh_desc,
+    }
+    _COMPILED_CACHE[key] = entry
+    return entry
+
+
+def _compile_serve_forward(ctx: RepoContext):
+    """The real serving path: serve/engine.py's ``make_forward`` over the
+    dp-only serving mesh, params replicated, batch sharded over data —
+    exactly what the standing engine jits."""
+    jax = jp._require_runtime(ctx)
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_framework_tpu.core.config import load_config
+    from distributed_tensorflow_framework_tpu.core.mesh import batch_spec
+    from distributed_tensorflow_framework_tpu.models import get_model
+    from distributed_tensorflow_framework_tpu.serve.engine import (
+        make_forward,
+        serving_mesh,
+    )
+    from distributed_tensorflow_framework_tpu.train.step import model_inputs
+
+    cfg = load_config(base=jp._merge(jp._BASE, {}))
+    mesh = serving_mesh(-1)
+    model = get_model(cfg.model, bn_axis_name=None, mesh=mesh)
+    replicated = NamedSharding(mesh, P())
+    image = jax.ShapeDtypeStruct(
+        (64, 28, 28, 1), jnp.float32,
+        sharding=NamedSharding(mesh, batch_spec(mesh)))
+    var_shapes = jax.eval_shape(
+        lambda im: model.init(jax.random.PRNGKey(0), im, train=False), image)
+    variables = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=replicated),
+        var_shapes)
+    inputs = model_inputs("image", {"image": image})
+    compiled = make_forward(model, mesh).lower(variables, inputs).compile()
+    return compiled, f"data={mesh.devices.size}"
+
+
+# ---------------------------------------------------------------- budgets --
+def budgets_path(ctx: RepoContext) -> pathlib.Path:
+    return ctx.root / BUDGETS_RELPATH
+
+
+def load_budgets(path: pathlib.Path) -> dict:
+    data = json.loads(path.read_text())
+    if data.get("schema") != BUDGETS_SCHEMA:
+        raise ValueError(
+            f"{path} has schema {data.get('schema')!r}, want "
+            f"{BUDGETS_SCHEMA!r}")
+    return data
+
+
+def compute_budgets(ctx: RepoContext) -> dict:
+    """Fresh-compile every budgeted program and assemble the budgets file
+    content, with enough provenance (jax version, mesh, probe-config
+    digest) that a budget bump is a reviewable, attributable diff."""
+    import jax
+    programs = {}
+    for program, probe_name in BUDGET_PROGRAMS.items():
+        compiled = get_compiled(ctx, probe_name)
+        analysis = compiled["analysis"]
+        if analysis is None:
+            raise RuntimeError(
+                f"memory_analysis unavailable for {program} — cannot "
+                f"write a budget from nothing")
+        entry = {fld: int(analysis[fld]) for fld in BUDGET_FIELDS}
+        entry["mesh"] = compiled["mesh"]
+        entry["config_sha256"] = probe_config_digest(probe_name)
+        programs[program] = entry
+    return {
+        "schema": BUDGETS_SCHEMA,
+        "provenance": {
+            "jax": jax.__version__,
+            "backend": "cpu",
+            "device_count": jax.device_count(),
+            "generated_by": "scripts/graftcheck.py --update-budgets",
+        },
+        "tolerance_frac": DEFAULT_TOLERANCE,
+        "programs": programs,
+    }
+
+
+def write_budgets(ctx: RepoContext, path: pathlib.Path | None = None) -> str:
+    path = path or budgets_path(ctx)
+    data = compute_budgets(ctx)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(data, indent=2, sort_keys=True) + "\n")
+    return str(path)
+
+
+# ----------------------------------------------------------------- passes --
+@register(
+    "hlo-reshard-census", LAYER_HLO,
+    "compile the shard_map probes and require every optimized-HLO "
+    "collective to map to a jaxpr-declared one — extras are "
+    "GSPMD-inserted reshards, reported with shape/bytes/sharding",
+    anchors=("*/parallel/*.py", "*/train/step.py", "*/models/*.py"))
+def reshard_census_pass(ctx: RepoContext) -> list[Finding]:
+    findings = []
+    for name in CENSUS_PROBES:
+        probe = jp.get_probe(ctx, name)
+        expected = expected_hlo_census(jp.collective_census(probe["jaxpr"]))
+        if not expected:
+            findings.append(Finding(
+                "hlo-reshard-census", f"hlo:{name}",
+                f"probe {name} declares no jaxpr collectives — the census "
+                f"baseline is vacuous; probe config drifted",
+                severity=SEVERITY_INTERNAL))
+            continue
+        instrs = collect_collectives(get_compiled(ctx, name)["text"])
+        findings.extend(audit_reshard_census(name, instrs, expected))
+    # The serve forward runs replicated-params over a dp-only mesh: ANY
+    # collective in its optimized module is compiler-inserted.
+    instrs = collect_collectives(get_compiled(ctx, "serve")["text"])
+    findings.extend(audit_reshard_census("serve_forward", instrs, {}))
+    return findings
+
+
+@register(
+    "hlo-donation-survival", LAYER_HLO,
+    "compile the train-step probes and require one input_output_alias "
+    "entry per state leaf in the executable (donation that dies in "
+    "lowering doubles the state HBM footprint)",
+    anchors=("*/train/step.py", "*/train/state.py"))
+def donation_survival_pass(ctx: RepoContext) -> list[Finding]:
+    findings = []
+    for name in DONATION_PROBES:
+        probe = jp.get_probe(ctx, name)
+        entries = count_alias_entries(get_compiled(ctx, name)["text"])
+        findings.extend(audit_donation_survival(
+            entries, probe["n_state_leaves"], f"hlo:{name}/make_train_step"))
+    return findings
+
+
+@register(
+    "hlo-memory-budget", LAYER_HLO,
+    "gate compiled.memory_analysis() bytes for every budgeted program "
+    "against configs/hlo_budgets.json (two-sided tolerance band; "
+    "regenerate with --update-budgets)",
+    anchors=("configs/hlo_budgets.json", "*/train/step.py",
+             "*/models/*.py", "*/serve/engine.py"))
+def memory_budget_pass(ctx: RepoContext) -> list[Finding]:
+    path = budgets_path(ctx)
+    if not path.exists():
+        return [Finding(
+            "hlo-memory-budget", "hlo:budgets",
+            f"no {BUDGETS_RELPATH} — the memory gate is vacuous; run "
+            f"scripts/graftcheck.py --update-budgets and commit the file",
+            severity=SEVERITY_INTERNAL)]
+    try:
+        budgets = load_budgets(path)
+    except (ValueError, json.JSONDecodeError) as exc:
+        return [Finding(
+            "hlo-memory-budget", "hlo:budgets",
+            f"unreadable {BUDGETS_RELPATH}: {exc}",
+            severity=SEVERITY_INTERNAL)]
+    import jax
+    findings = []
+    recorded_jax = budgets.get("provenance", {}).get("jax")
+    if recorded_jax != jax.__version__:
+        # Byte figures legitimately shift across compiler versions:
+        # regeneration is the fix, gating against them is noise.
+        return [Finding(
+            "hlo-memory-budget", "hlo:budgets",
+            f"budgets were generated under jax {recorded_jax}, this run "
+            f"is jax {jax.__version__} — regenerate with --update-budgets "
+            f"so the drift lands as a reviewed diff")]
+    tolerance = float(budgets.get("tolerance_frac", DEFAULT_TOLERANCE))
+    programs = budgets.get("programs", {})
+    for program, probe_name in BUDGET_PROGRAMS.items():
+        entry = programs.get(program)
+        if entry is None:
+            findings.append(Finding(
+                "hlo-memory-budget", f"hlo:{program}",
+                f"no budget entry for {program} — run --update-budgets"))
+            continue
+        if entry.get("config_sha256") != probe_config_digest(probe_name):
+            findings.append(Finding(
+                "hlo-memory-budget", f"hlo:{program}",
+                f"probe config changed since the budget for {program} was "
+                f"generated (config_sha256 mismatch) — regenerate with "
+                f"--update-budgets"))
+            continue
+        compiled = get_compiled(ctx, probe_name)
+        if compiled["analysis"] is None:
+            findings.append(Finding(
+                "hlo-memory-budget", f"hlo:{program}",
+                "compiled.memory_analysis() returned nothing on this "
+                "backend — the gate cannot run",
+                severity=SEVERITY_INTERNAL))
+            continue
+        findings.extend(audit_budget_entry(
+            program, compiled["analysis"], entry, tolerance))
+    return findings

@@ -15,7 +15,12 @@ from typing import Callable
 
 LAYER_AST = "ast"
 LAYER_JAXPR = "jaxpr"
-LAYERS = (LAYER_AST, LAYER_JAXPR)
+LAYER_HLO = "hlo"
+LAYERS = (LAYER_AST, LAYER_JAXPR, LAYER_HLO)
+
+# Layers that trace/compile the real step (seconds, not milliseconds) —
+# skipped in --changed mode unless --trace opts them back in.
+TRACE_LAYERS = (LAYER_JAXPR, LAYER_HLO)
 
 
 @dataclass
