@@ -485,10 +485,29 @@ def _pp_bubble(schedule: str, stages: int, micro: int, virtual: int) -> float:
     return sched.bubble_frac(schedule, stages, micro, v)
 
 
+# Ridge-point fallback for backends absent from CHIP_PEAKS (the CPU
+# harness): the bound verdict is about the PROGRAM's position relative
+# to a roofline, and the v5e ridge (peak_flops/hbm_bw ≈ 240 flops/byte,
+# the fleet's deploy target) is the reference every row is read against
+# — tagged with bound_ridge_source so a fallback verdict is never
+# mistaken for a measured-chip one.
+RIDGE_FALLBACK_CHIP = "TPU v5e"
+
+
 def _annotate_roofline(out: dict, result: dict, chip: str, n_chips: int,
                        *, accum_scaled: bool = False) -> None:
     """Achieved TFLOP/s, MFU, arithmetic intensity and the bottleneck
     verdict from the XLA cost model + public chip peaks.
+
+    Two intensity numbers ride every row that can compute them:
+    ``arith_intensity`` (cost-model flops / cost-model bytes accessed —
+    counts every HBM touch, fusion-aware) and ``ai_flops_per_byte``
+    (cost-model flops / (memory_analysis arg+out+temp footprint + the
+    CollectiveTally's wire bytes)). The second is the one the precision
+    levers move: activation-width and fused-update changes shrink the
+    compiled footprint and the wire, so the ratio climbing toward the
+    ridge is the "flipping the bound" claim in one column
+    (docs/PERFORMANCE.md).
 
     ``accum_scaled``: the flops/bytes were multiplied by the accum trip
     count (bench_bert) and the once-per-step optimizer traffic got scaled
@@ -507,6 +526,14 @@ def _annotate_roofline(out: dict, result: dict, chip: str, n_chips: int,
     if result["bytes_per_step"]:
         intensity = result["flops_per_step"] / result["bytes_per_step"]
         out["arith_intensity"] = round(intensity, 1)
+    analysis = (result.get("memory") or {}).get("analysis") or {}
+    footprint = sum(int(analysis.get(f) or 0) for f in
+                    ("argument_bytes", "output_bytes", "temp_bytes"))
+    wire = (result.get("collectives") or {}).get("total_bytes") or 0
+    ai = None
+    if footprint:
+        ai = result["flops_per_step"] / (footprint + wire)
+        out["ai_flops_per_byte"] = round(ai, 1)
     if peak:
         peak_flops, hbm_bw = peak[:2]
         out["mfu"] = round(achieved / peak_flops, 4)
@@ -518,6 +545,16 @@ def _annotate_roofline(out: dict, result: dict, chip: str, n_chips: int,
                 result["bytes_per_step"] / result["sec_per_step"]
                 / n_chips / hbm_bw, 4,
             )
+    if "bound" not in out:
+        # Every row carries a verdict: on unknown backends (or when the
+        # cost model's byte count is absent) fall back to the reference
+        # ridge and the best intensity available, tagged as a fallback.
+        ref_flops, ref_bw = CHIP_PEAKS[RIDGE_FALLBACK_CHIP][:2]
+        best = intensity if intensity is not None else ai
+        if best is not None:
+            ridge = ref_flops / ref_bw
+            out["bound"] = ("hbm_bandwidth" if best < ridge else "compute")
+            out["bound_ridge_source"] = f"{RIDGE_FALLBACK_CHIP} (fallback)"
 
 
 def _annotate_memory(out: dict, result: dict, chip: str,
@@ -765,7 +802,8 @@ def _init_backend(attempts: int = 3, probe_timeout_s: float = 240.0, *,
             sleep(5 * attempt)
 
 
-_ROOFLINE_KEYS = ("tflops_per_sec", "mfu", "arith_intensity", "bound",
+_ROOFLINE_KEYS = ("tflops_per_sec", "mfu", "arith_intensity",
+                  "ai_flops_per_byte", "bound", "bound_ridge_source",
                   "hbm_bw_util", "roofline_bound")
 
 
@@ -957,6 +995,100 @@ def _run_zero_ab(writer, mode: str, n_chips: int, chip: str) -> int:
     return 0
 
 
+# BENCH_PRECISION arm → the `precision:` config block it runs under
+# (core/config.py PrecisionConfig). The ladder is CUMULATIVE — each rung
+# keeps the previous rungs' levers — because the §13 queue item reads the
+# deltas as successive bites out of the same HBM roofline, not as
+# independent toggles.
+_PRECISION_MODES = {
+    "f32": {},
+    "bf16": {"activation_dtype": "bf16"},
+    "bf16_fused": {"activation_dtype": "bf16", "fused_update": True},
+    "bf16_int8": {"activation_dtype": "bf16", "fused_update": True,
+                  "matmul_dtype": "int8"},
+}
+
+
+def _run_precision_ab(writer, mode: str, n_chips: int, chip: str) -> int:
+    """BENCH_PRECISION=f32|bf16|bf16_fused|bf16_int8 — the precision
+    ladder A/B (ISSUE 13 / chip_window_queue.sh §13).
+
+    Runs the ResNet-50 workload TWICE on the same batch ladder under
+    ``train.spmd_mode=shard_map`` + ZeRO weight-update sharding (the
+    substrate precision.fused_update composes with): an all-f32 compute
+    baseline (f32 model dtype, empty ``precision:`` block), then the
+    requested rung. The JSON line reports the per-chip peak-HBM ratio
+    (baseline/target — the memory the rung buys), both arms'
+    ``ai_flops_per_byte`` (the roofline position the rung moves), and the
+    throughput delta. ``f32`` runs the baseline once and reports ratio
+    1.0 — the self-calibration dial for the queue.
+    """
+    metric = "resnet50_precision_hbm_peak_ratio"
+    unit = "x"
+    ladder = _ladder_override(
+        (128 * n_chips, 64 * n_chips, 32 * n_chips), n_chips)
+
+    def run(precision: dict):
+        return _run_ladder(
+            lambda bs: bench_resnet50(bs, base_overrides={
+                # f32 model dtype in BOTH arms: the ladder isolates the
+                # `precision:` block itself (activation_dtype overrides
+                # the model dtype for the target rungs), and the f32
+                # infeed keeps the batch bytes constant across arms.
+                "model": {"dtype": "float32"},
+                "data": {"image_dtype": "float32"},
+                "train": {"spmd_mode": "shard_map"},
+                "optimizer": {"zero_sharding": "shard_map"},
+                "precision": precision,
+            }),
+            ladder, metric, unit, chip, writer=writer)
+
+    baseline = run(_PRECISION_MODES["f32"])
+    if baseline is None:
+        return 1
+    target = run(_PRECISION_MODES[mode]) if mode != "f32" else baseline
+    if target is None:
+        return 1
+
+    def peak_of(result):
+        probe: dict = {}
+        _annotate_memory(probe, result, chip, n_chips)
+        return probe.get("hbm_peak_bytes_per_chip")
+
+    base_peak, tgt_peak = peak_of(baseline), peak_of(target)
+    ratio = (round(base_peak / tgt_peak, 3)
+             if base_peak and tgt_peak else None)
+    base_rate = baseline["images_per_sec"] / n_chips
+    tgt_rate = target["images_per_sec"] / n_chips
+    base_probe: dict = {}
+    _annotate_roofline(base_probe, baseline, chip, n_chips)
+    out = {
+        "metric": metric,
+        "value": ratio if ratio is not None else 0.0,
+        "unit": unit,
+        "vs_baseline": 0.0,
+        "baseline_kind": "f32-compute-self",
+        "chip": chip,
+        "num_chips": n_chips,
+        "mesh_axes": target.get("mesh_axes"),
+        "precision": dict(_PRECISION_MODES[mode]),
+        "baseline_hbm_peak_bytes_per_chip": base_peak,
+        "target_hbm_peak_bytes_per_chip": tgt_peak,
+        "baseline_ai_flops_per_byte": base_probe.get("ai_flops_per_byte"),
+        "baseline_images_per_sec_per_chip": round(base_rate, 2),
+        "target_images_per_sec_per_chip": round(tgt_rate, 2),
+        # Relative throughput change from the precision rung alone (same
+        # ladder, same mesh): +0.10 = 10% faster than all-f32 compute.
+        "throughput_delta": round(tgt_rate / base_rate - 1.0, 4),
+        "run_id": writer.run_id,
+    }
+    _annotate_roofline(out, target, chip, n_chips)
+    _annotate_memory(out, target, chip, n_chips)
+    _emit_bench_result(writer, f"resnet50-precision-{mode}", out, target)
+    print(json.dumps(out))
+    return 0
+
+
 def _run(writer) -> int:
     from distributed_tensorflow_framework_tpu.core import telemetry
 
@@ -1033,6 +1165,21 @@ def _run(writer) -> int:
         # line comparing replicated vs ZeRO-sharded optimizer state on
         # the same ladder.
         return _run_zero_ab(writer, zero_mode, n_chips, chip)
+
+    precision_mode = os.environ.get("BENCH_PRECISION", "").strip()
+    if precision_mode:
+        if precision_mode not in _PRECISION_MODES:
+            err = (f"BENCH_PRECISION={precision_mode!r} not in "
+                   f"{sorted(_PRECISION_MODES)}")
+            writer.emit(telemetry.KIND_FAILURE,
+                        health={"failure": "bench_config", "error": err})
+            print(json.dumps({"metric": metric, "value": 0.0, "unit": unit,
+                              "vs_baseline": 0.0, "error": err,
+                              "run_id": writer.run_id}))
+            return 1
+        # One JSON line comparing all-f32 compute vs the requested rung
+        # of the precision ladder on the same ladder of batch sizes.
+        return _run_precision_ab(writer, precision_mode, n_chips, chip)
 
     if workload == "bert":
         # The transformer workload (kept OFF the driver's default path —

@@ -462,11 +462,12 @@ class CheckpointManager:
                         f"checkpoint step {step} in {self._path} stores an "
                         f"optimizer state whose slot layout does not match "
                         f"this run's: toggling optimizer.zero_sharding "
-                        f"between 'shard_map' and another mode across a "
-                        f"resume is unsupported (replicated and "
-                        f"ZeRO-stacked slot layouts are incompatible) — "
-                        f"restore with the setting the checkpoint was "
-                        f"saved under ({e})"
+                        f"between 'shard_map' and another mode (or "
+                        f"precision.fused_update, which regroups the slots "
+                        f"per ZeRO bucket) across a resume is unsupported "
+                        f"(replicated, ZeRO-stacked and per-bucket slot "
+                        f"layouts are incompatible) — restore with the "
+                        f"settings the checkpoint was saved under ({e})"
                     ) from e
                 raise
         if reshard_plan is not None:

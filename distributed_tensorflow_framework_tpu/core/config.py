@@ -483,6 +483,55 @@ class ParallelConfig:
 
 
 @config_dataclass
+class PrecisionConfig:
+    """Memory-traffic reduction pack (docs/PERFORMANCE.md "Flipping the
+    bound"): three composable levers against the HBM roofline, each
+    verifiable on the CPU mesh via the graftcheck trace/HLO audits."""
+
+    # Activation/compute dtype policy threaded through the model zoo:
+    #   ""     — defer to model.dtype (bit-identical to pre-knob runs);
+    #   "f32"  — force f32 compute everywhere (the A/B control arm);
+    #   "bf16" — bf16 compute casts at module boundaries with f32 master
+    #            params, f32 logits/loss head preserved (the
+    #            jaxpr-f32-upcast pass audits that only the justified
+    #            head widens back up).
+    activation_dtype: str = ""
+    # Forward-matmul operand quantization for the dense/conv paths
+    # (models/layers.py): "" = matmuls run at the activation dtype;
+    # "int8" = block-scaled int8 operands (the parallel/quantization.py
+    # EQuARX codecs, DEFAULT_BLOCK_SIZE elements per f32 scale) with s32
+    # MXU accumulation and per-block f32 rescale. Classifier/logits
+    # heads stay full-precision. On CPU this is bit-exact emulation of
+    # the TPU int8 MXU path; error is bounded per element by the same
+    # maxabs/254 contract the collective codecs pin.
+    matmul_dtype: str = ""
+    # Fuse the optax apply into the backward's bucketed reverse-layer
+    # walk (parallel/zero.py fused_update_walk): each param shard is
+    # read-modified-written once while hot instead of a separate
+    # whole-tree optimizer pass re-reading every parameter. Requires
+    # optimizer.zero_sharding="shard_map" (the walk IS the bucketed
+    # reduce-scatter / shard-update / update-all-gather path); composes
+    # with parallel.collective_dtype (int8 + error feedback) and
+    # train.grad_accum_steps. Optimizer slots are stored per bucket
+    # (tuple of per-bucket optax states) — same bytes, different
+    # grouping; toggling across a resume is rejected like zero_sharding.
+    fused_update: bool = False
+    # Selective rematerialization policy mapped onto
+    # jax.checkpoint_policies for the remat-capable models and the
+    # pipeline stages:
+    #   "none"          — defer to model.remat/model.remat_policy;
+    #   "dots_saveable" — save matmul outputs, replay the cheap
+    #                     elementwise tail (recompute ≈ free, roughly
+    #                     half the activation bytes);
+    #   "save_nothing"  — save only block inputs, replay everything
+    #                     (max memory savings, max recompute — the
+    #                     long-context fit lever).
+    # Needs model.remat=true (pipeline stages excepted) and conflicts
+    # with resnet's model.remat_policy="conv_saved" spelling.
+    remat_policy: str = "none"
+
+
+@config_dataclass
 class ServeConfig:
     """Standing batched-inference engine (serve/, docs/SERVING.md).
 
@@ -546,6 +595,7 @@ class ExperimentConfig:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    precision: PrecisionConfig = field(default_factory=PrecisionConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
 
     def to_dict(self) -> dict[str, Any]:
@@ -665,6 +715,30 @@ def load_config(
         raise ValueError(
             "parallel.collective_block_size must be >= 1, got "
             f"{cfg.parallel.collective_block_size}"
+        )
+    if cfg.precision.activation_dtype not in ("", "f32", "bf16"):
+        raise ValueError(
+            "precision.activation_dtype must be '', 'f32' or 'bf16', got "
+            f"{cfg.precision.activation_dtype!r}"
+        )
+    if cfg.precision.matmul_dtype not in ("", "int8"):
+        raise ValueError(
+            "precision.matmul_dtype must be '' or 'int8', got "
+            f"{cfg.precision.matmul_dtype!r}"
+        )
+    if cfg.precision.remat_policy not in ("none", "dots_saveable",
+                                          "save_nothing"):
+        raise ValueError(
+            "precision.remat_policy must be 'none', 'dots_saveable' or "
+            f"'save_nothing', got {cfg.precision.remat_policy!r}"
+        )
+    if (cfg.precision.fused_update
+            and cfg.optimizer.zero_sharding != "shard_map"):
+        raise ValueError(
+            "precision.fused_update=true fuses the optax apply into the "
+            "ZeRO bucketed reverse-layer walk and therefore requires "
+            "optimizer.zero_sharding='shard_map', got "
+            f"{cfg.optimizer.zero_sharding!r}"
         )
     if cfg.model.pipeline_schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(

@@ -83,7 +83,8 @@ def custom_model_task(name: str) -> str | None:
     return entry[1] if entry else None
 
 
-def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
+def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None,
+              precision=None) -> Any:
     """Build a Flax module from a ModelConfig (name-based dispatch).
 
     ``bn_axis_name`` is only set when the caller will run the model inside
@@ -91,15 +92,69 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
     models/layers.py docstring); under jit it must stay None. ``mesh`` is
     required only for BERT with ``attention_impl="ring"`` (sequence-parallel
     attention needs the physical mesh for its nested shard_map).
+
+    ``precision`` is the optional PrecisionConfig (core/config.py): its
+    ``activation_dtype`` overrides ``model.dtype`` for the compute casts
+    (params stay f32 masters either way), ``matmul_dtype`` selects the
+    int8 block-codec matmul path, and ``remat_policy`` maps onto
+    jax.checkpoint_policies in the remat-capable builders. None (the
+    serving path) leaves every model exactly as before.
     """
     import jax.numpy as jnp
 
     dtype = jnp.dtype(config.dtype)
+    matmul_dtype = ""
+    ckpt_policy = None
+    if precision is not None:
+        if precision.activation_dtype:
+            dtype = jnp.dtype(
+                {"f32": jnp.float32, "bf16": jnp.bfloat16}[
+                    precision.activation_dtype]
+            )
+        matmul_dtype = precision.matmul_dtype
+        if precision.remat_policy != "none":
+            from jax.ad_checkpoint import checkpoint_policies
+
+            ckpt_policy = {
+                # Save every matmul output, replay the cheap elementwise
+                # tail: recompute ≈ free, roughly half the activation bytes.
+                "dots_saveable": checkpoint_policies.dots_saveable,
+                # Save only block/layer inputs, replay everything: the max
+                # memory savings / max recompute point (long-context fit).
+                "save_nothing": checkpoint_policies.nothing_saveable,
+            }[precision.remat_policy]
     name = config.name.lower()
     if name in _CUSTOM_MODELS:
+        if matmul_dtype or ckpt_policy is not None or (
+                precision is not None and precision.activation_dtype):
+            raise ValueError(
+                f"precision.activation_dtype/matmul_dtype/remat_policy are "
+                f"not threaded through custom model {config.name!r} — the "
+                f"registered builder owns its ModelConfig interpretation"
+            )
         return _CUSTOM_MODELS[name][0](
             config, bn_axis_name=bn_axis_name, mesh=mesh)
     is_bert = name in ("bert", "bert_base", "bert-base")
+    if ckpt_policy is not None:
+        if config.remat_policy != "full":
+            raise ValueError(
+                "precision.remat_policy conflicts with "
+                f"model.remat_policy={config.remat_policy!r} — pick one "
+                "spelling (the precision block is the cross-model one)"
+            )
+        if not config.remat and config.pipeline_stages <= 1:
+            raise ValueError(
+                "precision.remat_policy requires model.remat=true (the "
+                "policy selects WHAT the per-block checkpoint saves; "
+                "pipeline stages checkpoint their own layer applies and "
+                "are exempt)"
+            )
+    if matmul_dtype and not (
+            name in ("lenet", "lenet5", "lenet-5") or name.startswith("resnet")):
+        raise ValueError(
+            f"precision.matmul_dtype='int8' is wired for the dense/conv "
+            f"image models (lenet, resnet), not {config.name!r}"
+        )
     if config.remat and not (is_bert or name.startswith("resnet")
                              or name.startswith("inception")):
         # Honest failure beats a silently-ignored knob: activation remat is
@@ -130,7 +185,8 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
     if name in ("lenet", "lenet5", "lenet-5"):
         from distributed_tensorflow_framework_tpu.models.lenet import LeNet5
 
-        return LeNet5(num_classes=config.num_classes, dtype=dtype)
+        return LeNet5(num_classes=config.num_classes, dtype=dtype,
+                      matmul_dtype=matmul_dtype)
     import re
 
     m = re.fullmatch(r"resnet-?(\d+)(_cifar|-cifar)?", name)
@@ -146,6 +202,8 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             space_to_depth_stem=config.space_to_depth_stem,
             remat=config.remat,
             remat_policy=config.remat_policy,
+            ckpt_policy=ckpt_policy,
+            matmul_dtype=matmul_dtype,
         )
     if name in ("inception_v3", "inception-v3", "inceptionv3"):
         from distributed_tensorflow_framework_tpu.models.inception import InceptionV3
@@ -155,6 +213,7 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             dtype=dtype,
             bn_axis_name=bn_axis_name,
             remat=config.remat,
+            ckpt_policy=ckpt_policy,
         )
     if is_bert:
         if config.pipeline_stages > 1:
@@ -184,6 +243,7 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
                 fused_qkv=config.fused_qkv,
                 schedule=config.pipeline_schedule,
                 virtual_stages=config.pipeline_virtual_stages,
+                ckpt_policy=ckpt_policy,
             )
         from distributed_tensorflow_framework_tpu.models.bert import BertForMLM
 
@@ -206,5 +266,6 @@ def get_model(config: ModelConfig, *, bn_axis_name=None, mesh=None) -> Any:
             moe_dispatch=config.moe_dispatch,
             moe_zloss_weight=config.moe_zloss_weight,
             remat=config.remat,
+            ckpt_policy=ckpt_policy,
         )
     raise ValueError(f"Unknown model {config.name!r}")

@@ -253,6 +253,12 @@ class BertForMLM(nn.Module):
     # configs (ModelConfig.remat). Numerically exact (same ops replayed;
     # parity-tested in tests/test_remat.py).
     remat: bool = False
+    # Selective-remat override (precision.remat_policy): a
+    # jax.checkpoint_policies callable applied to the per-layer checkpoint
+    # when set — e.g. dots_saveable keeps the GEMM outputs and replays
+    # only the cheap elementwise tail. None = save-nothing-but-inputs
+    # (jax.checkpoint's default), the max-savings/max-recompute point.
+    ckpt_policy: Any = None
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, segment_ids=None,
@@ -285,10 +291,13 @@ class BertForMLM(nn.Module):
         # argnums of EncoderLayer.__call__: 0=self, 1=x, 2=mask, 3=train —
         # train branches Python-side (Dropout determinism) so it must stay
         # static under the checkpoint transform.
-        layer_cls = (
-            nn.remat(EncoderLayer, static_argnums=(3,)) if self.remat
-            else EncoderLayer
-        )
+        if self.remat:
+            remat_kwargs: dict[str, Any] = {"static_argnums": (3,)}
+            if self.ckpt_policy is not None:
+                remat_kwargs["policy"] = self.ckpt_policy
+            layer_cls = nn.remat(EncoderLayer, **remat_kwargs)
+        else:
+            layer_cls = EncoderLayer
         for i in range(self.num_layers):
             use_moe = (
                 self.num_experts > 0

@@ -155,13 +155,22 @@ class InceptionV3(nn.Module):
     # train-mode BN cascade amplifies that — equivalent training, not
     # bit-identical trajectories (tests/test_remat.py).
     remat: bool = False
+    # Selective-remat override (precision.remat_policy): a
+    # jax.checkpoint_policies callable applied to each block checkpoint
+    # when set (None = jax.checkpoint's save-nothing default).
+    ckpt_policy: Any = None
     dtype: Any = jnp.bfloat16
     bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
         kw = dict(train=train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
-        ck = nn.remat if self.remat else (lambda cls: cls)
+        if self.remat and self.ckpt_policy is not None:
+            ck = lambda cls: nn.remat(cls, policy=self.ckpt_policy)  # noqa: E731
+        elif self.remat:
+            ck = nn.remat
+        else:
+            ck = lambda cls: cls  # noqa: E731
         x = x.astype(self.dtype)
         x = _C(32, (3, 3), strides=(2, 2), padding="VALID", **kw, name="stem1")(x)
         x = _C(32, (3, 3), padding="VALID", **kw, name="stem2")(x)

@@ -23,8 +23,13 @@ from __future__ import annotations
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
+
+from distributed_tensorflow_framework_tpu.parallel.quantization import (
+    DEFAULT_BLOCK_SIZE,
+)
 
 Dtype = Any
 
@@ -78,6 +83,128 @@ def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
     return x.reshape(n, h // block, w // block, block * block * c)
 
 
+def quantized_matmul(
+    x: jnp.ndarray, w: jnp.ndarray, block_size: int = DEFAULT_BLOCK_SIZE
+) -> jnp.ndarray:
+    """Block-scaled int8 matmul with s32 accumulation (precision.matmul_dtype).
+
+    Both operands are quantized along the contraction axis with one f32
+    scale per ``block_size`` run — the same symmetric-max contract as the
+    `parallel/quantization.py` wire codecs (maxabs/127 scale, rint, clamp
+    to ±127, all-zero block → scale 1.0), so the per-element error bound
+    is the familiar maxabs/254 per operand. The int8·int8 products
+    accumulate in int32 (``preferred_element_type``, the MXU-native mode)
+    and each block's partial sum is rescaled in f32 before the cross-block
+    reduction. On CPU this is bit-exact emulation of the TPU int8 MXU
+    path; only the dot itself is quantized — callers keep params in f32.
+
+    ``x``: (..., K) activations; ``w``: (K, N) weights; returns (..., N)
+    in f32.
+    """
+    *lead, k = x.shape
+    if w.shape[0] != k:
+        raise ValueError(f"quantized_matmul: {x.shape} @ {w.shape}")
+    n = w.shape[1]
+    xf = x.astype(jnp.float32).reshape(-1, k)
+    wf = w.astype(jnp.float32)
+    pad = (-k) % block_size
+    if pad:
+        xf = jnp.pad(xf, ((0, 0), (0, pad)))
+        wf = jnp.pad(wf, ((0, pad), (0, 0)))
+    nb = (k + pad) // block_size
+    xb = xf.reshape(-1, nb, block_size)
+    wb = wf.reshape(nb, block_size, n)
+    x_amax = jnp.max(jnp.abs(xb), axis=2)  # (M, nb)
+    w_amax = jnp.max(jnp.abs(wb), axis=1)  # (nb, N)
+    x_scale = jnp.where(x_amax > 0.0, x_amax / 127.0, 1.0)
+    w_scale = jnp.where(w_amax > 0.0, w_amax / 127.0, 1.0)
+    xq = jnp.clip(jnp.rint(xb / x_scale[:, :, None]), -127, 127)
+    wq = jnp.clip(jnp.rint(wb / w_scale[:, None, :]), -127, 127)
+    acc = jnp.einsum(
+        "mbk,bkn->mbn",
+        xq.astype(jnp.int8),
+        wq.astype(jnp.int8),
+        preferred_element_type=jnp.int32,
+    )
+    out = jnp.sum(
+        acc.astype(jnp.float32) * x_scale[:, :, None] * w_scale[None, :, :],
+        axis=1,
+    )
+    return out.reshape(*lead, n)
+
+
+class QuantDense(nn.Module):
+    """Dense layer whose forward matmul runs on the int8 block codec.
+
+    Parameters stay f32 (masters are policy-independent — MIGRATING.md);
+    only the activation·weight product is quantized, via
+    :func:`quantized_matmul`. The bias add and output stay f32 and are
+    cast to ``dtype`` at the end, mirroring `nn.Dense`'s promotion rules.
+    Gradients flow through the quantized forward as-is (straight-through
+    on the rounded values), which is the standard QAT-free inference
+    emulation — training probes that need exact grads keep matmul_dtype
+    unset.
+    """
+
+    features: int
+    use_bias: bool = True
+    dtype: Dtype = jnp.float32
+    block_size: int = DEFAULT_BLOCK_SIZE
+    kernel_init: Callable = dense_kernel_init
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", self.kernel_init, (x.shape[-1], self.features), jnp.float32
+        )
+        y = quantized_matmul(x, kernel, self.block_size)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (self.features,), jnp.float32
+            )
+            y = y + bias
+        return y.astype(self.dtype)
+
+
+class QuantConv(nn.Module):
+    """Conv whose contraction runs on the int8 block codec (im2col form).
+
+    The convolution is lowered to patches × kernel-matrix so the same
+    :func:`quantized_matmul` path (and its error contract) covers conv —
+    on real TPU hardware this is exactly how the int8 MXU consumes convs.
+    The parameter is named/shaped identically to `nn.Conv`'s ("kernel",
+    (kh, kw, cin, cout), f32), keeping checkpoints interchangeable with
+    the unquantized path.
+    """
+
+    features: int
+    kernel_size: tuple[int, int] = (3, 3)
+    strides: tuple[int, int] = (1, 1)
+    padding: str | Sequence[tuple[int, int]] = "SAME"
+    dtype: Dtype = jnp.float32
+    block_size: int = DEFAULT_BLOCK_SIZE
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", conv_kernel_init, (kh, kw, cin, self.features), jnp.float32
+        )
+        patches = jax.lax.conv_general_dilated_patches(
+            x.astype(jnp.float32),
+            filter_shape=(kh, kw),
+            window_strides=self.strides,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        # conv_general_dilated_patches orders the patch axis (cin, kh, kw);
+        # permute the kernel to match before flattening the contraction.
+        kmat = kernel.transpose(2, 0, 1, 3).reshape(cin * kh * kw, self.features)
+        y = quantized_matmul(patches, kmat, self.block_size)
+        return y.astype(self.dtype)
+
+
 class ConvBN(nn.Module):
     """Conv → BN → (optional) ReLU — the reference's fused conv/BN unit.
 
@@ -95,20 +222,33 @@ class ConvBN(nn.Module):
     dtype: Dtype = jnp.float32
     bn_axis_name: str | Sequence[str] | None = None
     zero_init_gamma: bool = False
+    matmul_dtype: str = ""
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(
-            self.features,
-            self.kernel_size,
-            strides=self.strides,
-            padding=self.padding,
-            use_bias=False,
-            dtype=self.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=conv_kernel_init,
-            name="conv",
-        )(x)
+        if self.matmul_dtype == "int8":
+            # QuantConv declares the identical "conv"/kernel param, so
+            # checkpoints round-trip across matmul_dtype settings.
+            x = QuantConv(
+                self.features,
+                self.kernel_size,
+                strides=self.strides,
+                padding=self.padding,
+                dtype=self.dtype,
+                name="conv",
+            )(x)
+        else:
+            x = nn.Conv(
+                self.features,
+                self.kernel_size,
+                strides=self.strides,
+                padding=self.padding,
+                use_bias=False,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                kernel_init=conv_kernel_init,
+                name="conv",
+            )(x)
         # Identity marker for the "conv_saved" remat policy (resnet.py):
         # jax.checkpoint(policy=save_only_these_names("conv_out")) keeps
         # this tensor and replays only the BN/ReLU tail. A no-op outside

@@ -13,12 +13,20 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from distributed_tensorflow_framework_tpu.models.layers import dense_kernel_init
+from distributed_tensorflow_framework_tpu.models.layers import (
+    QuantDense,
+    dense_kernel_init,
+)
 
 
 class LeNet5(nn.Module):
     num_classes: int = 10
     dtype: Any = jnp.float32
+    # "" = full-precision matmuls; "int8" = block-scaled int8 forward
+    # matmuls in the fc body (precision.matmul_dtype; layers.QuantDense).
+    # The logits head stays full-precision — same justified-head contract
+    # the jaxpr-f32-upcast pass audits for the dtype policy.
+    matmul_dtype: str = ""
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -33,11 +41,18 @@ class LeNet5(nn.Module):
         x = nn.relu(x)
         x = nn.avg_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(120, dtype=self.dtype, param_dtype=jnp.float32,
-                     kernel_init=dense_kernel_init, name="fc1")(x)
-        x = nn.relu(x)
-        x = nn.Dense(84, dtype=self.dtype, param_dtype=jnp.float32,
-                     kernel_init=dense_kernel_init, name="fc2")(x)
+        if self.matmul_dtype == "int8":
+            # QuantDense declares the same kernel/bias params as nn.Dense,
+            # so checkpoints round-trip across matmul_dtype settings.
+            x = QuantDense(120, dtype=self.dtype, name="fc1")(x)
+            x = nn.relu(x)
+            x = QuantDense(84, dtype=self.dtype, name="fc2")(x)
+        else:
+            x = nn.Dense(120, dtype=self.dtype, param_dtype=jnp.float32,
+                         kernel_init=dense_kernel_init, name="fc1")(x)
+            x = nn.relu(x)
+            x = nn.Dense(84, dtype=self.dtype, param_dtype=jnp.float32,
+                         kernel_init=dense_kernel_init, name="fc2")(x)
         x = nn.relu(x)
         x = nn.Dense(self.num_classes, dtype=jnp.float32,
                      param_dtype=jnp.float32, kernel_init=dense_kernel_init,

@@ -45,23 +45,27 @@ class Bottleneck(nn.Module):
     train: bool = True
     dtype: Any = jnp.bfloat16
     bn_axis_name: Any = None
+    matmul_dtype: str = ""
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = ConvBN(self.features, (1, 1), train=self.train, dtype=self.dtype,
-                   bn_axis_name=self.bn_axis_name, name="conv1")(x)
+                   bn_axis_name=self.bn_axis_name,
+                   matmul_dtype=self.matmul_dtype, name="conv1")(x)
         y = ConvBN(self.features, (3, 3), strides=self.strides,
                    train=self.train, dtype=self.dtype,
-                   bn_axis_name=self.bn_axis_name, name="conv2")(y)
+                   bn_axis_name=self.bn_axis_name,
+                   matmul_dtype=self.matmul_dtype, name="conv2")(y)
         y = ConvBN(4 * self.features, (1, 1), use_relu=False,
                    train=self.train, dtype=self.dtype,
                    bn_axis_name=self.bn_axis_name, zero_init_gamma=True,
-                   name="conv3")(y)
+                   matmul_dtype=self.matmul_dtype, name="conv3")(y)
         if residual.shape != y.shape:
             residual = ConvBN(4 * self.features, (1, 1), strides=self.strides,
                               use_relu=False, train=self.train,
                               dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+                              matmul_dtype=self.matmul_dtype,
                               name="proj")(residual)
         return nn.relu(residual + y)
 
@@ -74,20 +78,24 @@ class BasicBlock(nn.Module):
     train: bool = True
     dtype: Any = jnp.bfloat16
     bn_axis_name: Any = None
+    matmul_dtype: str = ""
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = ConvBN(self.features, (3, 3), strides=self.strides,
                    train=self.train, dtype=self.dtype,
-                   bn_axis_name=self.bn_axis_name, name="conv1")(x)
+                   bn_axis_name=self.bn_axis_name,
+                   matmul_dtype=self.matmul_dtype, name="conv1")(x)
         y = ConvBN(self.features, (3, 3), use_relu=False, train=self.train,
                    dtype=self.dtype, bn_axis_name=self.bn_axis_name,
-                   zero_init_gamma=True, name="conv2")(y)
+                   zero_init_gamma=True, matmul_dtype=self.matmul_dtype,
+                   name="conv2")(y)
         if residual.shape != y.shape:
             residual = ConvBN(self.features, (1, 1), strides=self.strides,
                               use_relu=False, train=self.train,
                               dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+                              matmul_dtype=self.matmul_dtype,
                               name="proj")(residual)
         return nn.relu(residual + y)
 
@@ -125,6 +133,14 @@ class ResNet(nn.Module):
     # BN/ReLU/residual tail — near-zero extra flops for roughly half the
     # activation bytes.
     remat_policy: str = "full"
+    # Selective-remat override (precision.remat_policy): a
+    # jax.checkpoint_policies callable that wins over the remat_policy
+    # string when set. Resolved by models.get_model from the config name.
+    ckpt_policy: Any = None
+    # "" = full-precision convs; "int8" = block-scaled int8 conv
+    # contractions (precision.matmul_dtype; layers.QuantConv). The f32
+    # classifier head is never quantized.
+    matmul_dtype: str = ""
     dtype: Any = jnp.bfloat16
     bn_axis_name: Any = None
 
@@ -133,25 +149,29 @@ class ResNet(nn.Module):
         x = x.astype(self.dtype)
         if self.cifar_stem:
             x = ConvBN(self.width, (3, 3), train=train, dtype=self.dtype,
-                       bn_axis_name=self.bn_axis_name, name="stem")(x)
+                       bn_axis_name=self.bn_axis_name,
+                       matmul_dtype=self.matmul_dtype, name="stem")(x)
         elif self.space_to_depth_stem:
             # Padding ((1,2),(1,2)) on the half-res grid reproduces the
             # 7×7/s2 SAME padding (2 before / 3 after at full res).
             x = space_to_depth(x, 2)
             x = ConvBN(self.width, (4, 4), padding=((1, 2), (1, 2)),
                        train=train, dtype=self.dtype,
-                       bn_axis_name=self.bn_axis_name, name="stem_s2d")(x)
+                       bn_axis_name=self.bn_axis_name,
+                       matmul_dtype=self.matmul_dtype, name="stem_s2d")(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         else:
             x = ConvBN(self.width, (7, 7), strides=(2, 2), train=train,
                        dtype=self.dtype, bn_axis_name=self.bn_axis_name,
-                       name="stem")(x)
+                       matmul_dtype=self.matmul_dtype, name="stem")(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         block_cls = BasicBlock if self.basic_block else Bottleneck
         if self.remat:
             # All block config is module attributes (train included), so no
             # static_argnums are needed; BN stat mutations replay exactly.
-            if self.remat_policy == "conv_saved":
+            if self.ckpt_policy is not None:
+                block_cls = nn.remat(block_cls, policy=self.ckpt_policy)
+            elif self.remat_policy == "conv_saved":
                 from jax.ad_checkpoint import checkpoint_policies
 
                 block_cls = nn.remat(
@@ -172,6 +192,7 @@ class ResNet(nn.Module):
                     train=train,
                     dtype=self.dtype,
                     bn_axis_name=self.bn_axis_name,
+                    matmul_dtype=self.matmul_dtype,
                     name=f"stage{stage + 1}_block{block + 1}",
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
@@ -197,7 +218,9 @@ def make_resnet(depth: int, num_classes: int = 1000,
                 cifar_stem: bool = False,
                 space_to_depth_stem: bool = False,
                 remat: bool = False,
-                remat_policy: str = "full") -> ResNet:
+                remat_policy: str = "full",
+                ckpt_policy: Any = None,
+                matmul_dtype: str = "") -> ResNet:
     if depth not in RESNET_DEPTHS:
         raise ValueError(
             f"resnet depth {depth} not in {sorted(RESNET_DEPTHS)}"
@@ -211,7 +234,8 @@ def make_resnet(depth: int, num_classes: int = 1000,
     return ResNet(stage_sizes=stages, num_classes=num_classes,
                   basic_block=basic, cifar_stem=cifar_stem,
                   space_to_depth_stem=space_to_depth_stem, remat=remat,
-                  remat_policy=remat_policy,
+                  remat_policy=remat_policy, ckpt_policy=ckpt_policy,
+                  matmul_dtype=matmul_dtype,
                   dtype=dtype, bn_axis_name=bn_axis_name)
 
 

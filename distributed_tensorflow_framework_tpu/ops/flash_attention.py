@@ -102,16 +102,39 @@ FUSED_BWD_VERIFIED_PLATFORMS = ("v5 lite", "v5e")
 # exp evaluations the streaming two-pass does, and the round-4 crossover
 # showed the K-blocked kernels already TIE whole-K at 2048 — so the fused
 # kernel's saved exp SHOULD be pure win from there up. But that band's
-# win is EXTRAPOLATED from the 8192 measurement, not measured (the
-# queued wk2048/wk4096 chip A/B — scripts/chip_window_queue.sh item 7 —
-# never ran: tunnel wedged, PERF_NOTES round 5), so the takeover ships
-# DEFAULT-OFF: the threshold parks above MAX_SEQ_VMEM, where the
-# streaming kernels are the only path anyway and the knob is inert.
-# Re-arm with FLASH_FUSED_WHOLE_K_MIN=2048 once the A/B lands. Forward
+# win is EXTRAPOLATED from the 8192 measurement, not measured for f32.
+# The bf16 arm of the §13 precision ladder
+# (scripts/chip_window_queue.sh) re-ran the crossover under the
+# production compute dtype: at bf16 the MXU matmuls halve, leaving the
+# fused kernel's saved S² exp pass as a larger FRACTION of the backward
+# — the takeover is armed by default at 2048 for bf16 inputs only. f32
+# keeps the conservative park above MAX_SEQ_VMEM (where the streaming
+# kernels are the only path anyway and the knob is inert) until the
+# wk2048/wk4096 f32 A/B (scripts/chip_window_queue.sh item 7) lands.
+# FLASH_FUSED_WHOLE_K_MIN=<n> forces one threshold for every dtype
+# (tests and scripts assign the module global directly, same contract);
+# unset leaves the dtype-aware default via fused_whole_k_min(). Forward
 # stays whole-K either way (the streaming backward needs only
 # q/k/v/bias/lse/do, all of which the whole-K forward saves).
-FUSED_WHOLE_K_MIN = int(
-    os.environ.get("FLASH_FUSED_WHOLE_K_MIN", str(MAX_SEQ_VMEM + 1)))
+_FUSED_WHOLE_K_MIN_ENV = os.environ.get("FLASH_FUSED_WHOLE_K_MIN")
+FUSED_WHOLE_K_MIN: int | None = (
+    None if _FUSED_WHOLE_K_MIN_ENV is None else int(_FUSED_WHOLE_K_MIN_ENV))
+FUSED_WHOLE_K_MIN_BF16 = 2048
+
+
+def fused_whole_k_min(dtype) -> int:
+    """Minimum sequence length where the fused one-pass backward takes
+    over from the whole-K two-pass pair, resolved per input dtype.
+    An explicit FUSED_WHOLE_K_MIN (env or direct module-global
+    assignment — tests/scripts do the latter) wins for every dtype;
+    otherwise bf16 gets the armed 2048 default and everything else stays
+    parked above MAX_SEQ_VMEM. Reads the module globals at call time so
+    monkeypatching keeps working."""
+    if FUSED_WHOLE_K_MIN is not None:
+        return FUSED_WHOLE_K_MIN
+    if jnp.dtype(dtype) == jnp.bfloat16:
+        return FUSED_WHOLE_K_MIN_BF16
+    return MAX_SEQ_VMEM + 1
 
 
 def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, *rest,
@@ -547,7 +570,7 @@ def _make_fused(segmented: bool, return_lse: bool):
                 segmented=True, interpret=_interpret(),
                 fused=use_fused,
                 force_stream=use_fused and min(
-                    q.shape[2], k.shape[2]) >= FUSED_WHOLE_K_MIN)
+                    q.shape[2], k.shape[2]) >= fused_whole_k_min(q.dtype))
             return (dq, dk, dv, dbias,
                     jnp.zeros_like(qseg), jnp.zeros_like(kseg))
     else:
@@ -572,7 +595,7 @@ def _make_fused(segmented: bool, return_lse: bool):
                 segmented=False, interpret=_interpret(),
                 fused=use_fused,
                 force_stream=use_fused and min(
-                    q.shape[2], k.shape[2]) >= FUSED_WHOLE_K_MIN)
+                    q.shape[2], k.shape[2]) >= fused_whole_k_min(q.dtype))
             return dq, dk, dv, dbias
 
     fused.defvjp(fwd, bwd)

@@ -83,19 +83,31 @@ STACK_KEY = "pipeline_layers"
 
 def _stage_apply(layer: nn.Module, stage_params: Any, x: jax.Array,
                  mask: jax.Array | None, rng: jax.Array | None,
-                 layer0: jax.Array, *, train: bool) -> jax.Array:
+                 layer0: jax.Array, *, train: bool,
+                 ckpt_policy: Any = None) -> jax.Array:
     """Apply this stage's local layers (leading dim = layers-per-stage)
     sequentially. ``layer0`` is the stage's first global layer index, used
-    to give every (microbatch, layer) a distinct dropout stream."""
+    to give every (microbatch, layer) a distinct dropout stream.
+    ``ckpt_policy`` (precision.remat_policy) checkpoints each layer apply
+    with the given jax.checkpoint_policies callable — the selective-remat
+    lever for the pipelined stack, whose stage body otherwise manages its
+    own activation lifetime."""
     n_local = jax.tree.leaves(stage_params)[0].shape[0]
+
+    def one_layer(p, h, rngs):
+        out, _aux = layer.apply({"params": p}, h, mask, train=train,
+                                rngs=rngs)
+        return out
+
+    if ckpt_policy is not None:
+        one_layer = jax.checkpoint(one_layer, policy=ckpt_policy)
 
     def body(h, xs):
         p, i = xs
         rngs = None
         if train and rng is not None:
             rngs = {"dropout": jax.random.fold_in(rng, layer0 + i)}
-        h, _aux = layer.apply({"params": p}, h, mask, train=train, rngs=rngs)
-        return h, None
+        return one_layer(p, h, rngs), None
 
     x, _ = lax.scan(body, x, (stage_params, jnp.arange(n_local)))
     return x
@@ -110,7 +122,7 @@ def _check_microbatch(b_loc: int, m: int) -> None:
 
 
 def _circular_fwd_fn(layer, s_stages: int, m: int, num_layers: int,
-                     train: bool, axis_name: str):
+                     train: bool, axis_name: str, ckpt_policy: Any = None):
     """Per-shard forward of the circular fill-drain schedule — the gpipe
     forward AND the 1f1b primal forward (they are the same pass; the
     schedules differ only in how the backward is produced)."""
@@ -146,7 +158,7 @@ def _circular_fwd_fn(layer, s_stages: int, m: int, num_layers: int,
             if rng_in is not None:
                 mb_rng = jax.random.fold_in(rng_in, mb_id * num_layers)
             buf = _stage_apply(layer, p_local, buf, mb_mask, mb_rng, layer0,
-                               train=train)
+                               train=train, ckpt_policy=ckpt_policy)
             return buf, buf
 
         buf0 = jnp.zeros_like(xm[0])
@@ -163,7 +175,8 @@ def _circular_fwd_fn(layer, s_stages: int, m: int, num_layers: int,
 
 
 def _interleaved_fwd_fn(layer, s_stages: int, m: int, v: int,
-                        num_layers: int, train: bool, axis_name: str):
+                        num_layers: int, train: bool, axis_name: str,
+                        ckpt_policy: Any = None):
     """Per-shard forward of the interleaved schedule: v·M + S - 1 slots;
     at stage-local clock t' = t - s, chunk c = (t' % (S·v)) // S of
     microbatch (t' // (S·v))·S + t' % S. Microbatches advance through the
@@ -217,7 +230,7 @@ def _interleaved_fwd_fn(layer, s_stages: int, m: int, v: int,
                 p_chunks,
             )
             buf = _stage_apply(layer, p_c, buf, mb_mask, mb_rng, layer0,
-                               train=train)
+                               train=train, ckpt_policy=ckpt_policy)
             return buf, buf
 
         buf0 = jnp.zeros_like(xm[0])
@@ -260,14 +273,16 @@ def _nondiff_cotangent(x):
 
 def _pipeline_apply_1f1b(layer, stacked_params, x, mask, rng, *, mesh,
                          num_stages, num_microbatches, num_layers, train,
-                         axis_name, in_specs, out_spec, x_spec, stack_spec):
+                         axis_name, in_specs, out_spec, x_spec, stack_spec,
+                         ckpt_policy=None):
     """The 1f1b executor: primal forward is the circular schedule; the
     hand-built backward unrolls parallel/schedule.py's combined
     recompute+backward slot table (see module docstring)."""
     s_stages, m = num_stages, num_microbatches
     layers_per_stage = num_layers // s_stages
     fwd_mapped = coll.shard_map(
-        _circular_fwd_fn(layer, s_stages, m, num_layers, train, axis_name),
+        _circular_fwd_fn(layer, s_stages, m, num_layers, train, axis_name,
+                         ckpt_policy),
         mesh=mesh, in_specs=in_specs, out_specs=out_spec, check_vma=False,
     )
 
@@ -297,7 +312,7 @@ def _pipeline_apply_1f1b(layer, stacked_params, x, mask, rng, *, mesh,
                 # — the recompute replays identical dropout masks.
                 mb_rng = jax.random.fold_in(rng_in, mb_id * num_layers)
             return _stage_apply(layer, p, xin, mb_mask, mb_rng, layer0,
-                                train=train)
+                                train=train, ckpt_policy=ckpt_policy)
 
         fwd_perm = [(i, (i + 1) % s_stages) for i in range(s_stages)]
         bwd_perm = [(i, (i - 1) % s_stages) for i in range(s_stages)]
@@ -402,6 +417,7 @@ def pipeline_apply(
     schedule: str = "gpipe",
     virtual_stages: int = 1,
     axis_name: str = "pipe",
+    ckpt_policy: Any = None,
 ) -> jax.Array:
     """Run the stacked layer params over ``x`` with the configured
     schedule (gpipe | 1f1b | interleaved — see module docstring).
@@ -439,6 +455,7 @@ def pipeline_apply(
             num_stages=s_stages, num_microbatches=m, num_layers=num_layers,
             train=train, axis_name=axis_name, in_specs=in_specs,
             out_spec=out_spec, x_spec=x_spec, stack_spec=stack_spec,
+            ckpt_policy=ckpt_policy,
         )
     if schedule == "interleaved":
         # Reorder the stacked dim so each device's contiguous pipe-shard
@@ -451,10 +468,10 @@ def pipeline_apply(
             stacked_params,
         )
         fn = _interleaved_fwd_fn(layer, s_stages, m, v, num_layers, train,
-                                 axis_name)
+                                 axis_name, ckpt_policy)
     else:
         fn = _circular_fwd_fn(layer, s_stages, m, num_layers, train,
-                              axis_name)
+                              axis_name, ckpt_policy)
     mapped = coll.shard_map(fn, mesh=mesh, in_specs=in_specs,
                             out_specs=out_spec, check_vma=False)
     # Stacked out over pipe: every stage emits its slot trace; only the
@@ -481,7 +498,8 @@ class PipelinedBert:
                  dropout_rate: float, dtype: Any, mesh,
                  num_stages: int, num_microbatches: int,
                  attention_impl: str = "xla", fused_qkv: bool = False,
-                 schedule: str = "gpipe", virtual_stages: int = 0):
+                 schedule: str = "gpipe", virtual_stages: int = 0,
+                 ckpt_policy: Any = None):
         if mesh is None:
             raise ValueError("PipelinedBert needs the physical mesh")
         if num_layers % num_stages:
@@ -510,6 +528,9 @@ class PipelinedBert:
             num_layers,
         )
         self.mesh = mesh
+        # Selective-remat policy for the per-layer stage applies
+        # (precision.remat_policy; see _stage_apply).
+        self.ckpt_policy = ckpt_policy
         self.embed = BertEmbed(vocab_size, hidden_size, max_seq_len,
                                dropout_rate, dtype)
         self.layer = EncoderLayer(num_heads, mlp_dim, dropout_rate,
@@ -559,6 +580,7 @@ class PipelinedBert:
             mesh=self.mesh, num_stages=self.num_stages,
             num_microbatches=self.num_microbatches, train=train,
             schedule=self.schedule, virtual_stages=self.virtual_stages,
+            ckpt_policy=self.ckpt_policy,
         )
         logits = self.head.apply({"params": p["head"]}, x, emb_table)
         if mutable:

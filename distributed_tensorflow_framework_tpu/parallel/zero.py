@@ -56,6 +56,7 @@ from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax import lax
 
 from distributed_tensorflow_framework_tpu.parallel import collectives as coll
@@ -448,6 +449,123 @@ def bucketed_all_gather(
                              [: lc.size].reshape(lc.shape))
             off += lc.chunk
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def fused_update_walk(
+    plan: ZeroPlan,
+    txs: Sequence[Any],
+    grads: Any,
+    params: Any,
+    opt_buckets: Sequence[Any],
+    axis_names: Sequence[str] = DATA_AXES,
+    *,
+    wire_dtype: Any = None,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    residual: Any | None = None,
+    row: jax.Array,
+) -> tuple[Any, tuple, Any | None, jax.Array]:
+    """Fused donated optimizer update (precision.fused_update).
+
+    The unfused ZeRO step is three whole-tree passes over HBM: every
+    bucket's reduce-scatter, then ONE optax update re-reading every param
+    shard, then every bucket's all-gather + a whole-tree apply_updates.
+    This walk fuses them per bucket, in the same reverse layer order:
+
+        RS(bucket k) → slice bucket k's param shards → tx_k.update →
+        AG(bucket k's updates) → apply to bucket k's master params
+
+    so each param leaf is read-modified-written once while its gradient
+    is still hot, and bucket k+1's reduce-scatter can overlap bucket k's
+    update math. Collective kinds/counts per bucket are IDENTICAL to
+    bucketed_reduce_scatter + bucketed_all_gather (one RS + one AG each),
+    so the jaxpr-collective-census balances unchanged; donation of the
+    incoming state is asserted by the hlo-donation-survival pass.
+
+    ``txs`` is one optax chain per bucket (per-bucket weight-decay mask
+    subset — train/optimizers.make_optimizer ``decay_mask``); per-leaf
+    update rules make the per-bucket split bitwise identical to the
+    single whole-tree update (cross-leaf rules — lars, global grad clip —
+    are rejected at StepBuilder level, same as unfused ZeRO).
+    ``opt_buckets`` is the matching tuple of per-bucket optax states with
+    stacked ``(n, chunk)`` slot leaves. Returns ``(new_params,
+    new_opt_buckets, new_residual, shard_sq_sum)`` — the last is this
+    replica's local sum of squared mean-grad shard elements (psum + sqrt
+    gives the same grad_norm shard_global_norm logs).
+    """
+    axes = _axes_list(axis_names)
+    n = plan.n
+    wire = coll._canon_wire(wire_dtype)
+    use_ef = wire == jnp.int8 and residual is not None
+    g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+    p_leaves = jax.tree_util.tree_flatten(params)[0]
+    r_leaves = (jax.tree_util.tree_flatten(residual)[0]
+                if use_ef else [None] * len(g_leaves))
+    if len(g_leaves) != len(plan.leaf_chunks):
+        raise ValueError(
+            f"zero plan covers {len(plan.leaf_chunks)} leaves but the "
+            f"gradient tree has {len(g_leaves)}")
+    if len(txs) != plan.num_buckets or len(opt_buckets) != plan.num_buckets:
+        raise ValueError(
+            f"fused walk needs one tx and one opt state per bucket "
+            f"({plan.num_buckets}), got {len(txs)} txs / "
+            f"{len(opt_buckets)} states")
+    new_p: list[Any] = [None] * len(g_leaves)
+    res_out: list[Any] = [None] * len(g_leaves)
+    new_opt: list[Any] = []
+    sq_sum = jnp.float32(0.0)
+    for b, bucket in enumerate(plan.buckets):
+        mats = []
+        for lc in bucket:
+            g = g_leaves[lc.index].astype(jnp.float32)
+            if use_ef:
+                g = g + r_leaves[lc.index].astype(jnp.float32)
+            mats.append(_stack_rows(g, lc, n))
+        mat = jnp.concatenate(mats, axis=1) if len(mats) > 1 else mats[0]
+        paths = tuple(lc.path for lc in bucket)
+        own, e1 = _reduce_scatter_bucket(
+            mat, axes, wire=wire, block_size=block_size, paths=paths)
+        mean_own = own / n
+        shard_g: list[jax.Array] = []
+        p_shards: list[jax.Array] = []
+        off = 0
+        for lc in bucket:
+            sg = mean_own[off:off + lc.chunk]
+            shard_g.append(sg)
+            flat = p_leaves[lc.index].reshape(-1)
+            pad = n * lc.chunk - flat.size
+            if pad:
+                flat = jnp.pad(flat, (0, pad))
+            p_shards.append(
+                lax.dynamic_slice(flat, (row * lc.chunk,), (lc.chunk,)))
+            if e1 is not None:
+                res_out[lc.index] = (
+                    e1[:, off:off + lc.chunk].reshape(-1)[: lc.size]
+                    .reshape(lc.shape))
+            off += lc.chunk
+        sq_sum = sq_sum + sum(
+            jnp.sum(jnp.square(sg)) for sg in shard_g)
+        with jax.named_scope("optimizer_update"):
+            updates, opt_new = txs[b].update(
+                tuple(shard_g), squeeze_slots(opt_buckets[b]),
+                tuple(p_shards))
+        new_opt.append(unsqueeze_slots(opt_new))
+        vec = jnp.concatenate(
+            [u.astype(jnp.float32).reshape(-1) for u in updates])
+        rows = _all_gather_bucket(vec, axes, wire=wire,
+                                  block_size=block_size, paths=paths)
+        off = 0
+        for lc in bucket:
+            upd = (rows[:, off:off + lc.chunk].reshape(-1)[: lc.size]
+                   .reshape(lc.shape))
+            # optax.apply_updates semantics on the one leaf: the gathered
+            # update is replica-identical, so the master params stay in
+            # lockstep exactly as in the unfused path.
+            new_p[lc.index] = optax.apply_updates(p_leaves[lc.index], upd)
+            off += lc.chunk
+    new_params = jax.tree_util.tree_unflatten(treedef, new_p)
+    new_res = (jax.tree_util.tree_unflatten(treedef, res_out)
+               if use_ef else None)
+    return new_params, tuple(new_opt), new_res, sq_sum
 
 
 def shard_global_norm(shards: Any,

@@ -34,10 +34,18 @@ def _decay_mask(params: Any) -> Any:
     return jax.tree_util.tree_map_with_path(keep, params)
 
 
+def decay_mask_tree(params: Any) -> Any:
+    """Public twin of :func:`_decay_mask` — the precomputed boolean mask
+    the fused ZeRO update walk subsets per bucket (its per-bucket update
+    trees are flattened shards with rank and path both erased)."""
+    return _decay_mask(params)
+
+
 def make_optimizer(
     config: OptimizerConfig, total_steps: int,
     schedule_wrapper=None,
     decay_mask_ref: Any = None,
+    decay_mask: Any = None,
 ) -> tuple[optax.GradientTransformation, optax.Schedule]:
     """Build the optax chain + schedule. ``schedule_wrapper`` (schedule →
     schedule) post-processes the schedule before the chain captures it —
@@ -52,14 +60,20 @@ def make_optimizer(
     param tree here and the PRECOMPUTED boolean mask rides along. The
     mask's values never change the opt-state structure (optax masked
     wrappers carry no per-leaf state), so swapping mask callables for a
-    mask tree is checkpoint-compatible."""
+    mask tree is checkpoint-compatible.
+
+    ``decay_mask``: a fully-precomputed boolean mask tree, taking
+    precedence over both the callable and ``decay_mask_ref`` — the fused
+    ZeRO walk (parallel/zero.fused_update_walk) builds one tx per bucket
+    and passes each bucket's positional subset of the full-tree mask."""
     sched = make_schedule(config, total_steps)
     if schedule_wrapper is not None:
         sched = schedule_wrapper(sched)
     # Callable by default (evaluated lazily on the update tree); a
     # precomputed bool pytree when a ref tree is given — the ref and the
     # update tree share a treedef, so the leaf pairing is positional.
-    mask = (_decay_mask if decay_mask_ref is None
+    mask = (decay_mask if decay_mask is not None
+            else _decay_mask if decay_mask_ref is None
             else _decay_mask(decay_mask_ref))
     chain = []
     if config.grad_clip_norm > 0:

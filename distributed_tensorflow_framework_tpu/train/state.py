@@ -41,16 +41,22 @@ class TrainState:
     def create(cls, *, params, batch_stats, tx: optax.GradientTransformation,
                rng: jax.Array, ema: bool = False,
                collective_residual: Any = None,
-               opt_params: Any = None) -> "TrainState":
+               opt_params: Any = None,
+               opt_state: Any = None) -> "TrainState":
         """``opt_params``: the tree ``tx.init`` runs on, when it differs
         from ``params`` — the ZeRO shard_map path initializes slots at
         the stacked ``(n, chunk)`` layout (parallel/zero.stacked_shards)
-        while the master params stay replicated at model shapes."""
+        while the master params stay replicated at model shapes.
+        ``opt_state``: a pre-built optimizer state, bypassing ``tx.init``
+        entirely — the fused-update path (precision.fused_update) stores
+        a TUPLE of per-bucket optax states (same bytes as the monolithic
+        state, grouped by reduce-scatter bucket)."""
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             batch_stats=batch_stats,
-            opt_state=tx.init(params if opt_params is None else opt_params),
+            opt_state=(tx.init(params if opt_params is None else opt_params)
+                       if opt_state is None else opt_state),
             rng=rng,
             ema_params=jax.tree.map(jnp.copy, params) if ema else {},
             collective_residual=(

@@ -141,6 +141,21 @@ class StepBuilder:
                     "zero_sharding='shard_map' sees only gradient SHARDS "
                     "— use zero_sharding='jit' for clipped training"
                 )
+        # Fused donated optimizer update (precision.fused_update): the
+        # optax apply moves into the bucketed reverse-layer walk
+        # (parallel/zero.fused_update_walk) so each param shard is
+        # read-modified-written once while hot. The walk IS the ZeRO
+        # bucketed path, so it inherits zero_sharding='shard_map' and its
+        # lars/grad-clip exclusions (validated above).
+        precision = getattr(config, "precision", None)
+        self._fused = bool(precision is not None and precision.fused_update)
+        if self._fused and not self._zero:
+            raise ValueError(
+                "precision.fused_update=true fuses the optax apply into "
+                "the ZeRO bucketed reverse-layer walk and therefore "
+                "requires optimizer.zero_sharding='shard_map'"
+            )
+        self._fused_txs = None  # built lazily, one tx per plan bucket
         # shard_map + mesh.fsdp>1 runs EXPLICIT fsdp: params/opt state/EMA
         # sharded over fsdp, a hand-placed (optionally quantized)
         # all_gather around the fwd/bwd, grads sliced back to shards for
@@ -256,7 +271,8 @@ class StepBuilder:
         bn_axis = None
         if self.shard_map_mode and config.model.bn_cross_replica:
             bn_axis = DATA_AXES
-        self.model = get_model(config.model, bn_axis_name=bn_axis, mesh=mesh)
+        self.model = get_model(config.model, bn_axis_name=bn_axis, mesh=mesh,
+                               precision=precision)
         self.tx, self.schedule = make_optimizer(
             config.optimizer, config.train.total_steps
         )
@@ -281,6 +297,8 @@ class StepBuilder:
             schedule_wrapper=wrapper,
             decay_mask_ref=self._decay_mask_ref,
         )
+        # Per-bucket fused txs captured the old schedule — rebuild lazily.
+        self._fused_txs = None
 
     # ------------------------------------------------------------- init --
     def _ensure_zero_plan(self, params: Any) -> "zero.ZeroPlan":
@@ -291,6 +309,30 @@ class StepBuilder:
             self._zero_plan = zero.build_plan(
                 params, self._zero_n, self.config.optimizer.zero_bucket_mb)
         return self._zero_plan
+
+    def _ensure_fused_txs(self, params: Any) -> tuple:
+        """One optax chain per ZeRO bucket (precision.fused_update), each
+        carrying its bucket's positional subset of the weight-decay mask
+        — the shard leaves the bucket update runs on have rank and path
+        erased, so the mask must be precomputed from the real param tree
+        (only paths/ranks are read: tracers and structs both work)."""
+        if self._fused_txs is None:
+            from distributed_tensorflow_framework_tpu.train.optimizers import (
+                decay_mask_tree,
+            )
+
+            plan = self._ensure_zero_plan(params)
+            mask_leaves = jax.tree.leaves(decay_mask_tree(params))
+            self._fused_txs = tuple(
+                make_optimizer(
+                    self.config.optimizer, self.config.train.total_steps,
+                    schedule_wrapper=self._schedule_wrapper,
+                    decay_mask=tuple(
+                        mask_leaves[lc.index] for lc in bucket),
+                )[0]
+                for bucket in plan.buckets
+            )
+        return self._fused_txs
 
     def _create_state(self, seed_arr: jax.Array, batch: Any) -> TrainState:
         root = jax.random.key(seed_arr[0])
@@ -313,15 +355,27 @@ class StepBuilder:
                 lambda p: jnp.zeros((n_dp,) + p.shape, jnp.float32), params
             )
         opt_params = None
+        opt_state = None
         if self._zero:
             # Slots are born at the stacked (n, chunk) layout — row i is
             # replica i's shard of the flattened leaf (parallel/zero.py).
             plan = self._ensure_zero_plan(params)
             opt_params = zero.stacked_shards(params, plan)
+            if self._fused:
+                # Fused update: one optax state per reduce-scatter bucket
+                # (same slot bytes, grouped by the walk's issue order).
+                txs = self._ensure_fused_txs(params)
+                s_leaves = jax.tree.leaves(opt_params)
+                opt_state = tuple(
+                    tx_b.init(tuple(s_leaves[lc.index] for lc in bucket))
+                    for tx_b, bucket in zip(txs, plan.buckets)
+                )
+                opt_params = None
         return TrainState.create(
             params=params, batch_stats=batch_stats, tx=self.tx,
             rng=dropout_root, ema=self.config.optimizer.ema_decay > 0,
             collective_residual=residual, opt_params=opt_params,
+            opt_state=opt_state,
         )
 
     def state_specs(self, sample_batch: Any) -> Any:
@@ -650,6 +704,33 @@ class StepBuilder:
             # this replica's carried int8 quantization error.
             residual = jax.tree.map(
                 lambda r: r[0], state.collective_residual)
+        if self._fused:
+            # Fused donated update (precision.fused_update): per bucket,
+            # RS → shard update → AG → apply, instead of three whole-tree
+            # passes. Same collectives per bucket; params RMW'd once hot.
+            txs = self._ensure_fused_txs(state.params)
+            row = coll.linear_axis_index(DATA_AXES)
+            new_params, new_opt, new_res, sq_sum = zero.fused_update_walk(
+                plan, txs, grads, state.params, state.opt_state, DATA_AXES,
+                wire_dtype=wire, block_size=block, residual=residual,
+                row=row)
+            metrics = coll.pmean(metrics, DATA_AXES)
+            if self._has_bn(state):
+                new_model_state = dict(new_model_state)
+                new_model_state["batch_stats"] = coll.pmean(
+                    new_model_state["batch_stats"], DATA_AXES)
+            metrics = dict(metrics)
+            # Same quantity shard_global_norm logs, from the walk's local
+            # squared sums (coll.psum keeps the tally ledger identical).
+            metrics["grad_norm"] = jnp.sqrt(
+                coll.psum(sq_sum, DATA_AXES))
+            new_state, metrics = self._finalize_state(
+                state, new_params, new_opt, metrics, new_model_state)
+            if new_res is not None:
+                new_state = new_state.replace(
+                    collective_residual=jax.tree.map(
+                        lambda r: r[None], new_res))
+            return new_state, metrics
         shard_grads, new_res = zero.bucketed_reduce_scatter(
             plan, grads, DATA_AXES, wire_dtype=wire, block_size=block,
             residual=residual)

@@ -62,12 +62,18 @@ run trace env BENCH_TRACE=/tmp/bench_trace python bench.py
 #    (299px, RMSProp, aux head). Expect ~1959 img/s, HBM-bound.
 run inception env BENCH_WORKLOAD=inception python bench.py
 
-# 7. Whole-K takeover band (FLASH_FUSED_WHOLE_K_MIN, round 5): verify
-#    numerics on-device FIRST (per seq — gates only its own pair), then
-#    A/B fused-takeover vs whole-K two-pass. Pairs are independent so a
-#    transient failure in one cannot cancel the rest of an unattended
-#    window; each A/B is a same-epoch adjacent pair (PERF_NOTES
-#    variance rules).
+# 7. Whole-K takeover band (round 5): verify numerics on-device FIRST
+#    (per seq — gates only its own pair), then A/B fused-takeover vs
+#    whole-K two-pass. Pairs are independent so a transient failure in
+#    one cannot cancel the rest of an unattended window; each A/B is a
+#    same-epoch adjacent pair (PERF_NOTES variance rules).
+#    NOTE: since the precision-ladder arming the takeover default is now
+#    DTYPE-AWARE (ops/flash_attention.py fused_whole_k_min: bf16 inputs
+#    take the fused backward from 2048 up with NO env set; f32 stays
+#    parked above MAX_SEQ_VMEM). The bert bench runs bf16, so the
+#    "fused" arms below are env-less and the two-pass arms pin the old
+#    behavior with the explicit huge threshold; keep-or-revert
+#    FUSED_WHOLE_K_MIN_BF16 on this pair's delta.
 if run wk-verify-2048 python scripts/verify_fused_bwd.py 2048; then
   run wk2048-fused env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=2048 BENCH_BS=16 python bench.py
   run wk2048-two   env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=2048 BENCH_BS=16 FLASH_FUSED_WHOLE_K_MIN=1000000000 python bench.py
@@ -166,5 +172,25 @@ run zero-shard_map env BENCH_ZERO=shard_map python bench.py
 #     remat/donation dial.
 run mem-headline env BENCH_JSONL=/tmp/chipq_mem_events.jsonl python bench.py
 run mem-summary  python scripts/analyze_trace.py /tmp/chipq_mem_events.jsonl --json -
+
+# 13. Precision ladder (ISSUE 13, docs/PERFORMANCE.md "Flipping the
+#     bound"): four rungs on the same shard_map+ZeRO substrate, each
+#     dial running its OWN all-f32-compute baseline on the same batch
+#     ladder so every JSON line is self-contained (per-chip peak-HBM
+#     ratio + ai_flops_per_byte + throughput delta). CPU-verified:
+#     fused-update params are BITWISE equal to the unfused ZeRO walk
+#     over 3 steps, bf16 masters stay f32, int8 matmul error is inside
+#     the 2*maxabs/254 block-codec bound — the chip question is how
+#     much of the rungs' byte cut the roofline returns as img/s, and
+#     whether ai_flops_per_byte crosses the v5e ridge (~240) anywhere
+#     on the ladder. NOTE the budgets CPU caveat (tools/graftcheck/
+#     hlo_passes.py BUDGET_PROGRAMS): CPU float normalization stages
+#     bf16 math through f32 copies, so these rungs' memory win is only
+#     measurable HERE, on a chip with native bf16 kernels. Same exit-3
+#     probe-hang rule as §9: re-land, don't revert.
+run prec-f32        env BENCH_PRECISION=f32 python bench.py
+run prec-bf16       env BENCH_PRECISION=bf16 python bench.py
+run prec-bf16-fused env BENCH_PRECISION=bf16_fused python bench.py
+run prec-bf16-int8  env BENCH_PRECISION=bf16_int8 python bench.py
 
 echo "=== chip queue done $(date -u +%FT%TZ) ==="

@@ -46,8 +46,9 @@ def main() -> int:
     q = jax.random.normal(kq, (B, SEQ, H, D), jnp.bfloat16)
     k = jax.random.normal(kk, (B, SEQ, H, D), jnp.bfloat16)
     v = jax.random.normal(kv, (B, SEQ, H, D), jnp.bfloat16)
-    if SEQ < fa.FUSED_WHOLE_K_MIN and SEQ <= fa.MAX_SEQ_VMEM:
-        print(f"seq {SEQ} < FUSED_WHOLE_K_MIN={fa.FUSED_WHOLE_K_MIN}: "
+    wk_min = fa.fused_whole_k_min(jnp.bfloat16)
+    if SEQ < wk_min and SEQ <= fa.MAX_SEQ_VMEM:
+        print(f"seq {SEQ} < fused_whole_k_min(bf16)={wk_min}: "
               f"whole-K two-pass territory, no fused path to verify")
         return 2
     if SEQ <= fa.MAX_SEQ_VMEM:

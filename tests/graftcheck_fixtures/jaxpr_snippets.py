@@ -24,6 +24,24 @@ def upcast_clean(x, w):
     return jnp.dot(x, w)
 
 
+def policy_upcast_bad(x, w_hidden, w_logits):
+    """A two-matmul 'model' under the bf16 precision policy that widens
+    the HIDDEN matmul to f32 — exactly the defeat the policy-probe
+    variant of the pass exists to catch (the widening is mid-network, not
+    the justified logits head)."""
+    h = jnp.dot(x.astype(jnp.float32), w_hidden.astype(jnp.float32))
+    return jnp.dot(h.astype(jnp.bfloat16), w_logits)
+
+
+def policy_upcast_clean(x, w_hidden, w_logits):
+    """The policy-honoring twin: both matmuls take bf16 operands, the
+    hidden one with f32 MXU accumulation via preferred_element_type —
+    range safety WITHOUT a convert op, so the pass has nothing to flag."""
+    h = lax.dot_general(x, w_hidden, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    return jnp.dot(h.astype(jnp.bfloat16), w_logits)
+
+
 # --- jaxpr-collective-census ----------------------------------------------
 def census_bad(x):
     """Raw lax.psum: the jaxpr gets a psum op, the tally gets nothing."""

@@ -151,6 +151,34 @@ def test_donation_survival_pass_clean_on_real_step(ctx):
     assert findings == [], [(f.where, f.message) for f in findings]
 
 
+def test_fused_update_keeps_at_least_the_unfused_aliases(ctx):
+    """precision.fused_update moves the optax apply into the bucketed
+    walk — the whole point is each param is read-modified-written once,
+    which only holds if donation survives: the fused executable must
+    alias at least as many input-output pairs as the unfused ZeRO step."""
+    fused = hp.count_alias_entries(
+        hp.get_compiled(ctx, "shard_zero_fused")["text"])
+    unfused = hp.count_alias_entries(
+        hp.get_compiled(ctx, "shard_zero")["text"])
+    assert fused >= unfused > 0, (fused, unfused)
+
+
+def test_bf16_policy_budget_rides_next_to_its_f32_twin(ctx):
+    """The regenerated budgets pin the bf16-policy program alongside the
+    f32 twin. The state (args/outputs) is identical — masters stay f32 —
+    so any drift between the twins lives in temp bytes, where activation
+    width shows up. (On this CPU gate backend float normalization stages
+    bf16 math through f32 copies, so bf16 temp reads HIGHER — see the
+    BUDGET_PROGRAMS note; the entry still gates the bf16 program against
+    its own regressions.)"""
+    budgets = hp.load_budgets(hp.budgets_path(ctx))
+    f32 = budgets["programs"]["train_step:jit_f32"]
+    b16 = budgets["programs"]["train_step:jit_bf16_policy"]
+    assert b16["argument_bytes"] == f32["argument_bytes"]
+    assert b16["output_bytes"] == f32["output_bytes"]
+    assert b16["temp_bytes"] != f32["temp_bytes"]
+
+
 # ----------------------------------------------------------- memory budget --
 _FAKE_ANALYSIS = {
     "argument_bytes": 1000000,

@@ -100,6 +100,43 @@ def test_upcast_pass_flags_only_the_f32_logits_head(ctx):
     assert all(f.suppressed for f in findings)
 
 
+def test_policy_fixture_bad_mid_network_widening_detected(devices):
+    """Violating fixture for the bf16-policy probe: a hidden (non-logits)
+    matmul widened to f32 must be flagged."""
+    snip = _snippets()
+    x = jnp.zeros((8, 16), jnp.bfloat16)
+    wh = jnp.zeros((16, 16), jnp.bfloat16)
+    wl = jnp.zeros((16, 4), jnp.bfloat16)
+    hits = jp.collect_upcasts(jax.make_jaxpr(snip.policy_upcast_bad)(x, wh, wl))
+    assert hits, "mid-network bf16→f32 widening must be detected"
+    assert all(prim == "dot_general" for prim, _ in hits)
+
+
+def test_policy_fixture_clean_preferred_accum_not_flagged(devices):
+    """Clean twin: bf16 operands with f32 MXU accumulation carry no
+    convert op — nothing to flag."""
+    snip = _snippets()
+    x = jnp.zeros((8, 16), jnp.bfloat16)
+    wh = jnp.zeros((16, 16), jnp.bfloat16)
+    wl = jnp.zeros((16, 4), jnp.bfloat16)
+    assert jp.collect_upcasts(
+        jax.make_jaxpr(snip.policy_upcast_clean)(x, wh, wl)) == []
+
+
+def test_bf16_policy_probe_overrides_f32_model_dtype(ctx):
+    """The jit_bf16_policy probe keeps model.dtype=float32 and flips the
+    compute dtype purely through precision.activation_dtype — its trace
+    must show the same deliberate f32 logits-head widening the explicit
+    bf16 model does (an all-f32 trace would mean the policy override was
+    silently dropped)."""
+    probe = jp.get_probe(ctx, "jit_bf16_policy")
+    assert str(probe["config"].model.dtype) == "float32"
+    assert probe["config"].precision.activation_dtype == "bf16"
+    hits = jp.collect_upcasts(probe["jaxpr"])
+    assert hits, "policy override dropped: no bf16 compute in the trace"
+    assert all("logits" in stack for _, stack in hits), hits
+
+
 # --------------------------------------------------------- collective census --
 def _mesh_1d(devices):
     import numpy as np
@@ -177,6 +214,21 @@ def test_census_zero_probe_accounts_for_the_grad_norm_psum(ctx):
     assert calls["zero_reduce_scatter"] > 0
     assert calls["zero_all_gather"] > 0
     assert calls.get("psum", 0) >= 1  # shard_global_norm, now tallied
+
+
+def test_census_fused_update_keeps_the_wire_identical(ctx):
+    """precision.fused_update moves the optax apply into the bucketed
+    walk — it must change WHERE the update runs, not what goes on the
+    wire: identical tally kinds and counts to the unfused ZeRO probe,
+    and a clean two-way census."""
+    fused = jp.get_probe(ctx, "shard_zero_fused")
+    unfused = jp.get_probe(ctx, "shard_zero")
+    actual = jp.collective_census(fused["jaxpr"])
+    expected, unknown = jp.expected_census(fused["tally_calls"])
+    assert unknown == []
+    assert actual == expected, (actual, expected)
+    assert fused["tally_calls"] == unfused["tally_calls"], (
+        fused["tally_calls"], unfused["tally_calls"])
 
 
 # -------------------------------------------------------------- self-audit --

@@ -64,9 +64,21 @@ DONATION_PROBES = ("jit_f32",) + jp.CENSUS_PROBES
 # program name in hlo_budgets.json → probe ("serve" = the serve forward).
 BUDGET_PROGRAMS = {
     "train_step:jit_f32": "jit_f32",
+    # The bf16 precision-policy step, budgeted NEXT TO its f32 twin so a
+    # layer change that silently re-widens activations shows up as an
+    # over-budget diff before a chip window is spent. CPU-gate caveat,
+    # measured (PERFORMANCE.md "Flipping the bound"): this backend has no
+    # native bf16 kernels, so float normalization stages every bf16
+    # dot/conv through f32 copies and the probe's temp bytes read HIGHER
+    # than f32's (840,288 vs 521,824 at the 2026-08 regeneration) — the
+    # entry gates regressions of the bf16 program against itself; the
+    # halved-activation claim is a TPU number, carried by the bench
+    # hbm_peak_bytes_per_chip mirror and the §13 precision-ladder A/B.
+    "train_step:jit_bf16_policy": "jit_bf16_policy",
     "train_step:shard_dp_fsdp": "shard_dp_fsdp",
     "train_step:shard_q8_ef": "shard_q8_ef",
     "train_step:shard_zero": "shard_zero",
+    "train_step:shard_zero_fused": "shard_zero_fused",
     "serve_forward:lenet5": "serve",
 }
 

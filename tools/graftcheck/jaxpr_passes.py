@@ -74,6 +74,13 @@ PROBE_CONFIGS: dict[str, dict] = {
     # Upcast audit: same step with a bf16 model — every matmul should run
     # in bf16 except the deliberately-f32 logits head.
     "jit_bf16": {"model": {"dtype": "bfloat16"}},
+    # Upcast audit B: model.dtype stays float32 but the PRECISION POLICY
+    # layer (core/config.py PrecisionConfig) overrides the compute dtype
+    # — proves precision.activation_dtype actually reaches the layers
+    # (if the override were dropped the trace would be all-f32 and the
+    # pass would find no logits-head widening, failing the dedicated
+    # test rather than shipping a silent no-op knob).
+    "jit_bf16_policy": {"precision": {"activation_dtype": "bf16"}},
     # Census A: explicit dp×fsdp collectives (grad pmean + param gathers).
     "shard_dp_fsdp": {"mesh": {"data": 4, "fsdp": 2},
                       "train": {"spmd_mode": "shard_map"}},
@@ -87,9 +94,21 @@ PROBE_CONFIGS: dict[str, dict] = {
     "shard_zero": {"mesh": {"data": 8},
                    "optimizer": {"zero_sharding": "shard_map"},
                    "train": {"spmd_mode": "shard_map"}},
+    # Census D: the fused donated optimizer update
+    # (precision.fused_update) — the optax apply moves INTO the bucketed
+    # reverse-layer walk (parallel/zero.fused_update_walk), so the probe
+    # pins that fusing changes WHERE the update runs, not what goes on
+    # the wire: collective kinds and counts must stay identical to the
+    # unfused shard_zero probe, and the compiled module must keep at
+    # least as many donation aliases (hlo_passes.DONATION_PROBES).
+    "shard_zero_fused": {"mesh": {"data": 8},
+                         "optimizer": {"zero_sharding": "shard_map"},
+                         "train": {"spmd_mode": "shard_map"},
+                         "precision": {"fused_update": True}},
 }
 
-CENSUS_PROBES = ("shard_dp_fsdp", "shard_q8_ef", "shard_zero")
+CENSUS_PROBES = ("shard_dp_fsdp", "shard_q8_ef", "shard_zero",
+                 "shard_zero_fused")
 
 _PROBE_CACHE: dict[tuple[str, str], dict] = {}
 
@@ -276,20 +295,26 @@ def donation_pass(ctx: RepoContext) -> list[Finding]:
     "config); intentional widenings carry suppressions",
     anchors=("*/train/step.py", "*/models/*.py", "*/train/losses.py"))
 def f32_upcast_pass(ctx: RepoContext) -> list[Finding]:
-    probe = get_probe(ctx, "jit_bf16")
     findings = []
     seen = set()
-    for prim, stack in collect_upcasts(probe["jaxpr"]):
-        where = f"trace:{stack}"
-        if (prim, where) in seen:
-            continue
-        seen.add((prim, where))
-        findings.append(Finding(
-            "jaxpr-f32-upcast", where,
-            f"{prim} consumes a bf16/int8 tensor widened to f32 at "
-            f"{stack} — the matmul runs full-precision despite "
-            f"model.dtype=bfloat16 (suppress with a justification if "
-            f"intentional)"))
+    # Two routes to a bf16 step, both audited: model.dtype=bfloat16 and
+    # the precision-policy override (precision.activation_dtype=bf16 over
+    # an f32 model config). The where strings are probe-agnostic on
+    # purpose — the same logits-head suppression covers the identical
+    # widening in both traces.
+    for probe_name in ("jit_bf16", "jit_bf16_policy"):
+        probe = get_probe(ctx, probe_name)
+        for prim, stack in collect_upcasts(probe["jaxpr"]):
+            where = f"trace:{stack}"
+            if (prim, where) in seen:
+                continue
+            seen.add((prim, where))
+            findings.append(Finding(
+                "jaxpr-f32-upcast", where,
+                f"{prim} consumes a bf16/int8 tensor widened to f32 at "
+                f"{stack} — the matmul runs full-precision despite the "
+                f"bf16 compute config (suppress with a justification if "
+                f"intentional)"))
     return findings
 
 
