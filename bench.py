@@ -29,7 +29,11 @@ sink (default: <BENCH_TRACE>/bench_events.jsonl, else ./bench_events.jsonl;
 BENCH_JSONL=0 disables). BENCH_WAIT=<minutes> arms a bounded backend-init
 retry budget (see _init_backend). A backend probe HANG (vs a probe error)
 exits 3 with failure_class="probe_hang" in the JSON — chip access
-flakiness, not a code regression. BENCH_COLLECTIVE=f32|bf16|int8 runs the
+flakiness, not a code regression. BENCH_PROBE_ONLY=1 runs ONLY the
+backend probe and exits (0 healthy / 3 hang / 1 error) — the queue
+driver's preflight, so a dead chip fails the whole queue once instead of
+every workload separately burning its BENCH_WAIT budget (rounds r03–r05
+lost hours to exactly that). BENCH_COLLECTIVE=f32|bf16|int8 runs the
 collective wire-format A/B instead of a single workload
 (_run_collective_ab): f32-wire baseline vs the requested wire format on
 the same ladder, reporting the tallied wire-byte ratio and throughput
@@ -1133,6 +1137,20 @@ def _run(writer) -> int:
                   "not a code regression (exit 3)", file=sys.stderr)
             return 3
         return 1
+
+    if os.environ.get("BENCH_PROBE_ONLY", "").strip() not in ("", "0"):
+        # Preflight mode: the backend-init outcome IS the result. The
+        # queue driver runs this once before its first workload — the
+        # probe-hang classification (exit 3) happens immediately, up
+        # front, instead of once per dial with BENCH_WAIT burned each
+        # time.
+        out = {"probe_only": True, "chip": chip, "num_chips": n_chips,
+               "run_id": writer.run_id}
+        writer.emit(telemetry.KIND_BENCH_PROBE,
+                    health={"outcome": "ok", "probe_only": True,
+                            "chip": chip, "num_chips": n_chips})
+        print(json.dumps(out))
+        return 0
 
     coll_mode = os.environ.get("BENCH_COLLECTIVE", "").strip()
     if coll_mode:

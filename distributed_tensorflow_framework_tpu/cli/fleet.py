@@ -1,0 +1,159 @@
+"""``python -m distributed_tensorflow_framework_tpu.cli.fleet`` — stand up
+a health-aware serving fleet: N replica engines behind one router.
+
+    python -m distributed_tensorflow_framework_tpu.cli.fleet \
+        --artifact /runs/lenet_artifact \
+        [--set serve.fleet_replicas=3 --set serve.port=8000]
+
+Each replica is a ``cli/serve.py`` subprocess on an ephemeral port with
+its own log dir (``<log_dir>/r{i}/``); the router (serve/fleet.py)
+load-balances ``POST /predict`` across them with hedged retries, ejects
+and readmits them on health, restarts dead ones through the supervision
+machinery, and walks ``POST /reload`` across the fleet one drained
+replica at a time. The router's resolved endpoint lands in
+``<log_dir>/endpoint.json`` — same contract as the single server, so
+scripts/load_gen.py points at a fleet unchanged.
+
+SIGTERM drains the router first (stop admission) and then SIGTERMs every
+replica, whose own graceful drain finishes queued work — the whole tree
+exits 0 on a clean preemption.
+
+The router process itself never imports jax: replica subprocesses own
+the accelerators, the parent is pure stdlib plumbing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import subprocess
+import sys
+
+from distributed_tensorflow_framework_tpu.core.config import load_config
+from distributed_tensorflow_framework_tpu.core.metrics import setup_logging
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--artifact", type=str, default=None,
+                   help="artifact directory from cli/export.py (overrides "
+                        "serve.artifact_dir)")
+    p.add_argument("--config", type=str, default=None,
+                   help="optional YAML config (serve.* block)")
+    p.add_argument(
+        "--set", dest="overrides", action="append", default=[],
+        metavar="key.path=value", help="config override (repeatable)")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="fleet size (overrides serve.fleet_replicas)")
+    return p.parse_args(argv)
+
+
+def make_replica_launcher(artifact_dir: str, log_dir: str,
+                          overrides: list[str]):
+    """Build the launcher serve/fleet.py uses for first launch AND every
+    supervised restart: spawn ``cli.serve`` on an ephemeral port without
+    blocking on readiness (the router's prober admits the replica once
+    its endpoint.json appears and /healthz answers)."""
+
+    def launch(index: int):
+        replica_dir = os.path.join(log_dir, f"r{index}")
+        os.makedirs(replica_dir, exist_ok=True)
+        endpoint_path = os.path.join(replica_dir, "endpoint.json")
+        # A stale endpoint.json from the previous incarnation would make
+        # the prober probe a dead port forever — remove it first.
+        try:
+            os.remove(endpoint_path)
+        except FileNotFoundError:
+            pass
+        cmd = [
+            sys.executable, "-m",
+            "distributed_tensorflow_framework_tpu.cli.serve",
+            "--artifact", artifact_dir,
+            "--set", "serve.port=0",
+            "--set", f"serve.log_dir={replica_dir}",
+        ]
+        for override in overrides:
+            cmd.extend(["--set", override])
+        env = dict(os.environ)
+        env["DTF_REPLICA_ID"] = f"r{index}"
+        # Chaos faults target the ROUTER's fleet_chaos/fleet_reload
+        # points, not the replicas' own in-process points — a replica
+        # inheriting DTF_FAULTS would double-fire the drill.
+        env.pop("DTF_FAULTS", None)
+        env.pop("DTF_FAULTS_STATE", None)
+        log.info("launching replica r%d: %s", index, " ".join(cmd))
+        out = open(os.path.join(replica_dir, "stdout.log"), "ab")
+        try:
+            proc = subprocess.Popen(
+                cmd, stdout=out, stderr=subprocess.STDOUT, env=env)
+        finally:
+            out.close()  # the child holds its own dup of the fd
+        return proc, endpoint_path
+
+    return launch
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    args = parse_args(argv)
+    config = load_config(args.config, overrides=list(args.overrides))
+    srv = config.serve
+    artifact_dir = args.artifact or srv.artifact_dir
+    if not artifact_dir:
+        log.error("no artifact: pass --artifact or set serve.artifact_dir")
+        return 2
+    replicas = args.replicas if args.replicas is not None \
+        else srv.fleet_replicas
+
+    from distributed_tensorflow_framework_tpu.core import telemetry
+    from distributed_tensorflow_framework_tpu.serve.fleet import FleetRouter
+
+    log_dir = srv.log_dir or os.path.join(artifact_dir, "fleet_logs")
+    os.makedirs(log_dir, exist_ok=True)
+    writer = telemetry.TelemetryWriter(
+        os.path.join(log_dir, "events.jsonl"))
+    writer.emit_run_meta(
+        argv=list(argv if argv is not None else sys.argv),
+        config=config.name, role="fleet", artifact=artifact_dir,
+        replicas=replicas)
+
+    # Replica serve.* knobs ride through verbatim; router-only knobs
+    # (host/port/log_dir) are overridden per replica by the launcher.
+    passthrough = [o for o in args.overrides
+                   if not o.startswith(("serve.port=", "serve.host=",
+                                        "serve.log_dir=",
+                                        "serve.fleet_"))]
+    launcher = make_replica_launcher(
+        os.path.abspath(artifact_dir), log_dir, passthrough)
+    router = FleetRouter(srv, telemetry_writer=writer, launcher=launcher)
+    router.spawn_replicas(replicas)
+    router.start()
+    if not router.wait_ready(min_replicas=1, timeout=180.0):
+        log.error("no replica became healthy within 180s — aborting")
+        router.shutdown("startup failed")
+        writer.close()
+        return 3
+    endpoint = {
+        "url": f"http://{router.host}:{router.port}",
+        "host": router.host, "port": router.port, "pid": os.getpid(),
+        "artifact": os.path.abspath(artifact_dir),
+        "events": os.path.join(log_dir, "events.jsonl"),
+        "replicas": replicas, "role": "fleet",
+    }
+    with open(os.path.join(log_dir, "endpoint.json"), "w") as fh:
+        json.dump(endpoint, fh, indent=2)
+        fh.write("\n")
+    router.install_sigterm_drain()
+    try:
+        router.serve_forever()
+    finally:
+        writer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

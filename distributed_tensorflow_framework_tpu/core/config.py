@@ -582,6 +582,37 @@ class ServeConfig:
     # Telemetry logdir ("" = <artifact_dir>/serve_logs).
     log_dir: str = ""
 
+    # ---- Fleet router (serve/fleet.py, cli/fleet.py) ----
+    # Replica engines the router fronts (each a cli/serve.py subprocess).
+    fleet_replicas: int = 3
+    # End-to-end deadline for one proxied /predict, spanning every retry.
+    fleet_deadline_s: float = 30.0
+    # Per-attempt cap (the hedge window): an attempt that has not
+    # answered within this budget is abandoned and the request re-issued
+    # on a DIFFERENT replica while deadline budget remains.
+    fleet_attempt_timeout_s: float = 10.0
+    # Bounded retry count after the first attempt; each retry lands on a
+    # different replica (POST /predict is idempotent — POST /reload and
+    # anything else is proxied at most once).
+    fleet_retries: int = 2
+    # Backoff between retry attempts (doubles per attempt).
+    fleet_retry_backoff_ms: float = 25.0
+    # Consecutive proxy/probe failures before a replica is ejected into
+    # the circuit-breaker probing state.
+    fleet_eject_failures: int = 3
+    # A replica whose last good /healthz is older than this is ejected
+    # (stale health = not routable, even if the TCP port still accepts).
+    fleet_healthz_stale_s: float = 10.0
+    # Background prober cadence: healthz polls of admitted replicas,
+    # probe/readmit of ejected ones, restart of dead ones.
+    fleet_probe_interval_s: float = 0.5
+    # Retry-After seconds returned with a 503 when every admitted
+    # replica is saturated (shed, never queue unboundedly).
+    fleet_shed_retry_after_s: float = 1.0
+    # Per-replica restart budget (supervision backoff applies between
+    # attempts; the crash-loop breaker can stop earlier).
+    fleet_max_restarts: int = 8
+
 
 @config_dataclass
 class ExperimentConfig:

@@ -55,6 +55,26 @@ Spec syntax (``DTF_FAULTS=crash_at_step:120,stall_infeed:30s``):
                      typed MeshSizeError → exit code 84 → elastic refit
                      (core/supervision.py). N may also be LARGER than the
                      current count: growth drills take the same path.
+  kill_replica:N:T   SIGKILL serving replica N (0-based) at the fleet
+                     prober's Tth chaos tick (1-based; default 1) — the
+                     replica-death drill. Fired by serve/fleet.py at its
+                     ``fleet_chaos`` point and applied by the router
+                     (kill the child, watch the circuit breaker eject it
+                     and supervision restart + readmit it). The chaos
+                     clock starts once the whole fleet has been admitted,
+                     so T is relative to readiness, not replica boot.
+  stall_replica:N:S  SIGSTOP serving replica N for S seconds (then
+                     SIGCONT) — the wedged-replica drill: the process is
+                     alive, the port accepts, nothing answers. ``0``
+                     means "stopped forever". The router's hedged
+                     per-attempt timeout must route around it and the
+                     stale-healthz breaker must eject it.
+  corrupt_reload     before the next rolling reload begins, truncate the
+                     largest payload file of the NEW artifact — every
+                     replica's manifest verification must reject the
+                     swap (HTTP 409) and keep serving the old weights.
+                     Fired by serve/fleet.py at its ``fleet_reload``
+                     point; the arg is a free-form label for the logs.
 
 Faults fire at most once per process. When ``DTF_FAULTS_STATE`` names a
 file, firings are also recorded there (before executing — a crash fault
@@ -100,6 +120,12 @@ STATE_ENV_VAR = "DTF_FAULTS_STATE"
 #   ckpt_committed  ckpt/checkpoint.py, after the manifest commit
 #   relaunch        scripts/train_resilient.py, before launching attempt N
 #                   (`step` carries the 1-based attempt ordinal)
+#   fleet_chaos     serve/fleet.py, each prober/supervision tick (`step`
+#                   carries the 1-based tick ordinal); the router applies
+#                   the returned faults to its replica subprocesses
+#   fleet_reload    serve/fleet.py, before a rolling reload begins (the
+#                   router corrupts the NEW artifact so every replica's
+#                   verification must reject the swap)
 KIND_POINTS = {
     "crash_at_step": "step_begin",
     "nan_grads": "step_begin",
@@ -109,6 +135,9 @@ KIND_POINTS = {
     "crash_in_save": "ckpt_in_save",
     "corrupt_ckpt": "ckpt_committed",
     "drop_devices": "relaunch",
+    "kill_replica": "fleet_chaos",
+    "stall_replica": "fleet_chaos",
+    "corrupt_reload": "fleet_reload",
 }
 _STEP_KINDS = ("crash_at_step", "crash_in_save", "nan_grads", "loss_spike")
 _STALL_FOREVER_S = 6 * 3600.0
@@ -122,6 +151,8 @@ class Fault:
     seconds: float | None = None
     # drop_devices: the device count the child set is masked to.
     devices: int | None = None
+    # kill_replica / stall_replica: the 0-based replica index targeted.
+    replica: int | None = None
     # A fault may fire at `count` distinct steps ([step, step+count) —
     # repeat_nan); it is spent once `fires` reaches it.
     count: int = 1
@@ -192,6 +223,39 @@ def _parse_one(entry: str) -> Fault:
                 f"fault drop_devices needs devices >= 1 and attempt >= 1, "
                 f"got {arg!r}"
             )
+    elif kind == "kill_replica":
+        head, _, tail = arg.partition(":")
+        try:
+            fault.replica = int(head)
+            fault.step = int(tail) if tail else 1
+        except ValueError:
+            raise ValueError(
+                f"fault kill_replica needs replica[:tick] (e.g. "
+                f"kill_replica:1:3), got {arg!r}"
+            ) from None
+        if fault.replica < 0 or fault.step < 1:
+            raise ValueError(
+                f"fault kill_replica needs replica >= 0 and tick >= 1, "
+                f"got {arg!r}"
+            )
+    elif kind == "stall_replica":
+        head, _, tail = arg.partition(":")
+        raw = tail[:-1] if tail.endswith("s") else tail
+        try:
+            fault.replica = int(head)
+            fault.seconds = float(raw) if raw else 0.0
+        except ValueError:
+            raise ValueError(
+                f"fault stall_replica needs replica:seconds (e.g. "
+                f"stall_replica:0:10s), got {arg!r}"
+            ) from None
+        if fault.replica < 0:
+            raise ValueError(
+                f"fault stall_replica replica must be >= 0, got {arg!r}"
+            )
+        if fault.seconds == 0.0:
+            fault.seconds = _STALL_FOREVER_S
+        fault.step = 1  # first prober tick, like kill_replica's default
     elif kind == "stall_infeed":
         dur, _, ordinal = arg.partition(":")
         raw = dur[:-1] if dur.endswith("s") else dur

@@ -114,6 +114,16 @@ KIND_SERVE_BATCH = "serve_batch"
 KIND_SERVE_QUEUE = "serve_queue_depth"
 KIND_SERVE_LATENCY = "serve_latency"
 KIND_SERVE_RECOMPILE = "serve_bucket_recompile"
+# Fleet router events (serve/fleet.py, docs/SERVING.md): one per proxied
+# /predict (which replica answered, attempt/retry counts, shed verdict —
+# the routing-skew ledger), one per circuit-breaker transition (eject /
+# readmit / restart, with the reason), and one per replica step of a
+# rolling weight reload (old→new artifact digest, duration, verdict) —
+# together they let analyze_trace.py reconstruct WHY p99 degraded while
+# zero client requests failed.
+KIND_SERVE_ROUTE = "serve_route"
+KIND_SERVE_EJECT = "serve_eject"
+KIND_SERVE_RELOAD = "serve_reload"
 # Goodput ledger (core/goodput.py, docs/OBSERVABILITY.md): periodic +
 # end-of-run classification of every wall-clock second into productive
 # step compute vs overhead buckets (infeed wait, recompiles, metric
@@ -406,6 +416,11 @@ def summarize_events(path: str) -> dict:
         "compute_ms_total": 0.0, "queue_depth_max": 0,
         "recompiles": [], "latency": None,
     }
+    fleet = {
+        "requests": 0, "routed": {}, "retries": 0, "shed": 0,
+        "deadline_exceeded": 0, "skew": None,
+        "ejects": [], "readmits": 0, "restarts": 0, "reloads": [],
+    }
     last_collectives: dict | None = None
     # Per-attempt goodput rollups: one ledger per run_id (process); the
     # final rollup wins over periodic snapshots, else the last seen (a
@@ -546,6 +561,40 @@ def summarize_events(path: str) -> dict:
                 "bucket": extra.get("bucket"),
                 "compile_ms": m.get("compile_ms"),
             })
+        elif kind == KIND_SERVE_ROUTE:
+            m = ev.get("metrics") or {}
+            fleet["requests"] += 1
+            fleet["retries"] += int(m.get("retries", 0) or 0)
+            if extra.get("shed"):
+                fleet["shed"] += 1
+            if extra.get("deadline_exceeded"):
+                fleet["deadline_exceeded"] += 1
+            rep = extra.get("replica")
+            if rep is not None:
+                rep = str(rep)
+                fleet["routed"][rep] = fleet["routed"].get(rep, 0) + 1
+        elif kind == KIND_SERVE_EJECT:
+            action = str(extra.get("action", "eject"))
+            if action == "readmit":
+                fleet["readmits"] += 1
+            elif action == "restart":
+                fleet["restarts"] += 1
+            else:
+                fleet["ejects"].append({
+                    "replica": extra.get("replica"),
+                    "reason": extra.get("reason"),
+                })
+        elif kind == KIND_SERVE_RELOAD:
+            m = ev.get("metrics") or {}
+            # Event order IS the rolling-reload timeline (one replica at
+            # a time by design) — keep it, don't re-sort.
+            fleet["reloads"].append({
+                "replica": extra.get("replica"),
+                "ok": bool(extra.get("ok")),
+                "from_digest": extra.get("from_digest"),
+                "to_digest": extra.get("to_digest"),
+                "reload_ms": m.get("reload_ms"),
+            })
         elif kind == KIND_GOODPUT:
             m = ev.get("metrics") or {}
             snap = {
@@ -601,6 +650,12 @@ def summarize_events(path: str) -> dict:
                 round(float(logical) / float(total), 3)
                 if total and logical is not None else None),
         }
+    if fleet["routed"]:
+        # Routing skew: hottest replica vs the uniform share. 1.0 is a
+        # perfectly balanced fleet; ejections and stalls push it up.
+        counts = list(fleet["routed"].values())
+        mean = sum(counts) / len(counts)
+        fleet["skew"] = round(max(counts) / mean, 3) if mean else None
     goodput = None
     if goodput_by_run:
         # In-process accounting only: restart gaps BETWEEN attempts need
@@ -645,6 +700,9 @@ def summarize_events(path: str) -> dict:
         "zero": zero,
         "serve": (serve if (serve["requests"] or serve["batches"]
                             or serve["recompiles"]) else None),
+        "fleet": (fleet if (fleet["requests"] or fleet["ejects"]
+                            or fleet["readmits"] or fleet["restarts"]
+                            or fleet["reloads"]) else None),
         "goodput": goodput,
         "memory": (memory if memory["samples"] else None),
         "recovery": {
@@ -807,6 +865,38 @@ def format_run_summary(summary: dict) -> str:
             lines.append(
                 f"    bucket recompiles: {len(serve['recompiles'])}"
                 f" ({buckets})"
+            )
+    fleet = summary.get("fleet")
+    if fleet:  # KIND_SERVE_ROUTE / KIND_SERVE_EJECT / KIND_SERVE_RELOAD
+        routed = ", ".join(
+            f"{r}={n}" for r, n in sorted(fleet["routed"].items()))
+        lines.append(
+            f"  fleet: {fleet['requests']} proxied"
+            + (f" ({routed})" if routed else "")
+            + f", retries {fleet['retries']}, shed {fleet['shed']}"
+            + (f", deadline misses {fleet['deadline_exceeded']}"
+               if fleet["deadline_exceeded"] else "")
+            + (f", skew {float(fleet['skew']):.2f}"
+               if fleet.get("skew") is not None else "")
+        )
+        if fleet["ejects"] or fleet["readmits"] or fleet["restarts"]:
+            ej = ", ".join(
+                f"{e.get('replica')}:{e.get('reason')}"
+                for e in fleet["ejects"])
+            lines.append(
+                f"    ejections: {len(fleet['ejects'])}"
+                + (f" ({ej})" if ej else "")
+                + f", readmits {fleet['readmits']}"
+                f", restarts {fleet['restarts']}"
+            )
+        for r in fleet["reloads"]:  # timeline, one line per replica step
+            ms = r.get("reload_ms")
+            lines.append(
+                f"    reload {r.get('replica')}: "
+                f"{str(r.get('from_digest'))[:8]}"
+                f" -> {str(r.get('to_digest'))[:8]} "
+                + ("ok" if r.get("ok") else "REJECTED")
+                + (f" in {float(ms):.0f} ms" if ms is not None else "")
             )
     gp = summary.get("goodput")
     if gp:  # KIND_GOODPUT rollup (per-attempt ledgers summed)

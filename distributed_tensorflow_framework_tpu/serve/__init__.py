@@ -13,22 +13,35 @@ layers, bottom-up:
     parallel/sharding.py specs, and the KIND_SERVE_* SLO telemetry;
   * serve/server.py — the stdlib-only HTTP front end (predict + healthz)
     with graceful SIGTERM drain mirroring the supervisor's preemption
-    contract.
+    contract;
+  * serve/fleet.py — the health-aware router over N replica engines:
+    least-loaded routing, hedged retries, circuit-breaker eject/readmit,
+    supervised restarts, load shedding, and rolling live weight reloads.
 
 See docs/SERVING.md for the architecture and knob reference.
 """
+
+from distributed_tensorflow_framework_tpu.serve.fleet import (  # noqa: F401
+    FleetDrainError,
+    FleetError,
+    FleetProberError,
+    FleetRouter,
+    ReplicaLaunchError,
+)
 
 from distributed_tensorflow_framework_tpu.serve.engine import (  # noqa: F401
     EngineClosedError,
     InferenceEngine,
     OversizeRequestError,
     QueueFullError,
+    ReloadError,
     SequenceTooLongError,
     ServeError,
     serving_mesh,
 )
 from distributed_tensorflow_framework_tpu.serve.export import (  # noqa: F401
     Artifact,
+    artifact_content_digest,
     export_checkpoint,
     load_artifact,
     save_artifact,

@@ -26,6 +26,7 @@ p50/p90/p99 rollups from a bounded reservoir (core/metrics.py).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -50,7 +51,10 @@ from distributed_tensorflow_framework_tpu.core.metrics import (
 )
 from distributed_tensorflow_framework_tpu.models import get_model
 from distributed_tensorflow_framework_tpu.parallel import sharding as shd
-from distributed_tensorflow_framework_tpu.serve.export import Artifact
+from distributed_tensorflow_framework_tpu.serve.export import (
+    Artifact,
+    load_artifact,
+)
 from distributed_tensorflow_framework_tpu.train.step import model_inputs
 
 log = logging.getLogger(__name__)
@@ -71,6 +75,13 @@ class ServeReporterError(RuntimeError):
         super().__init__(
             f"serve reporter thread failed: {type(cause).__name__}: {cause}")
         self.__cause__ = cause
+
+
+class ReloadError(ServeError):
+    """Live weight reload rejected — tampered/unverified artifact, an
+    incompatible model config, or a reload already in flight. The engine
+    keeps serving the OLD weights; rejection is never an outage
+    (server.py maps this to HTTP 409)."""
 
 
 class OversizeRequestError(ServeError):
@@ -182,14 +193,7 @@ class InferenceEngine:
         self.model = get_model(
             artifact.model_config, bn_axis_name=None, mesh=self.mesh)
         # One placement at startup: replicated under the dp-only specs.
-        specs = shd.infer_param_specs(artifact.params, self.mesh)
-        self._variables = {
-            "params": shd.shard_pytree(artifact.params, specs, self.mesh)}
-        if jax.tree.leaves(artifact.batch_stats):
-            stat_specs = shd.infer_param_specs(
-                artifact.batch_stats, self.mesh)
-            self._variables["batch_stats"] = shd.shard_pytree(
-                artifact.batch_stats, stat_specs, self.mesh)
+        self._variables = self._place_variables(artifact)
         self._batch_sharding = NamedSharding(self.mesh, batch_spec(self.mesh))
         self._fn = make_forward(self.model, self.mesh)
         self._compiled: set[tuple] = set()
@@ -198,6 +202,12 @@ class InferenceEngine:
         self._queue: deque[_Request] = deque()
         self._stop_reporting = threading.Event()
         self._state = "running"  # running | draining | closed
+        # Staged live reload: (artifact, placed variables, future,
+        # t_requested), applied by the batcher BETWEEN batches. Identity
+        # label rides fleet telemetry (cli/fleet.py sets DTF_REPLICA_ID).
+        self._pending_reload: tuple | None = None
+        self._reloads = 0
+        self._replica_label = os.environ.get("DTF_REPLICA_ID", "engine")
         self._t_start = time.monotonic()
         self._latency = PercentileReservoir()
         self._requests = 0
@@ -223,6 +233,20 @@ class InferenceEngine:
             "engine up: task=%s step=%d dp=%d row_buckets=%s seq_buckets=%s",
             self.task, artifact.step, self.dp, self.row_buckets,
             self.seq_buckets)
+
+    def _place_variables(self, artifact: Artifact) -> dict[str, Any]:
+        """Host trees -> device, replicated under the dp-only specs (the
+        same placement for cold start and live reload — parity by
+        construction)."""
+        specs = shd.infer_param_specs(artifact.params, self.mesh)
+        variables = {
+            "params": shd.shard_pytree(artifact.params, specs, self.mesh)}
+        if jax.tree.leaves(artifact.batch_stats):
+            stat_specs = shd.infer_param_specs(
+                artifact.batch_stats, self.mesh)
+            variables["batch_stats"] = shd.shard_pytree(
+                artifact.batch_stats, stat_specs, self.mesh)
+        return variables
 
     # ------------------------------------------------------- validation
 
@@ -301,6 +325,70 @@ class InferenceEngine:
                 timeout: float | None = None) -> np.ndarray:
         return self.submit(inputs).result(timeout)
 
+    def request_reload(self, artifact_dir: str) -> Future:
+        """Stage a live weight swap; the batcher applies it BETWEEN
+        batches, so in-flight requests finish on the old weights and the
+        next batch runs the new ones — zero downtime.
+
+        Manifest verification (serve/export.load_artifact) and host->
+        device placement happen HERE, on the calling thread: a tampered
+        or incompatible artifact raises :class:`ReloadError` without the
+        batcher ever seeing it, and the old weights keep serving. The
+        jitted forward is reused unchanged (same model config is
+        enforced), so reloaded responses are bitwise what a cold engine
+        on the new artifact would produce.
+        """
+        try:
+            art = load_artifact(artifact_dir)
+        except (ValueError, OSError) as e:
+            raise ReloadError(
+                f"reload rejected, still serving step "
+                f"{self.artifact.step}: {e}") from e
+        if art.task != self.task:
+            raise ReloadError(
+                f"reload rejected: artifact task {art.task!r} != serving "
+                f"task {self.task!r}")
+        if art.model_config != self.artifact.model_config:
+            raise ReloadError(
+                "reload rejected: model config differs from the serving "
+                "artifact — a fleet swaps weights, not architectures")
+        if art.input_spec != self.artifact.input_spec:
+            raise ReloadError(
+                "reload rejected: input spec differs from the serving "
+                "artifact")
+        t0 = time.monotonic()
+        variables = self._place_variables(art)
+        fut: Future = Future()
+        with self._cond:
+            if self._state != "running":
+                raise EngineClosedError(
+                    f"engine is {self._state} — not accepting reloads")
+            if self._pending_reload is not None:
+                raise ReloadError(
+                    "reload rejected: another reload is already staged")
+            self._pending_reload = (art, variables, fut, t0)
+            self._cond.notify_all()
+        return fut
+
+    def reload(self, artifact_dir: str,
+               timeout: float | None = 60.0) -> dict[str, Any]:
+        """Synchronous :meth:`request_reload` (server.py's POST /reload)."""
+        return self.request_reload(artifact_dir).result(timeout)
+
+    def artifact_info(self) -> dict[str, Any]:
+        """Digest self-report for /healthz: mid-roll, mixed-version
+        replicas each answer with the artifact they are ACTUALLY
+        serving."""
+        with self._cond:
+            art = self.artifact
+            reloads = self._reloads
+        return {
+            "step": art.step,
+            "param_spec_digest": art.param_spec_digest,
+            "content_digest": art.version_digest,
+            "reloads": reloads,
+        }
+
     def stats(self) -> dict[str, Any]:
         """Point-in-time counters for healthz (no locking beyond the
         queue peek — monotonic counters can be a batch stale)."""
@@ -358,10 +446,14 @@ class InferenceEngine:
         with self._cond:
             self._state = "closed"
             leftovers, self._queue = list(self._queue), deque()
+            pending, self._pending_reload = self._pending_reload, None
             self._cond.notify_all()
         for req in leftovers:
             req.future.set_exception(EngineClosedError(
                 "engine drain timed out before this request was served"))
+        if pending is not None:
+            pending[2].set_exception(EngineClosedError(
+                "engine drained before the staged reload applied"))
         self._stop_reporting.set()
         self._reporter.join(max(1.0, self.cfg.report_interval_s))
         self._emit_latency()  # final cumulative rollup — last one wins
@@ -384,6 +476,8 @@ class InferenceEngine:
             while not self._queue:
                 if self._state != "running":
                     return None
+                if self._pending_reload is not None:
+                    return []  # wake the loop so the swap applies now
                 self._cond.wait(0.1)
             deadline = self._queue[0].t_enqueue + self.cfg.max_wait_ms / 1e3
             while (self._state == "running"
@@ -489,8 +583,42 @@ class InferenceEngine:
                              "latency_ms": latency_ms})
             req.future.set_result(out)
 
+    def _apply_pending_reload(self) -> None:
+        """Batcher-thread half of the reload: swap the verified, already
+        placed trees in one locked assignment between batches."""
+        with self._cond:
+            pending, self._pending_reload = self._pending_reload, None
+        if pending is None:
+            return
+        art, variables, fut, t0 = pending
+        old = self.artifact
+        with self._cond:
+            self.artifact = art
+            self._variables = variables
+            self._reloads += 1
+        reload_ms = (time.monotonic() - t0) * 1e3
+        result = {
+            "from_step": old.step, "to_step": art.step,
+            "from_digest": old.version_digest,
+            "to_digest": art.version_digest,
+            "reload_ms": reload_ms,
+        }
+        if self._tw:
+            self._tw.emit(
+                telemetry.KIND_SERVE_RELOAD,
+                metrics={"reload_ms": reload_ms},
+                replica=self._replica_label, ok=True,
+                from_digest=old.version_digest,
+                to_digest=art.version_digest,
+                from_step=old.step, to_step=art.step)
+        log.info("live reload: step %d -> %d, digest %s -> %s (%.0f ms)",
+                 old.step, art.step, old.version_digest[:8],
+                 art.version_digest[:8], reload_ms)
+        fut.set_result(result)
+
     def _batch_loop(self) -> None:
         while True:
+            self._apply_pending_reload()
             batch = self._take_batch()
             if batch is None:
                 return

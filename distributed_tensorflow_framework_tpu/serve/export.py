@@ -76,6 +76,16 @@ def param_tree_digest(tree: Any) -> str:
     return h.hexdigest()
 
 
+def artifact_content_digest(manifest: dict) -> str:
+    """sha256 over the manifest's (file, sha256) records — changes
+    whenever any payload byte changes, unlike param_tree_digest which
+    hashes only the spec (path/shape/dtype)."""
+    h = hashlib.sha256()
+    for rel in sorted(manifest.get("files") or {}):
+        h.update(f"{rel}:{manifest['files'][rel].get('sha256')}\n".encode())
+    return h.hexdigest()
+
+
 def input_spec_for(config: ExperimentConfig, task: str) -> dict[str, Any]:
     """Per-ROW request spec recorded in the artifact: what a client must
     send per example. The server's healthz exposes it so the load
@@ -125,11 +135,23 @@ class Artifact:
     param_spec_digest: str
     input_spec: dict[str, Any]
     meta: dict[str, Any]
+    # WEIGHT-bearing identity from the integrity manifest (see
+    # artifact_content_digest) — "" for artifacts loaded without one.
+    content_digest: str = ""
 
     @property
     def vocab_size(self) -> int:
         return int(self.meta.get("vocab_size") or
                    self.model_config.vocab_size)
+
+    @property
+    def version_digest(self) -> str:
+        """The digest that identifies THIS artifact's weights: the
+        content digest when available, else the spec digest. The fleet's
+        rolling reload keys mixed-version /healthz visibility on it —
+        two same-architecture exports share a param_spec_digest, so the
+        spec digest alone cannot tell old weights from new."""
+        return self.content_digest or self.param_spec_digest
 
 
 def save_artifact(
@@ -328,4 +350,5 @@ def load_artifact(artifact_dir: str, *, verify: bool = True) -> Artifact:
         param_spec_digest=digest,
         input_spec=meta["input_spec"],
         meta=meta,
+        content_digest=artifact_content_digest(manifest),
     )

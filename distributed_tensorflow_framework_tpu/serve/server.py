@@ -8,8 +8,13 @@ blocking IS the dynamic batching window) and two routes:
     out. Typed engine errors map to useful statuses: validation and
     oversize/too-long → 400, backpressure and draining → 503 (retryable),
     anything else → 500.
+  * ``POST /reload`` — ``{"artifact_dir": ...}``: live weight swap via
+    the engine's between-batches reload. Verification failure → 409 with
+    the old weights still serving.
   * ``GET /healthz`` — liveness + the artifact's input spec (the load
-    generator reads it to synthesize traffic) + engine counters.
+    generator reads it to synthesize traffic) + engine counters + the
+    digest of the artifact actually being served (the fleet router's
+    mixed-version visibility during a rolling reload).
 
 SIGTERM mirrors the trainer's graceful-preemption contract
 (core/supervision.py): stop admission, finish every queued request
@@ -35,6 +40,7 @@ from distributed_tensorflow_framework_tpu.serve.engine import (
     InferenceEngine,
     OversizeRequestError,
     QueueFullError,
+    ReloadError,
     SequenceTooLongError,
     ServeError,
 )
@@ -91,10 +97,12 @@ class ServingServer:
                 outer.handle_healthz(self)
 
             def do_POST(self):
-                if self.path != "/predict":
+                if self.path == "/predict":
+                    outer.handle_predict(self)
+                elif self.path == "/reload":
+                    outer.handle_reload(self)
+                else:
                     self._reply(404, {"error": f"no route {self.path}"})
-                    return
-                outer.handle_predict(self)
 
         class Server(ThreadingHTTPServer):
             # The socketserver default accept backlog of 5 drops
@@ -145,6 +153,41 @@ class ServingServer:
             log.exception("predict failed")
             handler._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
+    def handle_reload(self, handler) -> None:
+        """``POST /reload {"artifact_dir": ...}`` — live weight swap.
+
+        Not idempotent and not proxied-with-retry: a rejected reload
+        (tamper, mismatch) is 409 with the engine still on the old
+        weights; only the fleet router's rolling deploy should normally
+        call this directly.
+        """
+        if self._draining.is_set():
+            handler._reply(503, {"error": "draining", "retryable": True})
+            return
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+            if length <= 0 or length > _MAX_BODY:
+                handler._reply(400, {"error": f"bad Content-Length {length}"})
+                return
+            payload = json.loads(handler.rfile.read(length))
+            artifact_dir = payload.get("artifact_dir")
+            if not isinstance(artifact_dir, str) or not artifact_dir:
+                handler._reply(
+                    400, {"error": "body must be {\"artifact_dir\": ...}"})
+                return
+            result = self.engine.reload(
+                artifact_dir, timeout=self.cfg.drain_timeout_s)
+            handler._reply(200, {"reloaded": True, **result})
+        except ReloadError as e:
+            handler._reply(409, {"error": str(e), "reloaded": False})
+        except EngineClosedError as e:
+            handler._reply(503, {"error": str(e), "retryable": True})
+        except json.JSONDecodeError as e:
+            handler._reply(400, {"error": f"invalid JSON: {e}"})
+        except Exception as e:  # noqa: BLE001 — server must outlive a bad request
+            log.exception("reload failed")
+            handler._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
     def handle_healthz(self, handler) -> None:
         status = 503 if self._draining.is_set() else 200
         art = self.engine.artifact
@@ -155,6 +198,9 @@ class ServingServer:
             "step": art.step,
             "vocab_size": art.vocab_size,
             "input_spec": art.input_spec,
+            # Which weights am I ACTUALLY serving — mid-roll, mixed-
+            # version replicas answer with different digests here.
+            "artifact": self.engine.artifact_info(),
             "engine": self.engine.stats(),
             # Live HBM + goodput snapshots: load_gen diffs these across a
             # bench window to attribute serve-side memory pressure and

@@ -27,6 +27,21 @@ run() {
 #    numbers the ledger can't explain. Runs on CPU, never touches the chip.
 run graftcheck env JAX_PLATFORMS=cpu python scripts/graftcheck.py || exit 1
 
+# 0b. Chip preflight: ONE bounded backend probe before any workload
+#     burns its BENCH_WAIT budget (rounds r03–r05: a dead tunnel cost
+#     BENCH_WAIT *per dial* before anything failed). Exit 3 here is the
+#     probe-hang class — chip access is down, abort the whole queue and
+#     re-land it later; nothing to revert.
+run probe env BENCH_PROBE_ONLY=1 python bench.py
+rc=$?
+if [ $rc -eq 3 ]; then
+  echo "chipq: preflight probe HANG — chip access down, aborting queue (exit 3)"
+  exit 3
+elif [ $rc -ne 0 ]; then
+  echo "chipq: preflight probe failed rc=$rc — aborting queue"
+  exit $rc
+fi
+
 # 1. The headline number: driver-format ResNet-50 bench (expect ~2512).
 run resnet python bench.py || exit 1   # if the probe fails, stop — tunnel is down
 
@@ -192,5 +207,34 @@ run prec-f32        env BENCH_PRECISION=f32 python bench.py
 run prec-bf16       env BENCH_PRECISION=bf16 python bench.py
 run prec-bf16-fused env BENCH_PRECISION=bf16_fused python bench.py
 run prec-bf16-int8  env BENCH_PRECISION=bf16_int8 python bench.py
+
+# 14. Fleet-vs-single serving A/B (ISSUE 14, docs/SERVING.md): the same
+#     closed+open load against one engine (§10's artifact, batched arm)
+#     vs a 3-replica fleet behind the health-aware router. The win is
+#     the p99-vs-req/s spread between SERVE_BENCH_batched.json and
+#     SERVE_BENCH_fleet.json (the /2 schema's fleet section carries
+#     per-replica routing counts + router retry/shed deltas, so skew is
+#     readable straight off the JSON line). Reuses §10's artifact; a
+#     failed §10 export already aborted the queue. Drained via SIGTERM
+#     like every serving arm (exit 0 = clean fleet drain).
+python -m distributed_tensorflow_framework_tpu.cli.fleet \
+    --artifact /tmp/chipq_serve/artifact --replicas 3 \
+    --set serve.log_dir=/tmp/chipq_fleet \
+    --set serve.max_batch_size=8 --set serve.max_wait_ms=5 \
+    > /tmp/chipq_fleet.log 2>&1 &
+fleet_pid=$!
+for _ in $(seq 240); do
+  [ -f /tmp/chipq_fleet/endpoint.json ] && break
+  sleep 1
+done
+run serve-fleet python scripts/load_gen.py \
+    --endpoint /tmp/chipq_fleet/endpoint.json \
+    --requests 512 --concurrency 32 --rate 200 --mode both \
+    --out SERVE_BENCH_fleet.json
+kill -TERM "$fleet_pid" 2>/dev/null
+wait "$fleet_pid"
+echo "--- [serve-fleet] drain rc=$? (0 = clean fleet drain)"
+run serve-fleet-slo python scripts/analyze_trace.py \
+    /tmp/chipq_fleet/events.jsonl
 
 echo "=== chip queue done $(date -u +%FT%TZ) ==="

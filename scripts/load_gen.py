@@ -46,7 +46,10 @@ from distributed_tensorflow_framework_tpu.core.metrics import (  # noqa: E402
     PercentileReservoir,
 )
 
-BENCH_SCHEMA = "dtf-serve-bench/1"
+# /2 is additive over /1: per-run "by_replica" and a top-level "fleet"
+# section (router counter deltas + replica distribution) appear when the
+# endpoint is a fleet router; every /1 field is unchanged.
+BENCH_SCHEMA = "dtf-serve-bench/2"
 
 
 def resolve_endpoint(endpoint: str) -> str:
@@ -98,8 +101,10 @@ def make_payload(spec: dict, rows: int, *, vocab_size: int,
 
 
 def post_predict(url: str, payload: dict, timeout: float = 60.0) -> tuple:
-    """(status, latency_ms, rows_returned). Network errors count as
-    status 0 — a closed connection mid-drain must not crash the bench."""
+    """(status, latency_ms, rows_returned, replica). Network errors count
+    as status 0 — a closed connection mid-drain must not crash the bench.
+    ``replica`` is the fleet router's X-DTF-Replica attribution header
+    (None against a single server)."""
     body = json.dumps(payload).encode()
     req = urllib.request.Request(
         url + "/predict", data=body,
@@ -109,12 +114,13 @@ def post_predict(url: str, payload: dict, timeout: float = 60.0) -> tuple:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             out = json.load(resp)
             return resp.status, (time.monotonic() - t0) * 1e3, \
-                int(out.get("rows", 0))
+                int(out.get("rows", 0)), resp.headers.get("X-DTF-Replica")
     except urllib.error.HTTPError as e:
         e.read()
-        return e.code, (time.monotonic() - t0) * 1e3, 0
+        return e.code, (time.monotonic() - t0) * 1e3, 0, \
+            e.headers.get("X-DTF-Replica")
     except (urllib.error.URLError, OSError, TimeoutError):
-        return 0, (time.monotonic() - t0) * 1e3, 0
+        return 0, (time.monotonic() - t0) * 1e3, 0, None
 
 
 def _drive(url: str, payloads: list[dict], *, concurrency: int,
@@ -122,14 +128,18 @@ def _drive(url: str, payloads: list[dict], *, concurrency: int,
     """Run one mode over pre-built payloads; rate=None → closed loop."""
     latency = PercentileReservoir()
     lock = threading.Lock()
-    counts = {"ok": 0, "errors": 0, "rows": 0, "by_status": {}}
+    counts = {"ok": 0, "errors": 0, "rows": 0, "by_status": {},
+              "by_replica": {}}
     idx = {"next": 0}
 
-    def record(status, ms, rows):
+    def record(status, ms, rows, replica=None):
         with lock:
             latency.add(ms)
             key = str(status)
             counts["by_status"][key] = counts["by_status"].get(key, 0) + 1
+            if replica is not None:
+                counts["by_replica"][replica] = \
+                    counts["by_replica"].get(replica, 0) + 1
             if status == 200:
                 counts["ok"] += 1
                 counts["rows"] += rows
@@ -181,6 +191,10 @@ def _drive(url: str, payloads: list[dict], *, concurrency: int,
         "rows_per_sec": counts["rows"] / elapsed,
         "latency_ms": {"p50": s["p50"], "p90": s["p90"], "p99": s["p99"],
                        "mean": s["mean"], "count": s["count"]},
+        # Client-observed per-replica distribution (fleet endpoints only):
+        # how evenly did the router actually spread THIS window's traffic.
+        **({"by_replica": dict(sorted(counts["by_replica"].items()))}
+           if counts["by_replica"] else {}),
         **({"offered_rate": rate} if rate is not None else
            {"concurrency": concurrency}),
     }
@@ -208,6 +222,24 @@ def run_bench(endpoint: str, *, requests: int = 256, concurrency: int = 32,
                            rate=rate))
     health1 = fetch_healthz(url)
     engine1 = health1.get("engine", {})
+    # Against a fleet router: the router-counter deltas over the bench
+    # window (how many proxied requests needed a retry, how many were
+    # shed) plus the server-side routed distribution and replica states.
+    fleet = None
+    if health1.get("role") == "fleet":
+        router0 = (health.get("fleet") or {}).get("router") or {}
+        router1 = (health1.get("fleet") or {}).get("router") or {}
+        fleet = {
+            "replicas": [
+                {"replica": r.get("replica"), "state": r.get("state"),
+                 "routed": r.get("routed"), "restarts": r.get("restarts")}
+                for r in (health1.get("fleet") or {}).get("replicas", [])],
+            "router_delta": {
+                key: router1.get(key, 0) - router0.get(key, 0)
+                for key in ("requests", "retries", "shed",
+                            "deadline_exceeded")},
+            "admitted": (health1.get("fleet") or {}).get("admitted"),
+        }
     # Server-side split over the bench window: where did a request's
     # life go — waiting for the admission window, or under compute?
     split = {
@@ -231,6 +263,7 @@ def run_bench(endpoint: str, *, requests: int = 256, concurrency: int = 32,
         "step": health.get("step"),
         "rows_per_request": rows,
         "runs": runs,
+        "fleet": fleet,
         "server_split": split,
         "server_latency": engine1.get("latency"),
         # Healthz deltas across the window: serve-side HBM pressure (peak
@@ -281,6 +314,13 @@ def main(argv=None) -> int:
         print(f"{run['mode']:>6}: {run['ok']}/{run['requests']} ok, "
               f"{run['requests_per_sec']:.1f} req/s, "
               f"p50 {lat['p50']:.1f} ms, p99 {lat['p99']:.1f} ms")
+    if bench.get("fleet"):
+        delta = bench["fleet"]["router_delta"]
+        dist = ", ".join(
+            f"{r['replica']}={r['routed']}"
+            for r in bench["fleet"]["replicas"])
+        print(f" fleet: {delta['requests']} proxied ({dist}), "
+              f"{delta['retries']} retries, {delta['shed']} shed")
     print(f"wrote {args.out}")
     return 0 if all(r["ok"] for r in bench["runs"]) else 1
 

@@ -13,6 +13,9 @@ set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 ART="${1:-/tmp/tier1}"
+# TIER1_MARKERS widens the run (e.g. TIER1_MARKERS='slow and serve'
+# runs the fleet chaos drill, whose serve-bench JSON is archived below).
+MARKERS="${TIER1_MARKERS:-not slow}"
 mkdir -p "$ART"
 
 echo "=== graftcheck (full run, JSON → $ART/graftcheck.json) ==="
@@ -21,11 +24,19 @@ env JAX_PLATFORMS=cpu python scripts/graftcheck.py \
 gc_rc=${PIPESTATUS[0]}
 
 echo "=== tier-1 pytest (log → $ART/pytest.log) ==="
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
-    -m 'not slow' --continue-on-collection-errors \
+# DTF_SERVE_BENCH_DIR: when a slow run includes the fleet chaos drill
+# (tests/test_fleet_drill.py), its dtf-serve-bench/2 JSON lands here
+# next to the other artifacts instead of dying with pytest's tmpdir.
+timeout -k 10 870 env JAX_PLATFORMS=cpu DTF_SERVE_BENCH_DIR="$ART" \
+    python -m pytest tests/ -q \
+    -m "$MARKERS" --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
     | tee "$ART/pytest.log"
 py_rc=${PIPESTATUS[0]}
+
+if [ -f "$ART/SERVE_BENCH_FLEET.json" ]; then
+  echo "=== serve bench archived: $ART/SERVE_BENCH_FLEET.json ==="
+fi
 
 echo "=== tier-1 summary: graftcheck rc=$gc_rc pytest rc=$py_rc ==="
 [ "$gc_rc" -eq 0 ] && [ "$py_rc" -eq 0 ]

@@ -1,0 +1,411 @@
+"""Fleet router (serve/fleet.py) — tier-1 unit coverage with stub
+replicas.
+
+The router is stdlib-only and fronts anything speaking the replica HTTP
+contract (/predict, /healthz, /reload), so these tests drive it against
+in-process stub servers: routing and balance, hedged retry on a
+DIFFERENT replica, circuit-breaker eject/readmit, load shedding with
+Retry-After, the rolling reload walk (including the abort-on-reject
+rule), the serve-fault spec parsing, and the KIND_SERVE_ROUTE /
+KIND_SERVE_EJECT / KIND_SERVE_RELOAD telemetry rollups.
+
+The real thing — three ``cli/serve.py`` subprocesses killed, stalled and
+rolled under live load — is the slow drill in test_fleet_drill.py.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributed_tensorflow_framework_tpu.core import faults, telemetry
+from distributed_tensorflow_framework_tpu.core.config import ServeConfig
+from distributed_tensorflow_framework_tpu.serve.fleet import (
+    FleetError,
+    FleetRouter,
+    ReplicaLaunchError,
+    read_endpoint,
+)
+
+pytestmark = pytest.mark.serve
+
+
+class StubReplica:
+    """A scriptable replica: flip ``fail``/``down``/``slow_s`` to model a
+    broken, dead, or wedged engine; ``digest`` models the weights
+    actually being served (swapped by /reload unless ``reject_reload``).
+    """
+
+    def __init__(self):
+        outer = self
+        self.fail = False
+        self.down = False
+        self.slow_s = 0.0
+        self.digest = "digest-v1"
+        self.step = 7
+        self.reject_reload = False
+        self.predicts = 0
+        self.reloads = 0
+        self.lock = threading.Lock()
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, status, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if outer.down:
+                    self._reply(503, {"error": "down"})
+                    return
+                with outer.lock:
+                    digest, step = outer.digest, outer.step
+                self._reply(200, {
+                    "status": "ok", "task": "classify", "model": "stub",
+                    "step": step, "vocab_size": 10,
+                    "input_spec": {"image": {"shape": [4], "dtype": "f32"}},
+                    "artifact": {"step": step, "content_digest": digest,
+                                 "param_spec_digest": "spec", "reloads":
+                                 outer.reloads},
+                    "engine": {"state": "running", "queue_depth": 0,
+                               "requests": outer.predicts},
+                })
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                if self.path == "/reload":
+                    payload = json.loads(body)
+                    if outer.reject_reload:
+                        self._reply(409, {"reloaded": False,
+                                          "error": "reload rejected"})
+                        return
+                    with outer.lock:
+                        outer.reloads += 1
+                        outer.digest = "digest-" + payload["artifact_dir"]
+                        outer.step += 1
+                        to_digest = outer.digest
+                    self._reply(200, {"reloaded": True,
+                                      "to_digest": to_digest,
+                                      "from_digest": "digest-v1"})
+                    return
+                if outer.slow_s:
+                    time.sleep(outer.slow_s)
+                with outer.lock:
+                    outer.predicts += 1
+                if outer.fail or outer.down:
+                    self._reply(500, {"error": "stub failure"})
+                else:
+                    self._reply(200, {"outputs": [[0.0]], "rows": 1,
+                                      "step": outer.step})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def _router(stubs, tmp_path=None, *, start=False, writer=None, **knobs):
+    base = {"port": 0, "fleet_probe_interval_s": 0.1, "fleet_retries": 2,
+            "fleet_retry_backoff_ms": 5.0, "fleet_eject_failures": 2,
+            "fleet_deadline_s": 10.0, "fleet_attempt_timeout_s": 5.0,
+            "fleet_healthz_stale_s": 2.0}
+    base.update(knobs)
+    router = FleetRouter(ServeConfig(**base), telemetry_writer=writer)
+    for stub in stubs:
+        router.add_replica(url=stub.url, admitted=True)
+    thread = threading.Thread(target=router.serve_forever, daemon=True)
+    thread.start()
+    if start:
+        router.start()
+    return router, thread
+
+
+def _post(url, payload, timeout=20.0):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+@pytest.fixture
+def fleet2():
+    stubs = [StubReplica(), StubReplica()]
+    routers = []
+    yield stubs, routers
+    for router, thread in routers:
+        router.shutdown("test teardown")
+        thread.join(10)
+    for stub in stubs:
+        stub.close()
+
+
+def test_routes_and_balances(fleet2):
+    stubs, routers = fleet2
+    router, thread = _router(stubs)
+    routers.append((router, thread))
+    url = f"http://{router.host}:{router.port}"
+    seen = set()
+    for _ in range(8):
+        status, out, headers = _post(url, {"inputs": {"image": [[1.0]]}})
+        assert status == 200 and out["rows"] == 1
+        seen.add(headers.get("X-DTF-Replica"))
+    # Equal-load ties round-robin: both replicas actually served.
+    assert seen == {"r0", "r1"}
+    health = router.fleet_healthz()
+    assert health["fleet"]["router"]["requests"] == 8
+    assert health["fleet"]["router"]["shed"] == 0
+    routed = {r["replica"]: r["routed"] for r in health["fleet"]["replicas"]}
+    assert routed == {"r0": 4, "r1": 4}
+
+
+def test_retry_lands_on_different_replica(fleet2):
+    stubs, routers = fleet2
+    stubs[0].fail = True
+    router, thread = _router(stubs)
+    routers.append((router, thread))
+    url = f"http://{router.host}:{router.port}"
+    for _ in range(6):
+        status, out, headers = _post(url, {"inputs": {"image": [[1.0]]}})
+        assert status == 200
+        assert headers.get("X-DTF-Replica") == "r1"
+    health = router.fleet_healthz()
+    assert health["fleet"]["router"]["retries"] >= 1
+    # fleet_eject_failures=2 consecutive 500s tripped the breaker.
+    states = {r["replica"]: r["state"] for r in health["fleet"]["replicas"]}
+    assert states["r0"] == "ejected"
+
+
+def test_eject_then_readmit_via_prober(fleet2):
+    stubs, routers = fleet2
+    stubs[0].down = True
+    router, thread = _router(stubs, start=True)
+    routers.append((router, thread))
+
+    def state_of(index):
+        health = router.fleet_healthz()
+        return health["fleet"]["replicas"][index]["state"]
+
+    deadline = time.monotonic() + 10
+    while state_of(0) != "ejected" and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert state_of(0) == "ejected"
+    stubs[0].down = False  # heals; the prober must readmit
+    deadline = time.monotonic() + 10
+    while state_of(0) != "admitted" and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert state_of(0) == "admitted"
+
+
+def test_sheds_with_retry_after_when_nothing_admitted(fleet2):
+    stubs, routers = fleet2
+    router, thread = _router(stubs[:1], fleet_shed_retry_after_s=2.5)
+    routers.append((router, thread))
+    with router._lock:
+        router._replicas[0].state = "ejected"
+    url = f"http://{router.host}:{router.port}"
+    status, out, headers = _post(url, {"inputs": {"image": [[1.0]]}})
+    assert status == 503
+    assert out["retryable"] is True
+    assert headers.get("Retry-After") == "2.5"
+    assert router.fleet_healthz()["fleet"]["router"]["shed"] == 1
+
+
+def test_rolling_reload_walks_fleet_in_order(fleet2, tmp_path):
+    stubs, routers = fleet2
+    router, thread = _router(stubs)
+    routers.append((router, thread))
+    results, ok = router.rolling_reload("v2")
+    assert ok is True
+    assert [r["replica"] for r in results] == ["r0", "r1"]
+    assert all(r["ok"] for r in results)
+    assert all(r["to_digest"] == "digest-v2" for r in results)
+    assert all(s.reloads == 1 for s in stubs)
+    # Both replicas readmitted and self-reporting the NEW digest.
+    health = router.fleet_healthz()
+    for rep in health["fleet"]["replicas"]:
+        assert rep["state"] == "admitted"
+        assert rep["artifact"]["content_digest"] == "digest-v2"
+
+
+def test_rejected_reload_aborts_roll(fleet2):
+    stubs, routers = fleet2
+    stubs[0].reject_reload = True
+    router, thread = _router(stubs)
+    routers.append((router, thread))
+    results, ok = router.rolling_reload("v2")
+    assert ok is False
+    # The roll stopped AT the rejecting replica: r1 was never asked, so
+    # a bad artifact cannot spread past the first verification failure.
+    assert len(results) == 1 and results[0]["replica"] == "r0"
+    assert results[0]["status"] == 409
+    assert stubs[1].reloads == 0
+    # The rejecting replica keeps serving its OLD weights, admitted.
+    health = router.fleet_healthz()
+    assert health["fleet"]["replicas"][0]["state"] == "admitted"
+    url = f"http://{router.host}:{router.port}"
+    status, _, _ = _post(url, {"inputs": {"image": [[1.0]]}})
+    assert status == 200
+
+
+def test_concurrent_rolls_are_refused(fleet2):
+    stubs, routers = fleet2
+    router, thread = _router(stubs)
+    routers.append((router, thread))
+    with router._lock:
+        router._rolling = True
+    with pytest.raises(FleetError, match="already in progress"):
+        router.rolling_reload("v2")
+    with router._lock:
+        router._rolling = False
+
+
+def test_4xx_passes_through_without_retry(fleet2):
+    stubs, routers = fleet2
+    router, thread = _router(stubs)
+    routers.append((router, thread))
+    url = f"http://{router.host}:{router.port}"
+    # The stub 200s any predict body, so drive the router's own 400 path
+    # (empty Content-Length) — a client error must not burn retries.
+    body = b""
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(req, timeout=10)
+    assert err.value.code == 400
+    # No retries burned on deterministic client errors.
+    assert router.fleet_healthz()["fleet"]["router"]["retries"] == 0
+
+
+def test_spawn_replicas_requires_launcher(fleet2):
+    stubs, routers = fleet2
+    router, thread = _router(stubs)
+    routers.append((router, thread))
+    with pytest.raises(ReplicaLaunchError, match="no launcher"):
+        router.spawn_replicas(1)
+
+
+def test_read_endpoint_tolerates_absent_and_torn_files(tmp_path):
+    path = tmp_path / "endpoint.json"
+    assert read_endpoint(str(path)) == ""
+    path.write_text("{not json")
+    assert read_endpoint(str(path)) == ""
+    path.write_text(json.dumps({"url": "http://127.0.0.1:9"}))
+    assert read_endpoint(str(path)) == "http://127.0.0.1:9"
+
+
+# ----------------------------------------------------------- serve faults
+
+
+def test_serve_fault_specs_parse():
+    plan = faults.FaultPlan.parse(
+        "kill_replica:1:3,stall_replica:2:10s,corrupt_reload:v2")
+    kill, stall, corrupt = plan.faults
+    assert kill.kind == "kill_replica" and kill.replica == 1
+    assert kill.step == 3 and kill.point == "fleet_chaos"
+    assert stall.kind == "stall_replica" and stall.replica == 2
+    assert stall.seconds == 10.0 and stall.point == "fleet_chaos"
+    assert corrupt.kind == "corrupt_reload"
+    assert corrupt.point == "fleet_reload" and corrupt.step is None
+
+
+def test_serve_fault_defaults_and_validation():
+    fault = faults.FaultPlan.parse("kill_replica:0").faults[0]
+    assert fault.replica == 0 and fault.step == 1  # first tick default
+    forever = faults.FaultPlan.parse("stall_replica:1:0").faults[0]
+    assert forever.seconds >= 3600  # "0" = stopped forever
+    with pytest.raises(ValueError, match="replica"):
+        faults.FaultPlan.parse("kill_replica:-1")
+    with pytest.raises(ValueError, match="replica:seconds"):
+        faults.FaultPlan.parse("stall_replica:nope")
+
+
+def test_serve_faults_fire_at_their_points():
+    plan = faults.FaultPlan.parse("kill_replica:0:2,corrupt_reload:v2")
+    assert plan.fire("fleet_chaos", step=1) == []  # tick 1: not yet
+    fired = plan.fire("fleet_chaos", step=2)
+    assert [f.kind for f in fired] == ["kill_replica"]
+    assert plan.fire("fleet_chaos", step=2) == []  # once per process
+    fired = plan.fire("fleet_reload")
+    assert [f.kind for f in fired] == ["corrupt_reload"]
+
+
+# ------------------------------------------------------- telemetry rollup
+
+
+def test_fleet_telemetry_rollup(tmp_path):
+    """KIND_SERVE_ROUTE / KIND_SERVE_EJECT / KIND_SERVE_RELOAD aggregate
+    into the summary's fleet section and the human rollup."""
+    events = str(tmp_path / "events.jsonl")
+    writer = telemetry.TelemetryWriter(events)
+    for replica, retries in (("r0", 0), ("r1", 1), ("r0", 0), ("r2", 2)):
+        writer.emit(telemetry.KIND_SERVE_ROUTE,
+                    metrics={"latency_ms": 5.0, "retries": retries,
+                             "status": 200},
+                    replica=replica, shed=False, deadline_exceeded=False)
+    writer.emit(telemetry.KIND_SERVE_ROUTE,
+                metrics={"latency_ms": 1.0, "retries": 0, "status": 503},
+                replica=None, shed=True, deadline_exceeded=False)
+    writer.emit(telemetry.KIND_SERVE_EJECT, replica="r1", action="eject",
+                reason="dead (rc=-9)")
+    writer.emit(telemetry.KIND_SERVE_EJECT, replica="r1", action="restart",
+                reason="supervised relaunch")
+    writer.emit(telemetry.KIND_SERVE_EJECT, replica="r1", action="readmit",
+                reason="healthz recovered")
+    writer.emit(telemetry.KIND_SERVE_RELOAD, metrics={"reload_ms": 120.0},
+                replica="r0", ok=True, from_digest="aaaa1111",
+                to_digest="bbbb2222")
+    writer.emit(telemetry.KIND_SERVE_RELOAD, metrics={"reload_ms": 15.0},
+                replica="r1", ok=False, from_digest="aaaa1111",
+                to_digest=None)
+    writer.close()
+    summary = telemetry.summarize_events(events)
+    fleet = summary["fleet"]
+    assert fleet["requests"] == 5
+    assert fleet["routed"] == {"r0": 2, "r1": 1, "r2": 1}
+    assert fleet["retries"] == 3
+    assert fleet["shed"] == 1
+    assert fleet["ejects"] == [{"replica": "r1", "reason": "dead (rc=-9)"}]
+    assert fleet["readmits"] == 1
+    assert fleet["restarts"] == 1
+    assert [r["ok"] for r in fleet["reloads"]] == [True, False]
+    assert fleet["skew"] is not None
+    text = telemetry.format_run_summary(summary)
+    assert "fleet: 5 proxied" in text
+    assert "retries 3" in text
+    assert "shed 1" in text
+    assert "readmits 1" in text
+    assert "aaaa1111" in text and "bbbb2222" in text
+    assert "REJECTED" in text
+
+
+def test_runs_without_fleet_events_have_no_fleet_section(tmp_path):
+    events = str(tmp_path / "train_only.jsonl")
+    writer = telemetry.TelemetryWriter(events)
+    writer.emit(telemetry.KIND_TRAIN_STEP, step=1, metrics={"loss": 1.0})
+    writer.close()
+    summary = telemetry.summarize_events(events)
+    assert summary["fleet"] is None
+    assert "fleet:" not in telemetry.format_run_summary(summary)

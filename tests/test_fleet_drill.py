@@ -1,0 +1,252 @@
+"""Serve-path chaos drill (tier-2): a 3-replica fleet under live load
+while replicas are killed, stalled, and rolled to new weights.
+
+The acceptance bar is the robustness headline: ZERO failed client
+requests while
+
+  * replica 0 is SIGKILLed mid-load (``kill_replica:0:10``) — the
+    circuit breaker ejects it, supervision restarts it, the prober
+    readmits it;
+  * replica 1 is SIGSTOPed for several seconds (``stall_replica:1:6s``)
+    — alive, port open, answering nothing: the hedged per-attempt
+    timeout routes around it until SIGCONT;
+  * the fleet is rolled to a new artifact one drained replica at a
+    time, and a ``corrupt_reload`` roll is rejected by every replica
+    with the old weights still serving.
+
+The router runs IN-PROCESS (chaos timing is driven through
+faults.install, deterministic relative to fleet readiness) while every
+replica is a real ``cli/serve.py`` subprocess spawned by the cli/fleet
+launcher — the same process tree production runs. Traffic is the real
+``scripts/load_gen.py`` over HTTP; its SERVE_BENCH.json (with the /2
+fleet section) is archived to ``DTF_SERVE_BENCH_DIR`` when the tier
+driver sets it (scripts/run_tier1.sh).
+"""
+
+import copy
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+from test_train_lenet import lenet_config
+
+from distributed_tensorflow_framework_tpu.cli.fleet import (
+    make_replica_launcher,
+)
+from distributed_tensorflow_framework_tpu.core import faults, telemetry
+from distributed_tensorflow_framework_tpu.serve import (
+    FleetRouter,
+    export_checkpoint,
+    load_artifact,
+    save_artifact,
+)
+from distributed_tensorflow_framework_tpu.train import Trainer
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+pytestmark = [pytest.mark.slow, pytest.mark.serve]
+
+
+def _perturbed(artifact, out_dir, bump):
+    params = __import__("jax").tree.map(
+        lambda x: x + np.asarray(bump, x.dtype)
+        if np.issubdtype(np.asarray(x).dtype, np.floating) else x,
+        artifact.params)
+    return save_artifact(
+        str(out_dir), model_config=artifact.model_config,
+        task=artifact.task, params=params,
+        batch_stats=artifact.batch_stats, step=artifact.step + 1,
+        input_spec=artifact.input_spec,
+        vocab_size=artifact.meta.get("vocab_size"))
+
+
+def _wait(predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.25)
+    raise AssertionError(f"timed out after {timeout}s waiting for {what}")
+
+
+def test_fleet_chaos_drill(devices, tmp_path):
+    # 1. Train + export the serving artifact, and two rollout versions.
+    cfg = lenet_config(**{
+        "checkpoint.directory": str(tmp_path / "ckpt"),
+        "checkpoint.async_save": False,
+        "checkpoint.save_interval_steps": 10,
+        "train.total_steps": 10,
+    })
+    trainer = Trainer(cfg)
+    trainer.build()
+    trainer.train()
+    cfg.serve.data = 1
+    cfg.serve.allow_reshard = True
+    art_dir = export_checkpoint(cfg, str(tmp_path / "artifact"))
+    artifact = load_artifact(art_dir)
+    v2_dir = _perturbed(artifact, tmp_path / "artifact_v2", 0.1)
+    v3_dir = _perturbed(artifact, tmp_path / "artifact_v3", 0.2)
+    v2_digest = load_artifact(v2_dir).version_digest
+
+    # 2. Router in-process, replicas as real cli/serve.py subprocesses
+    # via the same launcher cli/fleet.py uses.
+    serve_cfg = copy.deepcopy(cfg.serve)
+    serve_cfg.port = 0
+    serve_cfg.fleet_replicas = 3
+    serve_cfg.fleet_probe_interval_s = 0.25
+    serve_cfg.fleet_eject_failures = 2
+    serve_cfg.fleet_healthz_stale_s = 5.0
+    serve_cfg.fleet_attempt_timeout_s = 8.0
+    # Below load_gen's 60s client timeout: the router must always answer
+    # (even with its worst-case retry chain) before the client gives up.
+    serve_cfg.fleet_deadline_s = 45.0
+    serve_cfg.fleet_retries = 3
+    serve_cfg.drain_timeout_s = 30.0
+    log_dir = tmp_path / "fleet_logs"
+    log_dir.mkdir()
+    events_path = str(log_dir / "events.jsonl")
+    writer = telemetry.TelemetryWriter(events_path)
+    launcher = make_replica_launcher(
+        art_dir, str(log_dir),
+        ["serve.max_batch_size=8", "serve.max_wait_ms=5"])
+    router = FleetRouter(serve_cfg, telemetry_writer=writer,
+                         launcher=launcher)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    serve_thread = threading.Thread(target=router.serve_forever,
+                                    daemon=True)
+    try:
+        # Install the chaos plan BEFORE the prober starts: the chaos
+        # clock arms once all replicas are admitted, stall fires at
+        # tick 1 (right as readiness lands) and the kill at tick 10
+        # (~2.5s later, while load_gen traffic is flowing).
+        faults.install("kill_replica:0:10,stall_replica:1:6s")
+        router.spawn_replicas()
+        serve_thread.start()
+        router.start()
+        assert router.wait_ready(timeout=240.0), router.fleet_healthz()
+        url = f"http://{router.host}:{router.port}"
+
+        def replica(index):
+            return router.fleet_healthz()["fleet"]["replicas"][index]
+
+        # 3. Drive real client load through load_gen while the chaos
+        # plan kills r0 and stalls r1 underneath it.
+        bench_dir = os.environ.get("DTF_SERVE_BENCH_DIR")
+        if bench_dir:
+            os.makedirs(bench_dir, exist_ok=True)
+            bench_path = os.path.join(bench_dir, "SERVE_BENCH_FLEET.json")
+        else:
+            bench_path = str(tmp_path / "SERVE_BENCH_FLEET.json")
+        gen = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "load_gen.py"),
+             "--endpoint", url, "--requests", "300", "--concurrency", "16",
+             "--mode", "closed", "--out", bench_path],
+            cwd=str(REPO), env=env, capture_output=True, text=True,
+            timeout=600)
+        assert gen.returncode == 0, gen.stdout + gen.stderr
+
+        # 4. ZERO failed client requests, with the failures the router
+        # absorbed visible in the bench's fleet section.
+        bench = json.loads(pathlib.Path(bench_path).read_text())
+        assert bench["schema"] == "dtf-serve-bench/2"
+        run = bench["runs"][0]
+        assert run["ok"] == 300 and run["errors"] == 0, run
+        assert run["by_replica"]  # per-replica client attribution
+        assert bench["fleet"] is not None
+        assert bench["fleet"]["router_delta"]["requests"] >= 300
+
+        # 5. The killed replica was ejected, restarted by supervision,
+        # and readmitted; the stalled one recovered after SIGCONT.
+        _wait(lambda: replica(0)["restarts"] >= 1, 60,
+              "supervised restart of the killed replica")
+        _wait(lambda: all(replica(i)["state"] == "admitted"
+                          for i in range(3)), 240,
+              "killed + stalled replicas readmitted")
+
+        # 6. Rolling reload to v2: drain → reload → probe → readmit, one
+        # replica at a time, mixed versions visible mid-roll via the
+        # content digest each replica self-reports on /healthz.
+        body = json.dumps({"artifact_dir": v2_dir}).encode()
+        req = urllib.request.Request(
+            url + "/reload", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            roll = json.load(resp)
+        assert roll["reloaded"] is True, roll
+        assert [r["ok"] for r in roll["replicas"]] == [True] * 3
+        assert all(r["to_digest"] == v2_digest for r in roll["replicas"])
+        assert all(r["from_digest"] != v2_digest
+                   for r in roll["replicas"])
+        health = router.fleet_healthz()
+        assert all(r["artifact"]["content_digest"] == v2_digest
+                   for r in health["fleet"]["replicas"])
+
+        # 7. corrupt_reload: the NEW artifact is torn before the roll;
+        # the first replica's manifest verification rejects it (409),
+        # the roll aborts, and every replica still serves v2.
+        faults.install("corrupt_reload:v3")
+        req = urllib.request.Request(
+            url + "/reload",
+            data=json.dumps({"artifact_dir": v3_dir}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                rejected = json.load(resp)
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status, rejected = e.code, json.loads(e.read() or b"{}")
+        assert status == 409 and rejected["reloaded"] is False
+        assert len(rejected["replicas"]) == 1  # aborted at the first 409
+        assert rejected["replicas"][0]["status"] == 409
+        health = router.fleet_healthz()
+        assert all(r["artifact"]["content_digest"] == v2_digest
+                   for r in health["fleet"]["replicas"])
+        ok, _, _ = _predict_ok(url)
+        assert ok
+
+        # 8. Telemetry explains the whole degradation story: routes with
+        # retries, eject → restart → readmit, reload timeline.
+        writer.close()
+        events = list(telemetry.read_events(events_path))
+        actions = [(ev["extra"].get("replica"), ev["extra"].get("action"))
+                   for ev in events
+                   if ev["kind"] == telemetry.KIND_SERVE_EJECT]
+        assert ("r0", "eject") in actions
+        assert ("r0", "restart") in actions
+        assert ("r0", "readmit") in actions
+        reloads = [ev["extra"] for ev in events
+                   if ev["kind"] == telemetry.KIND_SERVE_RELOAD]
+        assert sum(1 for ev in reloads if ev.get("ok")) >= 3
+        summary = telemetry.summarize_events(events_path)
+        assert summary["fleet"]["requests"] >= 300
+        assert summary["fleet"]["restarts"] >= 1
+        text = telemetry.format_run_summary(summary)
+        assert "fleet:" in text and "ejections:" in text
+    finally:
+        faults.install(None)
+        clean = router.shutdown("drill teardown")
+        serve_thread.join(30)
+        try:
+            writer.close()
+        except ValueError:
+            pass
+        assert clean, "fleet drain left a replica running"
+
+
+def _predict_ok(url):
+    rng = np.random.default_rng(3)
+    image = rng.normal(size=(1, 28, 28, 1)).astype(np.float32).tolist()
+    body = json.dumps({"inputs": {"image": image}}).encode()
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        out = json.load(resp)
+        return resp.status == 200, out, resp.headers.get("X-DTF-Replica")

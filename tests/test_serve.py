@@ -422,3 +422,136 @@ def test_runs_without_serve_events_have_no_serving_section(tmp_path):
     summary = telemetry.summarize_events(events)
     assert summary["serve"] is None
     assert "serving:" not in telemetry.format_run_summary(summary)
+
+
+# ------------------------------------------------------------ live reload
+
+
+def _perturbed_artifact_dir(artifact, out_dir):
+    """A second artifact with the SAME architecture but genuinely
+    different weights — what a rolling deploy actually ships."""
+    params = jax.tree.map(
+        lambda x: x + np.asarray(0.1, x.dtype)
+        if np.issubdtype(np.asarray(x).dtype, np.floating) else x,
+        artifact.params)
+    return save_artifact(
+        str(out_dir),
+        model_config=artifact.model_config,
+        task=artifact.task,
+        params=params,
+        batch_stats=artifact.batch_stats,
+        step=artifact.step + 1,
+        input_spec=artifact.input_spec,
+        vocab_size=artifact.meta.get("vocab_size"),
+    )
+
+
+def _fresh_engine(artifact, trained_cfg):
+    cfg = copy.deepcopy(trained_cfg)
+    for k, v in _serve_overrides().items():
+        obj = cfg
+        parts = k.split(".")
+        for p in parts[:-1]:
+            obj = getattr(obj, p)
+        setattr(obj, parts[-1], v)
+    return InferenceEngine(artifact, cfg.serve, mesh=serving_mesh(1))
+
+
+def test_reload_bitwise_parity_with_cold_engine(
+        artifact, artifact_dir, trained_cfg, tmp_path):
+    """The acceptance bar for live reload: a reloaded engine's outputs
+    are BITWISE identical to a cold-started engine on the new artifact
+    (same jitted forward — model-config equality is enforced — and the
+    same placement path, so parity holds by construction and is verified
+    here, not assumed)."""
+    new_dir = _perturbed_artifact_dir(artifact, tmp_path / "v2")
+    new_artifact = load_artifact(new_dir)
+    assert new_artifact.version_digest != artifact.version_digest
+    rng = np.random.default_rng(11)
+    images = rng.normal(size=(3, 28, 28, 1)).astype(np.float32)
+
+    eng = _fresh_engine(artifact, trained_cfg)
+    cold = _fresh_engine(new_artifact, trained_cfg)
+    try:
+        before = np.asarray(eng.predict({"image": images}, timeout=30.0))
+        result = eng.reload(new_dir, timeout=60.0)
+        assert result["from_step"] == artifact.step
+        assert result["to_step"] == artifact.step + 1
+        assert result["from_digest"] != result["to_digest"]
+        after = np.asarray(eng.predict({"image": images}, timeout=30.0))
+        cold_out = np.asarray(cold.predict({"image": images}, timeout=30.0))
+        assert not np.array_equal(after, before)  # swap actually applied
+        assert np.array_equal(after, cold_out), (
+            "reloaded outputs diverge from a cold engine on the same "
+            f"artifact by {np.max(np.abs(after - cold_out))}")
+        info = eng.artifact_info()
+        assert info["reloads"] == 1
+        assert info["content_digest"] == new_artifact.version_digest
+        assert info["step"] == artifact.step + 1
+    finally:
+        assert eng.drain(10.0)
+        assert cold.drain(10.0)
+
+
+def test_reload_rejects_tampered_artifact_and_keeps_serving(
+        artifact, artifact_dir, trained_cfg, tmp_path):
+    """A truncated payload fails manifest verification on the CALLING
+    thread: typed ReloadError out, zero batcher involvement, and the old
+    weights keep serving bit-for-bit."""
+    import shutil
+
+    from distributed_tensorflow_framework_tpu.core import faults
+    from distributed_tensorflow_framework_tpu.serve import ReloadError
+
+    tampered = tmp_path / "tampered"
+    shutil.copytree(artifact_dir, tampered)
+    assert faults.corrupt_checkpoint_dir(str(tampered)) is not None
+    rng = np.random.default_rng(12)
+    images = rng.normal(size=(2, 28, 28, 1)).astype(np.float32)
+    eng = _fresh_engine(artifact, trained_cfg)
+    try:
+        before = np.asarray(eng.predict({"image": images}, timeout=30.0))
+        with pytest.raises(ReloadError, match="still serving step"):
+            eng.reload(str(tampered), timeout=60.0)
+        after = np.asarray(eng.predict({"image": images}, timeout=30.0))
+        assert np.array_equal(after, before)
+        assert eng.artifact_info()["reloads"] == 0
+        assert eng.artifact_info()["content_digest"] == \
+            artifact.version_digest
+    finally:
+        assert eng.drain(10.0)
+
+
+def test_reload_rejects_incompatible_input_spec(
+        artifact, trained_cfg, tmp_path):
+    from distributed_tensorflow_framework_tpu.serve import ReloadError
+
+    wrong_spec = dict(artifact.input_spec)
+    wrong_spec["image"] = {"shape": [14, 14, 1], "dtype": "float32"}
+    bad_dir = save_artifact(
+        str(tmp_path / "wrong_spec"),
+        model_config=artifact.model_config,
+        task=artifact.task,
+        params=artifact.params,
+        batch_stats=artifact.batch_stats,
+        step=artifact.step,
+        input_spec=wrong_spec,
+        vocab_size=artifact.meta.get("vocab_size"),
+    )
+    eng = _fresh_engine(artifact, trained_cfg)
+    try:
+        with pytest.raises(ReloadError, match="input spec"):
+            eng.reload(bad_dir, timeout=60.0)
+    finally:
+        assert eng.drain(10.0)
+
+
+def test_reload_refused_after_drain(artifact, artifact_dir, trained_cfg):
+    from distributed_tensorflow_framework_tpu.serve import (
+        EngineClosedError,
+    )
+
+    eng = _fresh_engine(artifact, trained_cfg)
+    assert eng.drain(10.0)
+    with pytest.raises(EngineClosedError):
+        eng.reload(artifact_dir, timeout=10.0)

@@ -112,7 +112,8 @@ def test_serving_acceptance_drill(devices, tmp_path):
             timeout=600)
         assert gen.returncode == 0, gen.stdout + gen.stderr
         bench = json.loads(bench_path.read_text())
-        assert bench["schema"] == "dtf-serve-bench/1"
+        assert bench["schema"] == "dtf-serve-bench/2"
+        assert bench["fleet"] is None  # single server, no router section
         assert len(bench["runs"]) == 2
         for run in bench["runs"]:
             assert run["ok"] == 256, run

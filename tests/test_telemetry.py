@@ -507,6 +507,13 @@ def test_summarize_rolls_up_every_kind(tmp_path):
            throughput={"requests_per_sec": 10.0, "rows_per_sec": 20.0})
     w.emit(telemetry.KIND_SERVE_RECOMPILE, bucket="rows2",
            metrics={"compile_ms": 50.0})
+    w.emit(telemetry.KIND_SERVE_ROUTE,
+           metrics={"latency_ms": 5.0, "retries": 1, "status": 200},
+           replica="r0", shed=False, deadline_exceeded=False)
+    w.emit(telemetry.KIND_SERVE_EJECT, replica="r1", action="eject",
+           reason="stale healthz")
+    w.emit(telemetry.KIND_SERVE_RELOAD, metrics={"reload_ms": 120.0},
+           replica="r0", ok=True, from_digest="aaaa", to_digest="bbbb")
     w.emit(telemetry.KIND_GOODPUT, step=5,
            metrics={"wall_s": 10.0, "goodput_frac": 0.8},
            buckets={"step_compute": 8.0, "other": 2.0},
@@ -535,6 +542,10 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert s["health_events"] == {"moe_collapse": 1}
     assert s["serve"]["requests"] == 1 and s["serve"]["batches"] == 1
     assert s["serve"]["queue_depth_max"] == 2
+    assert s["fleet"]["requests"] == 1 and s["fleet"]["retries"] == 1
+    assert s["fleet"]["ejects"] == [{"replica": "r1",
+                                     "reason": "stale healthz"}]
+    assert s["fleet"]["reloads"][0]["to_digest"] == "bbbb"
     assert s["zero"]["shards"] == 8 and s["zero"]["buckets"] == 3
     assert s["goodput"]["attempts"] == 1
     assert s["goodput"]["goodput_frac"] == pytest.approx(0.8)
@@ -549,6 +560,7 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert "health events: moe_collapse=1" in text
     assert "serving: 1 requests (2 rows) in 1 batches" in text
     assert "bucket recompiles: 1 (rows2)" in text
+    assert "fleet: 1 proxied" in text and "ejections: 1" in text
     assert "zero update sharding: 8 shards, 3 buckets" in text
     assert "goodput: 80.0% of 10.0 s wall over 1 attempt(s)" in text
     assert "memory: 1 sample(s)" in text
