@@ -571,8 +571,18 @@ def _annotate_memory(out: dict, result: dict, chip: str,
     against CHIP_PEAKS capacity, or host RAM for unknown chips, so the
     number answers "how much bigger a batch/model fits" on any backend.
     """
+    import jax
+
     mem = result.get("memory") or {}
     analysis = mem.get("analysis") or {}
+    # Multi-process rows stay comparable across topologies: the process
+    # count rides on the row, and the HBM peak below is scoped to THIS
+    # host's devices (memory sampling is per-process). Single-process
+    # rows keep their exact historical shape — this function stays a
+    # no-op when there is nothing to report.
+    if int(jax.process_count()) > 1:
+        out["process_count"] = int(jax.process_count())
+        out["hbm_peak_scope"] = f"host{jax.process_index()}"
     peak = mem.get("peak_bytes_in_use") or 0
     source = mem.get("source_kind", "unknown")
     if source != "device_memory_stats":

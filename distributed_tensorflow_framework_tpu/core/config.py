@@ -456,6 +456,31 @@ class ResilienceConfig:
 
 
 @config_dataclass
+class ClusterConfig:
+    """Gang supervision knobs for the multi-process runtime
+    (core/cluster.py, scripts/train_cluster.py, docs/RESILIENCE.md
+    "Gang supervision"). All of these matter only when
+    jax.process_count() > 1; single-process runs ignore them.
+    """
+
+    # After a gang (re)launch, a worker that produces no heartbeat within
+    # this window while at least one peer has → dropped from the gang and
+    # the mesh is refit to the survivors (gang-level rc-84, no attempt
+    # consumed). 0 disables the rejoin watchdog: the supervisor waits
+    # forever (or until the heartbeat-staleness watchdog fires).
+    rejoin_timeout_s: float = 0.0
+    # Coordinator-led exit barrier: at the end of training every worker
+    # blocks until the chief's manifest commit record for the final step
+    # is durable, polling every exit_barrier_poll_s, raising
+    # ExitBarrierTimeoutError past exit_barrier_timeout_s.
+    exit_barrier_timeout_s: float = 120.0
+    exit_barrier_poll_s: float = 0.5
+    # Per-worker heartbeat cadence (heartbeat-p<i>.json) — the supervisor's
+    # staleness watchdog budget must exceed this.
+    heartbeat_interval_s: float = 10.0
+
+
+@config_dataclass
 class ParallelConfig:
     """Collective wire-format knobs (parallel/collectives.py,
     docs/PERFORMANCE.md "Quantized collectives")."""
@@ -625,6 +650,7 @@ class ExperimentConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     precision: PrecisionConfig = field(default_factory=PrecisionConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
@@ -789,6 +815,27 @@ def load_config(
         raise ValueError(
             "resilience.loss_ewma_beta must be in (0, 1), got "
             f"{res.loss_ewma_beta}"
+        )
+    clu = cfg.cluster
+    if clu.rejoin_timeout_s < 0:
+        raise ValueError(
+            f"cluster.rejoin_timeout_s must be >= 0, got "
+            f"{clu.rejoin_timeout_s}"
+        )
+    if clu.exit_barrier_timeout_s <= 0:
+        raise ValueError(
+            "cluster.exit_barrier_timeout_s must be > 0, got "
+            f"{clu.exit_barrier_timeout_s}"
+        )
+    if clu.exit_barrier_poll_s <= 0:
+        raise ValueError(
+            f"cluster.exit_barrier_poll_s must be > 0, got "
+            f"{clu.exit_barrier_poll_s}"
+        )
+    if clu.heartbeat_interval_s <= 0:
+        raise ValueError(
+            "cluster.heartbeat_interval_s must be > 0, got "
+            f"{clu.heartbeat_interval_s}"
         )
     srv = cfg.serve
     if srv.max_batch_size < 1:

@@ -75,6 +75,28 @@ Spec syntax (``DTF_FAULTS=crash_at_step:120,stall_infeed:30s``):
                      swap (HTTP 409) and keep serving the old weights.
                      Fired by serve/fleet.py at its ``fleet_reload``
                      point; the arg is a free-form label for the logs.
+  kill_worker:W:T    SIGKILL gang worker W (0-based process id) at the
+                     cluster supervisor's Tth chaos tick (1-based;
+                     default 1) — the worker-death drill. Fired by
+                     scripts/train_cluster.py at its ``gang_chaos``
+                     point; the supervisor kills the child and must
+                     then SIGTERM the survivors (chief force-saves) and
+                     relaunch the whole gang. The chaos clock starts
+                     once every worker has heartbeated, so T is
+                     relative to gang readiness, not boot.
+  stall_worker:W:S   SIGSTOP gang worker W for S seconds (then SIGCONT)
+                     — the wedged-worker drill: the process is alive
+                     but its heartbeat goes stale and every peer is
+                     blocked in a collective. ``0`` means "stopped
+                     forever". The supervisor's per-worker watchdog
+                     must catch the stale heartbeat and coordinate a
+                     gang restart.
+  drop_worker:W:T    SIGKILL gang worker W at chaos tick T (1-based;
+                     default 1) and mark it PERMANENTLY lost — the
+                     shrunk-pod drill: the supervisor must refit the
+                     mesh to the surviving process count (gang-level
+                     rc-84) and relaunch smaller without consuming an
+                     attempt.
 
 Faults fire at most once per process. When ``DTF_FAULTS_STATE`` names a
 file, firings are also recorded there (before executing — a crash fault
@@ -126,6 +148,10 @@ STATE_ENV_VAR = "DTF_FAULTS_STATE"
 #   fleet_reload    serve/fleet.py, before a rolling reload begins (the
 #                   router corrupts the NEW artifact so every replica's
 #                   verification must reject the swap)
+#   gang_chaos      scripts/train_cluster.py, each supervisor tick once the
+#                   whole gang has heartbeated (`step` carries the 1-based
+#                   tick ordinal); the supervisor applies the returned
+#                   faults to its worker subprocesses
 KIND_POINTS = {
     "crash_at_step": "step_begin",
     "nan_grads": "step_begin",
@@ -138,6 +164,9 @@ KIND_POINTS = {
     "kill_replica": "fleet_chaos",
     "stall_replica": "fleet_chaos",
     "corrupt_reload": "fleet_reload",
+    "kill_worker": "gang_chaos",
+    "stall_worker": "gang_chaos",
+    "drop_worker": "gang_chaos",
 }
 _STEP_KINDS = ("crash_at_step", "crash_in_save", "nan_grads", "loss_spike")
 _STALL_FOREVER_S = 6 * 3600.0
@@ -153,6 +182,8 @@ class Fault:
     devices: int | None = None
     # kill_replica / stall_replica: the 0-based replica index targeted.
     replica: int | None = None
+    # kill_worker / stall_worker / drop_worker: the 0-based gang process id.
+    worker: int | None = None
     # A fault may fire at `count` distinct steps ([step, step+count) —
     # repeat_nan); it is spent once `fires` reaches it.
     count: int = 1
@@ -256,6 +287,39 @@ def _parse_one(entry: str) -> Fault:
         if fault.seconds == 0.0:
             fault.seconds = _STALL_FOREVER_S
         fault.step = 1  # first prober tick, like kill_replica's default
+    elif kind in ("kill_worker", "drop_worker"):
+        head, _, tail = arg.partition(":")
+        try:
+            fault.worker = int(head)
+            fault.step = int(tail) if tail else 1
+        except ValueError:
+            raise ValueError(
+                f"fault {kind} needs worker[:tick] (e.g. "
+                f"{kind}:1:3), got {arg!r}"
+            ) from None
+        if fault.worker < 0 or fault.step < 1:
+            raise ValueError(
+                f"fault {kind} needs worker >= 0 and tick >= 1, "
+                f"got {arg!r}"
+            )
+    elif kind == "stall_worker":
+        head, _, tail = arg.partition(":")
+        raw = tail[:-1] if tail.endswith("s") else tail
+        try:
+            fault.worker = int(head)
+            fault.seconds = float(raw) if raw else 0.0
+        except ValueError:
+            raise ValueError(
+                f"fault stall_worker needs worker:seconds (e.g. "
+                f"stall_worker:1:10s), got {arg!r}"
+            ) from None
+        if fault.worker < 0:
+            raise ValueError(
+                f"fault stall_worker worker must be >= 0, got {arg!r}"
+            )
+        if fault.seconds == 0.0:
+            fault.seconds = _STALL_FOREVER_S
+        fault.step = 1  # first supervisor tick, like kill_worker's default
     elif kind == "stall_infeed":
         dur, _, ordinal = arg.partition(":")
         raw = dur[:-1] if dur.endswith("s") else dur

@@ -17,8 +17,13 @@ nothing measured it. This module is the accountant:
     run (one ``run_id`` per process) into one cross-attempt table whose
     buckets — including the restart gaps BETWEEN attempts, classified
     from the sibling ``supervisor_events.jsonl`` — sum to the measured
-    wall-clock span. ``format_goodput_table`` renders it
-    (scripts/analyze_trace.py prints it per run directory).
+    wall-clock span. Gang runs add a dimension: each worker's stream
+    (``events.jsonl`` / ``events-p<i>.jsonl``) carries ``process_id``
+    on its goodput events, and stitching a list of streams groups
+    attempts by (run id, process id) into a ``per_host`` section whose
+    every host-table still sums to that host's own measured span.
+    ``format_goodput_table`` renders it (scripts/analyze_trace.py
+    prints it per run directory).
 
 Bucket definitions (seconds of host wall time; docs/OBSERVABILITY.md):
 
@@ -83,9 +88,14 @@ class GoodputLedger:
     """
 
     def __init__(self, writer: telemetry.TelemetryWriter | None = None,
-                 *, interval_s: float = 30.0, t0_perf: float | None = None):
+                 *, interval_s: float = 30.0, t0_perf: float | None = None,
+                 process_id: int | None = None):
         self._writer = writer
         self._interval_s = float(interval_s)
+        # Gang runs stamp the owning process id on every KIND_GOODPUT
+        # event so stitch_attempts can group per host without joining
+        # run_meta across files; single-process runs leave it off.
+        self._process_id = process_id
         self._lock = threading.Lock()
         now = time.perf_counter()
         self._t0 = now if t0_perf is None else float(t0_perf)
@@ -175,6 +185,9 @@ class GoodputLedger:
         if self._writer is None:
             return None
         snap = self.snapshot()
+        extra: dict[str, Any] = {}
+        if self._process_id is not None:
+            extra["process_id"] = self._process_id
         return self._writer.emit(
             telemetry.KIND_GOODPUT,
             step=step,
@@ -184,6 +197,7 @@ class GoodputLedger:
             counters=snap["counters"],
             t0=self.t0_wall,
             final=final,
+            **extra,
         )
 
     def maybe_emit(self, step: int | None = None) -> dict | None:
@@ -203,53 +217,10 @@ class GoodputLedger:
 # -- cross-attempt stitching (read side) ---------------------------------
 
 
-def stitch_attempts(events_path: str,
-                    supervisor_path: str | None = None) -> dict | None:
-    """Join per-attempt ``KIND_GOODPUT`` ledgers into one run table.
-
-    Each supervised attempt is a separate process with its own run_id
-    and ledger; its last (preferably final) goodput event covers the
-    interval ``[t0, t0 + wall_s]``. The wall between one attempt's
-    coverage end and the next attempt's ``t0`` is the ``restart_gap`` —
-    supervisor backoff + relaunch + the next process's pre-ledger
-    import time — classified, when ``supervisor_events.jsonl`` sits
-    next to the events file, by the exit classification of the attempt
-    that ended each gap. Returns None when the file has no goodput
-    events (e.g. a serve log).
-    """
-    by_run: dict[str, dict] = {}
-    for ev in telemetry.read_events(
-            events_path, kind=telemetry.KIND_GOODPUT, strict=False):
-        extra = ev.get("extra") or {}
-        m = ev.get("metrics") or {}
-        snap = {
-            "run_id": ev.get("run_id"),
-            "t0": float(extra.get("t0") or ev.get("t") or 0.0),
-            "wall_s": float(m.get("wall_s") or 0.0),
-            "goodput_frac": m.get("goodput_frac"),
-            "buckets": dict(extra.get("buckets") or {}),
-            "counters": dict(extra.get("counters") or {}),
-            "final": bool(extra.get("final")),
-        }
-        prev = by_run.get(snap["run_id"])
-        if prev is None or not prev["final"] or snap["final"]:
-            by_run[snap["run_id"]] = snap
-    if not by_run:
-        return None
-
-    attempts = sorted(by_run.values(), key=lambda s: s["t0"])
-    classifications: list[str] = []
-    if supervisor_path is None:
-        supervisor_path = os.path.join(
-            os.path.dirname(os.path.abspath(events_path)),
-            "supervisor_events.jsonl")
-    if os.path.exists(supervisor_path):
-        for ev in telemetry.read_events(
-                supervisor_path, kind=telemetry.KIND_SUPERVISOR_ATTEMPT,
-                strict=False):
-            classifications.append(
-                str((ev.get("extra") or {}).get("classification", "unknown")))
-
+def _stitch_host(attempts: list[dict], classifications: list[str]) -> dict:
+    """Stitch ONE host's time-ordered attempts: sum buckets/counters,
+    classify the restart gaps between coverage windows, and close the
+    books so buckets (gaps included) sum to that host's measured span."""
     buckets: dict[str, float] = {}
     counters: dict[str, int] = {}
     gaps: list[dict] = []
@@ -280,9 +251,90 @@ def stitch_attempts(events_path: str,
         "counters": counters,
         "restart_gaps": gaps,
         "goodput_frac": (productive / span) if span > 0 else 0.0,
-        "supervisor_events": (supervisor_path
-                              if os.path.exists(supervisor_path) else None),
     }
+
+
+def stitch_attempts(events_path,
+                    supervisor_path: str | None = None) -> dict | None:
+    """Join per-attempt ``KIND_GOODPUT`` ledgers into one run table.
+
+    Each supervised attempt is a separate process with its own run_id
+    and ledger; its last (preferably final) goodput event covers the
+    interval ``[t0, t0 + wall_s]``. The wall between one attempt's
+    coverage end and the next attempt's ``t0`` is the ``restart_gap`` —
+    supervisor backoff + relaunch + the next process's pre-ledger
+    import time — classified, when ``supervisor_events.jsonl`` sits
+    next to the (first) events file, by the exit classification of the
+    attempt that ended each gap.
+
+    ``events_path`` may be a single path or a list of per-worker
+    streams from a gang run (``events.jsonl`` plus the non-chief
+    workers' ``events-p<i>.jsonl``). Snapshots are grouped by (run id,
+    ``process_id`` extra); with more than one host the result gains a
+    ``per_host`` section — one stitched table per process id, each
+    summing to its OWN measured span, all sharing the gang-level gap
+    classifications — while the top-level table stays the chief's
+    timeline (host 0), keeping the single-stream shape. Returns None
+    when no stream has goodput events (e.g. a serve log).
+    """
+    paths = [events_path] if isinstance(events_path, str) else list(events_path)
+    if not paths:
+        return None
+    by_key: dict[tuple[int, str], dict] = {}
+    for path in paths:
+        for ev in telemetry.read_events(
+                path, kind=telemetry.KIND_GOODPUT, strict=False):
+            extra = ev.get("extra") or {}
+            m = ev.get("metrics") or {}
+            host = int(extra.get("process_id") or 0)
+            snap = {
+                "run_id": ev.get("run_id"),
+                "process_id": host,
+                "t0": float(extra.get("t0") or ev.get("t") or 0.0),
+                "wall_s": float(m.get("wall_s") or 0.0),
+                "goodput_frac": m.get("goodput_frac"),
+                "buckets": dict(extra.get("buckets") or {}),
+                "counters": dict(extra.get("counters") or {}),
+                "final": bool(extra.get("final")),
+            }
+            key = (host, snap["run_id"])
+            prev = by_key.get(key)
+            if prev is None or not prev["final"] or snap["final"]:
+                by_key[key] = snap
+    if not by_key:
+        return None
+
+    classifications: list[str] = []
+    if supervisor_path is None:
+        supervisor_path = os.path.join(
+            os.path.dirname(os.path.abspath(paths[0])),
+            "supervisor_events.jsonl")
+    if os.path.exists(supervisor_path):
+        for ev in telemetry.read_events(
+                supervisor_path, kind=telemetry.KIND_SUPERVISOR_ATTEMPT,
+                strict=False):
+            classifications.append(
+                str((ev.get("extra") or {}).get("classification", "unknown")))
+
+    by_host: dict[int, list[dict]] = {}
+    for snap in by_key.values():
+        by_host.setdefault(snap["process_id"], []).append(snap)
+    stitched = {
+        host: _stitch_host(sorted(atts, key=lambda s: s["t0"]),
+                           classifications)
+        for host, atts in by_host.items()
+    }
+    # The chief's timeline is the run's timeline: its attempts bound the
+    # span the supervisor actually managed.
+    primary = stitched[min(stitched)]
+    out = dict(primary)
+    out["supervisor_events"] = (supervisor_path
+                                if os.path.exists(supervisor_path) else None)
+    if len(stitched) > 1:
+        out["per_host"] = {
+            str(host): stitched[host] for host in sorted(stitched)
+        }
+    return out
 
 
 def format_goodput_table(g: Mapping[str, Any]) -> str:
@@ -314,4 +366,14 @@ def format_goodput_table(g: Mapping[str, Any]) -> str:
         lines.append(
             f"  restart gap after attempt {gap['after_attempt']}: "
             f"{gap['seconds']:.1f} s ({gap['classification']})")
+    per_host = g.get("per_host") or {}
+    for host in sorted(per_host, key=lambda h: int(h)):
+        h = per_host[host]
+        hf = h.get("goodput_frac")
+        hg = sum(x["seconds"] for x in h.get("restart_gaps") or [])
+        lines.append(
+            f"  host {host}: {float(h.get('wall_s') or 0.0):.1f} s span, "
+            f"{100.0 * float(hf or 0.0):.1f}% goodput, "
+            f"{len(h.get('attempts') or [])} attempt(s), "
+            f"{hg:.1f} s restart gap")
     return "\n".join(lines)

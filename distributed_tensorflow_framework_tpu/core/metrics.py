@@ -38,8 +38,14 @@ def setup_logging(level: int = logging.INFO) -> None:
 class MetricWriter:
     """Console + optional TensorBoard + optional JSONL metric sink.
 
-    Only the chief process writes (reference contract: chief owns summaries,
-    SURVEY.md §2 row 10); non-chief construction yields a no-op writer.
+    Only the chief process writes console/TensorBoard summaries
+    (reference contract: chief owns summaries, SURVEY.md §2 row 10). In
+    a multi-process gang every worker additionally keeps a telemetry
+    stream of its own — the chief's at ``events.jsonl``, worker i's at
+    ``events-p<i>.jsonl`` — so per-host goodput/heartbeat evidence
+    survives a worker death and ``stitch_attempts`` can join them by
+    run id + process_id. Single-process non-chief construction stays a
+    full no-op writer.
     """
 
     def __init__(
@@ -49,13 +55,20 @@ class MetricWriter:
         is_chief: bool = True,
         jsonl: bool = True,
         run_id: str | None = None,
+        process_index: int = 0,
+        process_count: int = 1,
     ):
         self._enabled = is_chief
         self._tb = None
+        telemetry_path = None
+        if logdir and jsonl and (is_chief or process_count > 1):
+            name = ("events.jsonl" if is_chief
+                    else f"events-p{process_index}.jsonl")
+            telemetry_path = os.path.join(logdir, name)
         self.telemetry = telemetry.TelemetryWriter(
-            os.path.join(logdir, "events.jsonl") if (logdir and jsonl) else None,
+            telemetry_path,
             run_id=run_id,
-            is_chief=is_chief,
+            is_chief=is_chief or process_count > 1,
         )
         self.run_id = self.telemetry.run_id
         if not self._enabled:

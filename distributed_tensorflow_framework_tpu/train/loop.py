@@ -26,7 +26,7 @@ import jax
 
 from distributed_tensorflow_framework_tpu.core.config import ExperimentConfig
 from distributed_tensorflow_framework_tpu.core import (
-    faults, goodput, memstats, profiling, supervision, telemetry)
+    cluster, faults, goodput, memstats, profiling, supervision, telemetry)
 from distributed_tensorflow_framework_tpu.core.mesh import MeshRuntime, initialize_runtime
 from distributed_tensorflow_framework_tpu.core.metrics import MetricWriter, setup_logging
 from distributed_tensorflow_framework_tpu.data import get_dataset
@@ -89,6 +89,8 @@ class Trainer:
         self.writer = MetricWriter(
             logdir=(config.checkpoint.directory or None),
             is_chief=self.runtime.is_chief,
+            process_index=self.runtime.process_index,
+            process_count=self.runtime.process_count,
         )
         self.run_id = self.writer.run_id
         # In-process recovery ladder (train/anomaly.py): detect → rollback
@@ -108,7 +110,9 @@ class Trainer:
         self.goodput = goodput.GoodputLedger(
             self.writer.telemetry,
             interval_s=config.train.goodput_interval_s,
-            t0_perf=self._init_t)
+            t0_perf=self._init_t,
+            process_id=(self.runtime.process_index
+                        if self.runtime.process_count > 1 else None))
         self._startup_accounted = False
         # Periodic HBM sampling (core/memstats.py): device.memory_stats()
         # where the backend has it, host RSS where it doesn't.
@@ -143,6 +147,7 @@ class Trainer:
             global_batch_size=self.config.data.global_batch_size,
             mesh={k: int(v) for k, v in self.mesh.shape.items()},
             process_count=self.runtime.process_count,
+            process_index=self.runtime.process_index,
         )
         stages = int(getattr(self.config.model, "pipeline_stages", 0) or 0)
         if stages > 0:
@@ -276,9 +281,17 @@ class Trainer:
         hooks = [tp, hooks_lib.LoggingHook(self.writer, cfg.train.log_interval, tp)]
         if cfg.train.nan_guard:
             hooks.append(hooks_lib.NaNGuardHook())
-        if self.runtime.is_chief and cfg.checkpoint.directory:
+        if cfg.checkpoint.directory and (
+                self.runtime.is_chief or self.runtime.process_count > 1):
+            # Gang runs: EVERY worker beats its own heartbeat-p<i>.json so
+            # the cluster supervisor can tell a hung worker from a hung
+            # gang; single-process runs keep the legacy heartbeat.json.
             hooks.append(hooks_lib.HeartbeatHook(
-                os.path.join(cfg.checkpoint.directory, "heartbeat.json")
+                cluster.heartbeat_path(
+                    cfg.checkpoint.directory,
+                    self.runtime.process_index,
+                    self.runtime.process_count),
+                min_interval_s=cfg.cluster.heartbeat_interval_s,
             ))
         if cfg.model.num_experts > 0:
             hooks.append(hooks_lib.MoECollapseHook())
@@ -495,6 +508,20 @@ class Trainer:
             # may not include it — never return (and never let the CLI exit
             # rc 83) with a commit still in flight on the saver thread.
             self._ckpt_manager.wait_until_finished()
+            if (self.runtime.process_count > 1
+                    and self.config.checkpoint.directory):
+                # Coordinator-led exit barrier (core/cluster.py): the
+                # chief confirms its manifest commit record is durable and
+                # every survivor waits on the same record before returning
+                # — a worker that exits early tears down the jax.distributed
+                # coordinator and can strand its peers' in-flight commits.
+                cluster.exit_barrier(
+                    self.config.checkpoint.directory,
+                    step=self.host_step,
+                    timeout_s=self.config.cluster.exit_barrier_timeout_s,
+                    poll_s=self.config.cluster.exit_barrier_poll_s,
+                    is_chief=self.runtime.is_chief,
+                )
         # Finalize AFTER the exit barrier so the last ckpt_save's
         # blocked-ms lands in the rollup, not past it.
         self.goodput.finalize(step=self.host_step)

@@ -29,6 +29,14 @@ stitched from supervisor events (core/goodput.py). Supervisor events
 (``supervisor_events.jsonl`` next to it) are summarized too when
 present.
 
+Gang runs: a directory's ``events.jsonl`` + ``events-p<i>.jsonl``
+siblings (the per-worker streams a multi-process run writes) are ONE
+run — stitched together by run id + process_id into a single goodput
+ledger with a per-host section, restart gaps classified from the
+cluster supervisor's events. Multiple run-directory targets may be
+given (per-worker run dirs on separate hosts); they merge the same way
+and ``--json`` still emits ONE dtf-run-summary/1 object.
+
 In run-summary mode ``--json`` (bare, or ``--json -``) prints the whole
 summary as ONE machine-readable JSON object instead of the text tables
 — drivers parse that; ``--json PATH`` writes the object to PATH and
@@ -40,6 +48,7 @@ import argparse
 import json
 import os
 import pathlib
+import re
 import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
@@ -65,28 +74,65 @@ def _events_files(target: str) -> list[str]:
     return []
 
 
-def summarize_run(target: str, json_out: str | None = None) -> bool:
-    """Print run summaries for every events JSONL under ``target``; False
-    when there is none (caller falls through to trace analysis).
+# The per-worker telemetry streams of ONE gang run (core/metrics.py):
+# the chief's events.jsonl plus each non-chief worker's events-p<i>.jsonl.
+_GANG_STREAM_RE = re.compile(r"^events(-p\d+)?\.jsonl$")
+
+
+def _group_streams(paths: list[str]) -> list[list[str]]:
+    """Partition events files into run groups: every gang worker stream
+    (events.jsonl / events-p<i>.jsonl, across ALL targets) folds into one
+    group stitched by run id + process_id; anything else (e.g.
+    supervisor_events.jsonl) stays its own single-file summary."""
+    gang = [p for p in paths
+            if _GANG_STREAM_RE.match(os.path.basename(p))]
+    rest = [p for p in paths if p not in gang]
+    groups: list[list[str]] = []
+    if gang:
+        # Chief stream first: the group's headline summary and the
+        # stitched ledger's primary timeline both come from host 0.
+        gang.sort(key=lambda p: (os.path.basename(p) != "events.jsonl", p))
+        groups.append(gang)
+    groups.extend([p] for p in rest)
+    return groups
+
+
+def summarize_run(targets, json_out: str | None = None) -> bool:
+    """Print run summaries for every events JSONL under the target(s);
+    False when there is none (caller falls through to trace analysis).
 
     ``json_out``: "-" prints ONLY the machine-readable object; a path
     writes the object there and still prints the text tables.
     """
-    paths = _events_files(target)
+    if isinstance(targets, str):
+        targets = [targets]
+    paths: list[str] = []
+    for target in targets:
+        for path in _events_files(target):
+            if path not in paths:
+                paths.append(path)
     if not paths:
         return False
     runs = []
-    for path in paths:
-        summary = telemetry.summarize_events(path)
+    for group in _group_streams(paths):
+        summary = telemetry.summarize_events(group[0])
         # Cross-attempt stitch: per-attempt goodput rollups + restart
-        # gaps classified from supervisor_events.jsonl when present.
-        ledger = goodput.stitch_attempts(path)
-        runs.append((path, summary, ledger))
+        # gaps classified from supervisor_events.jsonl when present; a
+        # gang group stitches every worker stream into one per-host
+        # ledger keyed by run id + process_id.
+        ledger = goodput.stitch_attempts(
+            group if len(group) > 1 else group[0])
+        runs.append((group, summary, ledger))
     if json_out:
         obj: dict = {"schema": RUN_SUMMARY_SCHEMA}
-        docs = [{"events_path": p, **s,
-                 **({"goodput_ledger": g} if g else {})}
-                for p, s, g in runs]
+        docs = []
+        for group, s, g in runs:
+            doc = {"events_path": group[0], **s}
+            if len(group) > 1:
+                doc["worker_streams"] = group
+            if g:
+                doc["goodput_ledger"] = g
+            docs.append(doc)
         if len(docs) == 1:
             obj.update(docs[0])
         else:
@@ -97,7 +143,7 @@ def summarize_run(target: str, json_out: str | None = None) -> bool:
             return True
         with open(json_out, "w") as fh:
             fh.write(text + "\n")
-    for i, (path, summary, ledger) in enumerate(runs):
+    for i, (group, summary, ledger) in enumerate(runs):
         if i:
             print()
         print(telemetry.format_run_summary(summary))
@@ -108,7 +154,10 @@ def summarize_run(target: str, json_out: str | None = None) -> bool:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="*.xplane.pb file, or a directory to search")
+    ap.add_argument("trace", nargs="+",
+                    help="*.xplane.pb file, a run directory, or several "
+                         "per-worker run directories (merged into one "
+                         "summary)")
     ap.add_argument("--hlo", default=None,
                     help="optimized HLO text for scope attribution "
                          "(default: auto-discover near the trace)")
@@ -126,15 +175,17 @@ def main(argv=None) -> int:
 
     # events.jsonl → run summary (recovery activity); a run DIRECTORY gets
     # both the run summary and, below, its newest trace when one exists.
+    primary = args.trace[0]
     summarized = summarize_run(args.trace, json_out=args.json)
-    if summarized and (os.path.isfile(args.trace) or args.json == "-"):
+    if summarized and (len(args.trace) > 1 or os.path.isfile(primary)
+                       or args.json == "-"):
         return 0
 
-    traces = ta.find_xplane_files(args.trace)
+    traces = ta.find_xplane_files(primary)
     if not traces:
         if summarized:
             return 0
-        print(f"no *.xplane.pb under {args.trace!r}", file=sys.stderr)
+        print(f"no *.xplane.pb under {primary!r}", file=sys.stderr)
         return 2
     if summarized:
         print()

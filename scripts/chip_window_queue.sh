@@ -237,4 +237,46 @@ echo "--- [serve-fleet] drain rc=$? (0 = clean fleet drain)"
 run serve-fleet-slo python scripts/analyze_trace.py \
     /tmp/chipq_fleet/events.jsonl
 
+# 15. Two-host-sim gang A/B (ISSUE 15, docs/RESILIENCE.md "Gang
+#     supervision"): the same LeNet workload, same GLOBAL batch, as one
+#     process with 4 devices vs a 2-process jax.distributed gang with
+#     2 devices each through scripts/train_cluster.py — the DCN-path
+#     overhead (coordinator handshake, cross-process collectives, exit
+#     barrier) read off the two chiefs' step-time/goodput telemetry via
+#     the multi-dir analyze_trace join. Gated behind its own §0b-style
+#     preflight: cluster.probe_gang() is ONE cheap subprocess round-trip
+#     that detects backends whose compiler rejects multi-process
+#     programs (stock CPU jaxlib) — skip the section, don't burn the
+#     window on a gang that can never compile.
+if run gang-probe python -c "
+import sys
+from distributed_tensorflow_framework_tpu.core import cluster
+ok, detail = cluster.probe_gang(procs=2, devices_per_proc=2)
+if not ok:
+    print(detail[-800:], file=sys.stderr)
+sys.exit(0 if ok else 1)
+"; then
+  rm -rf /tmp/chipq_gang
+  run gang-1p python scripts/train_cluster.py \
+      --procs 1 --devices-per-proc 4 --workdir /tmp/chipq_gang/w1 \
+      --max-attempts 1 -- \
+      --config configs/lenet_mnist.yaml \
+      --set train.total_steps=200 --set train.log_interval=50 \
+      --set train.eval_steps=0 --set train.eval_interval=0 \
+      --set data.global_batch_size=32 --set mesh.data=-1 \
+      --set checkpoint.directory=/tmp/chipq_gang/ck1
+  run gang-2p python scripts/train_cluster.py \
+      --procs 2 --devices-per-proc 2 --workdir /tmp/chipq_gang/w2 \
+      --max-attempts 1 -- \
+      --config configs/lenet_mnist.yaml \
+      --set train.total_steps=200 --set train.log_interval=50 \
+      --set train.eval_steps=0 --set train.eval_interval=0 \
+      --set data.global_batch_size=32 --set mesh.data=-1 \
+      --set checkpoint.directory=/tmp/chipq_gang/ck2
+  run gang-ab python scripts/analyze_trace.py /tmp/chipq_gang/ck1
+  run gang-ab-2p python scripts/analyze_trace.py /tmp/chipq_gang/ck2
+else
+  echo "--- [gang-probe] backend cannot run multi-process gangs — skipping §15"
+fi
+
 echo "=== chip queue done $(date -u +%FT%TZ) ==="

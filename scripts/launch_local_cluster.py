@@ -18,7 +18,16 @@ Usage:
 
 Everything after ``--`` is passed to train.py verbatim. Exit status is
 non-zero if any worker fails; worker logs stream to
-``<workdir>/worker-<i>.log`` (default /tmp/dtf-local-cluster).
+``<workdir>/worker-<i>.log`` (default /tmp/dtf-local-cluster), and the
+first failing worker's log tail is echoed to the launcher's stderr so CI
+failures carry their own evidence. The free-port probe is inherently
+racy (another process can grab the port between probe and coordinator
+bind), so a gang whose chief dies at boot with a bind error is relaunched
+on a fresh port up to ``--port-retries`` times.
+
+The per-worker environment contract lives in ``core.cluster.worker_env``
+(shared with scripts/train_cluster.py, the supervised flavor of this
+launcher).
 """
 
 from __future__ import annotations
@@ -30,6 +39,23 @@ import subprocess
 import sys
 import time
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from distributed_tensorflow_framework_tpu.core import cluster  # noqa: E402
+
+# SIGTERM → SIGKILL escalation budget, and the coordinator-bind failure
+# signatures the port-retry path matches against a dead chief's log tail.
+GRACE_S = 10.0
+PORT_RETRIES = 3
+BIND_FAILURE_SIGNS = (
+    "address already in use",
+    "failed to bind",
+    "bind failed",
+    "errno 98",
+)
+
 
 def free_port() -> int:
     with socket.socket() as s:
@@ -37,103 +63,181 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def main(argv: list[str] | None = None) -> int:
+def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--procs", type=int, default=2)
     p.add_argument("--devices-per-proc", type=int, default=2)
     p.add_argument("--workdir", default="/tmp/dtf-local-cluster")
+    p.add_argument("--port-retries", type=int, default=PORT_RETRIES,
+                   help="relaunch attempts when the coordinator loses the "
+                        "free-port bind race (1 = no retry)")
     p.add_argument("train_args", nargs=argparse.REMAINDER,
                    help="arguments for train.py (prefix with --)")
     args = p.parse_args(argv)
-    train_args = args.train_args
-    if train_args and train_args[0] == "--":
-        train_args = train_args[1:]
-    if not train_args:
+    if args.train_args and args.train_args[0] == "--":
+        args.train_args = args.train_args[1:]
+    if not args.train_args:
         p.error("pass train.py arguments after --")
+    if args.procs < 1:
+        p.error("--procs must be >= 1")
+    return args
 
-    os.makedirs(args.workdir, exist_ok=True)
-    port = free_port()
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    procs, logs = [], []
-    for i in range(args.procs):
-        env = dict(os.environ)
-        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
-        env["JAX_NUM_PROCESSES"] = str(args.procs)
-        env["JAX_PROCESS_ID"] = str(i)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = (
-            env.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.devices_per_proc}"
-        ).strip()
-        log = open(os.path.join(args.workdir, f"worker-{i}.log"), "w")
-        logs.append(log)
-        procs.append(subprocess.Popen(
-            [sys.executable, os.path.join(repo, "train.py"), *train_args],
-            env=env, cwd=repo, stdout=log, stderr=subprocess.STDOUT))
-    print(f"launched {args.procs} workers (coordinator 127.0.0.1:{port}); "
-          f"logs in {args.workdir}/worker-*.log", file=sys.stderr)
 
-    # Poll ALL workers: a crashed peer leaves the others blocked in a
-    # collective forever, so on the first nonzero exit the rest are
-    # terminated — the launcher must surface the failure, not hang on
-    # procs[0].wait().
-    rc = 0
-    grace = 10.0  # seconds between SIGTERM and SIGKILL escalation
+def log_path(workdir: str, worker: int) -> str:
+    return os.path.join(workdir, f"worker-{worker}.log")
+
+
+def log_tail(path: str, max_bytes: int = 4096) -> str:
+    """Last ``max_bytes`` of a worker log ('' when unreadable)."""
     try:
-        live = dict(enumerate(procs))
-        killed: dict[int, float] = {}  # worker → time SIGTERM was sent
-        while live:
-            now = time.monotonic()
-            for i, proc in list(live.items()):
-                r = proc.poll()
-                if r is None:
-                    # A worker blocked inside a native collective can
-                    # ignore SIGTERM indefinitely — escalate to SIGKILL
-                    # after the grace period so the launcher never hangs.
-                    if i in killed and now - killed[i] > grace:
-                        proc.kill()
-                        killed[i] = float("inf")  # kill once
-                    continue
-                del live[i]
-                if r != 0 and i not in killed:
-                    # Peers terminated below exit nonzero too — only the
-                    # first real failure is the root cause worth naming.
-                    print(f"worker {i} exited {r} — see "
-                          f"{args.workdir}/worker-{i}.log", file=sys.stderr)
-                    rc = rc or r
-                    for j, p in live.items():
-                        killed[j] = now
-                        p.terminate()
-            if live:
-                time.sleep(0.2)
-    except KeyboardInterrupt:
-        rc = 130
-        for proc in procs:
-            proc.terminate()
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - max_bytes))
+            return fh.read().decode("utf-8", errors="replace")
+    except OSError:
+        return ""
+
+
+def is_bind_failure(log_text: str) -> bool:
+    """Does a worker's log tail look like the coordinator bind race?"""
+    lowered = log_text.lower()
+    return any(sign in lowered for sign in BIND_FAILURE_SIGNS)
+
+
+def spawn_gang(
+    train_args: list[str],
+    *,
+    procs: int,
+    devices_per_proc: int,
+    workdir: str,
+    port: int,
+    base_env: dict | None = None,
+) -> tuple[list[subprocess.Popen], list]:
+    """Spawn the N workers of one gang; returns (processes, log handles).
+
+    ``base_env`` defaults to ``os.environ``; scripts/train_cluster.py
+    passes its relaunch env (fast-fail XLA flags, elastic overrides)
+    through here so the supervised gang uses the exact same discovery
+    path as the bare launcher.
+    """
+    os.makedirs(workdir, exist_ok=True)
+    children, logs = [], []
+    for i in range(procs):
+        env = cluster.worker_env(
+            dict(os.environ if base_env is None else base_env),
+            coordinator_port=port,
+            num_processes=procs,
+            process_id=i,
+            devices_per_proc=devices_per_proc,
+        )
+        log = open(log_path(workdir, i), "w")
+        logs.append(log)
+        children.append(subprocess.Popen(
+            [sys.executable, os.path.join(_REPO, "train.py"), *train_args],
+            env=env, cwd=_REPO, stdout=log, stderr=subprocess.STDOUT))
+    return children, logs
+
+
+def _report_failure(workdir: str, worker: int, rc: int) -> None:
+    path = log_path(workdir, worker)
+    print(f"worker {worker} exited {rc} — log tail ({path}):",
+          file=sys.stderr)
+    tail = log_tail(path)
+    for line in tail.splitlines()[-25:]:
+        print(f"    {line}", file=sys.stderr)
+
+
+def _wait_gang(procs: list[subprocess.Popen],
+               workdir: str) -> tuple[int, int | None]:
+    """Poll ALL workers until exit; returns (rc, first failing worker).
+
+    A crashed peer leaves the others blocked in a collective forever, so
+    on the first nonzero exit the rest are terminated — the launcher must
+    surface the failure, not hang on ``procs[0].wait()``. Workers that
+    ignore SIGTERM (blocked inside a native collective) are SIGKILLed
+    after the grace period.
+    """
+    rc, failed = 0, None
+    live = dict(enumerate(procs))
+    killed: dict[int, float] = {}  # worker → time SIGTERM was sent
+    while live:
+        now = time.monotonic()
+        for i, proc in list(live.items()):
+            r = proc.poll()
+            if r is None:
+                if i in killed and now - killed[i] > GRACE_S:
+                    proc.kill()
+                    killed[i] = float("inf")  # kill once
+                continue
+            del live[i]
+            if r != 0 and i not in killed:
+                # Peers terminated below exit nonzero too — only the
+                # first real failure is the root cause worth naming.
+                rc, failed = (rc or r), (failed if failed is not None else i)
+                for j, p in live.items():
+                    killed[j] = now
+                    p.terminate()
+        if live:
+            time.sleep(0.2)
+    return rc, failed
+
+
+def _reap(procs: list[subprocess.Popen], logs: list) -> None:
+    for proc in procs:
+        if proc.poll() is None:
+            try:
+                proc.wait(timeout=GRACE_S)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        proc.wait()
+    for log in logs:
+        log.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = parse_args(argv)
+    retries = max(1, args.port_retries)
+    for attempt in range(1, retries + 1):
+        port = free_port()
+        procs, logs = spawn_gang(
+            args.train_args, procs=args.procs,
+            devices_per_proc=args.devices_per_proc,
+            workdir=args.workdir, port=port)
+        print(f"launched {args.procs} workers (coordinator 127.0.0.1:{port}); "
+              f"logs in {args.workdir}/worker-*.log", file=sys.stderr)
         try:
-            deadline = time.monotonic() + grace
-            for proc in procs:
-                try:
-                    proc.wait(timeout=max(0.1, deadline - time.monotonic()))
-                except subprocess.TimeoutExpired:
-                    proc.kill()
+            rc, failed = _wait_gang(procs, args.workdir)
         except KeyboardInterrupt:
-            # Second Ctrl-C: stop waiting politely, SIGKILL everything;
-            # the finally block reaps.
             for proc in procs:
-                if proc.poll() is None:
-                    proc.kill()
-    finally:
-        # Reap everything — no orphaned children past this point.
-        for proc in procs:
-            if proc.poll() is None:
-                try:
-                    proc.wait(timeout=grace)
-                except subprocess.TimeoutExpired:
-                    proc.kill()
-            proc.wait()
-        for log in logs:
-            log.close()
+                proc.terminate()
+            try:
+                deadline = time.monotonic() + GRACE_S
+                for proc in procs:
+                    try:
+                        proc.wait(
+                            timeout=max(0.1, deadline - time.monotonic()))
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+            except KeyboardInterrupt:
+                # Second Ctrl-C: stop waiting politely, SIGKILL everything;
+                # _reap below collects the corpses.
+                for proc in procs:
+                    if proc.poll() is None:
+                        proc.kill()
+            return 130
+        finally:
+            _reap(procs, logs)
+        if rc == 0 or failed is None:
+            return rc
+        if (attempt < retries
+                and is_bind_failure(log_tail(log_path(args.workdir, failed)))):
+            print(f"worker {failed} lost the port-bind race on "
+                  f"127.0.0.1:{port} — relaunching the gang on a fresh "
+                  f"port (attempt {attempt + 1}/{retries})", file=sys.stderr)
+            continue
+        _report_failure(args.workdir, failed, rc)
+        return rc
     return rc
 
 

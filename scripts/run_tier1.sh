@@ -27,7 +27,11 @@ echo "=== tier-1 pytest (log → $ART/pytest.log) ==="
 # DTF_SERVE_BENCH_DIR: when a slow run includes the fleet chaos drill
 # (tests/test_fleet_drill.py), its dtf-serve-bench/2 JSON lands here
 # next to the other artifacts instead of dying with pytest's tmpdir.
+# DTF_GANG_DRILL_DIR: same contract for the gang chaos drills
+# (tests/test_cluster_drill.py) — their supervisor_events.jsonl is the
+# attempt-by-attempt record of the coordinated restart / gang refit.
 timeout -k 10 870 env JAX_PLATFORMS=cpu DTF_SERVE_BENCH_DIR="$ART" \
+    DTF_GANG_DRILL_DIR="$ART" \
     python -m pytest tests/ -q \
     -m "$MARKERS" --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
@@ -36,6 +40,9 @@ py_rc=${PIPESTATUS[0]}
 
 if [ -f "$ART/SERVE_BENCH_FLEET.json" ]; then
   echo "=== serve bench archived: $ART/SERVE_BENCH_FLEET.json ==="
+fi
+if [ -f "$ART/GANG_DRILL_EVENTS.jsonl" ]; then
+  echo "=== gang drill events archived: $ART/GANG_DRILL_EVENTS.jsonl ==="
 fi
 
 echo "=== tier-1 summary: graftcheck rc=$gc_rc pytest rc=$py_rc ==="

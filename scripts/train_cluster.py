@@ -1,0 +1,683 @@
+#!/usr/bin/env python
+"""Gang supervisor: cluster-level fault tolerance for the N-process runtime.
+
+``jax.distributed`` gangs fail as a unit — one worker crash or hang wedges
+every collective in the job — so scripts/train_resilient.py's per-process
+ladder is not enough at pod scale. This supervisor owns the WHOLE gang:
+
+    python scripts/train_cluster.py --procs 2 --devices-per-proc 2 \\
+        --heartbeat-timeout 60 --workdir /tmp/dtf-gang -- \\
+        --config configs/lenet_mnist.yaml \\
+        --set checkpoint.directory=/tmp/dtf-gang/ck
+
+Everything after ``--`` is passed to train.py verbatim; the N workers are
+launched through the same ``launch_local_cluster`` discovery path
+(JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID) the bare
+launcher uses. Behavior ladder (docs/RESILIENCE.md "Gang supervision"):
+
+  * Coordinated gang restart: on ANY worker's crash or stale heartbeat
+    (every worker beats its own ``heartbeat-p<i>.json``, pid-scoped), the
+    survivors get SIGTERM — the chief finishes its in-flight step and
+    force-saves through the graceful-preemption contract (rc 83) — then
+    the whole gang is relaunched with shared exponential backoff.
+  * Crash-loop breaker keyed on (worker, failure signature): worker 3
+    segfaulting at the same step trips ITS breaker after
+    ``--crash-loop-threshold`` identical no-progress repeats, while one
+    flaky host's noise cannot burn the shared attempt budget
+    (core/cluster.py GangBreaker).
+  * Gang-level rc-84: a worker dropped permanently (``drop_worker``
+    chaos, or no heartbeat within ``cluster.rejoin_timeout_s`` while its
+    peers rejoined) shrinks the gang — the mesh is refit to the
+    surviving process count (fit_axis_sizes), batch/grad-accum rescaled
+    so the EFFECTIVE batch is preserved (rescale_for_devices), and the
+    smaller gang relaunched WITHOUT consuming an attempt, bounded by
+    ``--max-reshards``. The refit reaches the children via
+    DTF_ELASTIC_OVERRIDES, exactly like the single-process ladder.
+  * Graceful preemption (first exit rc 83 that the supervisor did not
+    itself cause) and operator cancellation (130/143, or a signal sent
+    to the supervisor and forwarded to the gang) keep their
+    train_resilient.py semantics.
+  * Cluster chaos (core/faults.py): ``kill_worker:W[:T]``,
+    ``stall_worker:W:S`` (SIGSTOP/SIGCONT) and ``drop_worker:W[:T]``
+    fire at the supervisor's ``gang_chaos`` point on a 1-based tick
+    clock that starts once EVERY worker has heartbeated.
+  * Every attempt lands in ``<ckpt_dir>/supervisor_events.jsonl`` tagged
+    with the ``process_id`` the failure was attributed to, so
+    stitch_attempts / analyze_trace.py classify gang restart gaps per
+    host.
+
+Single-threaded by design: one poll loop owns the children, the
+heartbeat/rejoin watchdogs, the chaos tick clock and the SIGTERM→SIGKILL
+escalation — no supervisor threads to leak or deadlock.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import pathlib
+import re
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from distributed_tensorflow_framework_tpu.core import (  # noqa: E402
+    cluster,
+    faults,
+    supervision,
+    telemetry,
+)
+from scripts import launch_local_cluster as llc  # noqa: E402
+from scripts.train_resilient import (  # noqa: E402
+    _fmt_axes,
+    build_env,
+    find_checkpoint_dir,
+    latest_committed_step,
+    parse_training_params,
+)
+
+
+def parse_rejoin_timeout(cmd: list[str]) -> float:
+    """The child-visible ``cluster.rejoin_timeout_s`` knob, recovered the
+    same way parse_training_params recovers mesh sizes: --config YAML
+    first, then any ``--set cluster.rejoin_timeout_s=`` override in the
+    raw command text (last occurrence wins)."""
+    value = 0.0
+    config_path = None
+    for i, tok in enumerate(cmd):
+        if tok == "--config" and i + 1 < len(cmd):
+            config_path = cmd[i + 1]
+        elif tok.startswith("--config="):
+            config_path = tok.split("=", 1)[1]
+    if config_path:
+        try:
+            import yaml
+
+            with open(config_path) as fh:
+                doc = yaml.safe_load(fh) or {}
+            value = float((doc.get("cluster") or {}).get(
+                "rejoin_timeout_s", value))
+        except Exception:
+            pass
+    for m in re.finditer(r"cluster\.rejoin_timeout_s=([0-9.]+)",
+                         " ".join(cmd)):
+        value = float(m.group(1))
+    return value
+
+
+# -- cancellation forwarding ----------------------------------------------
+_children: dict[int, subprocess.Popen] = {}
+_cancelled = False
+
+
+def _forward_signal(signum, frame):
+    global _cancelled
+    _cancelled = True
+    for child in _children.values():
+        if child.poll() is None:
+            child.send_signal(signum)
+
+
+@dataclasses.dataclass
+class GangResult:
+    """One gang attempt's post-mortem, as the poll loop observed it."""
+
+    rcs: dict[int, int]             # worker → normalized exit code
+    pids: dict[int, int]            # worker → child pid (heartbeat scoping)
+    first_worker: int | None = None  # root-cause worker (first nonzero exit)
+    first_rc: int = 0
+    hung: set[int] = dataclasses.field(default_factory=set)
+    dropped: set[int] = dataclasses.field(default_factory=set)
+
+    @property
+    def done(self) -> bool:
+        return all(rc == 0 for rc in self.rcs.values())
+
+
+def _run_gang_attempt(
+    train_args: list[str],
+    env: dict,
+    *,
+    procs: int,
+    devices_per_proc: int,
+    workdir: str,
+    ckpt_dir: str | None,
+    hb_timeout: float,
+    hb_poll: float,
+    startup_grace: float,
+    rejoin_timeout_s: float,
+    chaos_tick_s: float,
+    grace: float = 10.0,
+) -> GangResult:
+    """Launch one gang and watch it to collective exit.
+
+    The loop owns four clocks: per-worker heartbeat staleness (pid-scoped
+    against THIS attempt's children), the pre-admission rejoin watchdog,
+    the chaos tick (starting once every worker has beaten), and the
+    SIGTERM→SIGKILL escalation once a shutdown begins. The first nonzero
+    exit is the root cause; everything after it (peers SIGTERMed by us
+    exiting 83, SIGKILL escalations) is fallout.
+    """
+    global _children
+    port = llc.free_port()
+    children, logs = llc.spawn_gang(
+        train_args, procs=procs, devices_per_proc=devices_per_proc,
+        workdir=workdir, port=port, base_env=env)
+    live = dict(enumerate(children))
+    _children = dict(live)
+    result = GangResult(
+        rcs={}, pids={w: p.pid for w, p in live.items()})
+    hb_paths = {
+        w: (cluster.heartbeat_path(ckpt_dir, w, procs) if ckpt_dir else None)
+        for w in live
+    }
+    print(f"train_cluster: launched gang of {procs} "
+          f"(coordinator 127.0.0.1:{port}); logs in {workdir}/worker-*.log",
+          file=sys.stderr)
+
+    start = time.monotonic()
+    admitted: float | None = None
+    tick = 0
+    stalled: dict[int, float] = {}   # worker → monotonic SIGCONT deadline
+    shutting_down = False
+    term_at = 0.0
+    killed: set[int] = set()
+
+    def _begin_shutdown(now: float) -> None:
+        nonlocal shutting_down, term_at
+        if shutting_down:
+            return
+        shutting_down = True
+        term_at = now
+        for w, deadline in list(stalled.items()):
+            # A SIGSTOPped worker cannot honor SIGTERM — wake it first.
+            proc = live.get(w)
+            if proc is not None and proc.poll() is None:
+                proc.send_signal(signal.SIGCONT)
+            del stalled[w]
+        for w, proc in live.items():
+            if proc.poll() is None:
+                proc.terminate()
+
+    try:
+        while live:
+            now = time.monotonic()
+            for w, proc in list(live.items()):
+                r = proc.poll()
+                if r is None:
+                    if shutting_down and now - term_at > grace \
+                            and w not in killed:
+                        proc.kill()
+                        killed.add(w)
+                    continue
+                del live[w]
+                if r < 0:
+                    r = 128 - r  # shell convention: 128 + signal
+                result.rcs[w] = r
+                if r != 0 and result.first_worker is None:
+                    result.first_worker, result.first_rc = w, r
+                if r != 0:
+                    # One worker down kills every collective — SIGTERM
+                    # the survivors so the chief force-saves (rc 83)
+                    # instead of timing out inside a dead rendezvous.
+                    _begin_shutdown(now)
+            if not live:
+                break
+            if not shutting_down:
+                ages = {
+                    w: (supervision.heartbeat_age_s(hb_paths[w],
+                                                    pid=proc.pid)
+                        if hb_paths[w] else None)
+                    for w, proc in live.items()
+                }
+                if hb_timeout > 0 or startup_grace > 0:
+                    for w, proc in list(live.items()):
+                        age = ages.get(w)
+                        stale = (hb_timeout > 0 and age is not None
+                                 and age > hb_timeout)
+                        no_start = (startup_grace > 0 and age is None
+                                    and now - start > startup_grace)
+                        if stale or no_start:
+                            why = (f"heartbeat stale ({age:.0f}s > "
+                                   f"{hb_timeout:.0f}s budget)" if stale
+                                   else f"no heartbeat within "
+                                        f"{startup_grace:.0f}s startup grace")
+                            print(f"train_cluster: worker {w} {why} — "
+                                  f"killing pid={proc.pid}", file=sys.stderr)
+                            result.hung.add(w)
+                            if proc.poll() is None:
+                                proc.send_signal(signal.SIGCONT)
+                                proc.kill()
+                            stalled.pop(w, None)
+                if admitted is None:
+                    overdue = cluster.decide_rejoin(
+                        ages, elapsed_s=now - start,
+                        rejoin_timeout_s=rejoin_timeout_s)
+                    for w in overdue:
+                        print(f"train_cluster: worker {w} failed to rejoin "
+                              f"within {rejoin_timeout_s:.0f}s — dropping "
+                              f"it from the gang", file=sys.stderr)
+                        result.dropped.add(w)
+                        proc = live.get(w)
+                        if proc is not None and proc.poll() is None:
+                            proc.kill()
+                    if live and all(ages.get(w) is not None for w in live):
+                        admitted = now  # chaos clock starts at readiness
+                if admitted is not None and chaos_tick_s > 0:
+                    while admitted + (tick + 1) * chaos_tick_s <= now:
+                        tick += 1
+                        for fault in faults.fire("gang_chaos", step=tick):
+                            w = fault.worker
+                            proc = live.get(w) if w is not None else None
+                            if proc is None or proc.poll() is not None:
+                                print(f"train_cluster: {fault.fault_id} "
+                                      f"targets worker {w}, which is not "
+                                      f"live — ignored", file=sys.stderr)
+                                continue
+                            if fault.kind == "kill_worker":
+                                print(f"train_cluster: chaos SIGKILL worker "
+                                      f"{w} (tick {tick})", file=sys.stderr)
+                                proc.kill()
+                            elif fault.kind == "drop_worker":
+                                print(f"train_cluster: chaos DROP worker "
+                                      f"{w} permanently (tick {tick})",
+                                      file=sys.stderr)
+                                result.dropped.add(w)
+                                proc.kill()
+                            elif fault.kind == "stall_worker":
+                                print(f"train_cluster: chaos SIGSTOP worker "
+                                      f"{w} for {fault.seconds:.0f}s "
+                                      f"(tick {tick})", file=sys.stderr)
+                                proc.send_signal(signal.SIGSTOP)
+                                stalled[w] = now + (fault.seconds or 0.0)
+                for w, resume_at in list(stalled.items()):
+                    if now >= resume_at:
+                        proc = live.get(w)
+                        if proc is not None and proc.poll() is None:
+                            print(f"train_cluster: chaos SIGCONT worker {w}",
+                                  file=sys.stderr)
+                            proc.send_signal(signal.SIGCONT)
+                        del stalled[w]
+            time.sleep(min(0.2, hb_poll))
+    finally:
+        for proc in children:
+            if proc.poll() is None:
+                try:
+                    proc.wait(timeout=grace)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            proc.wait()
+        for log in logs:
+            log.close()
+        _children = {}
+    for w, proc in enumerate(children):
+        result.rcs.setdefault(w, 0 if proc.returncode == 0
+                              else abs(proc.returncode))
+    return result
+
+
+def main(argv=None) -> int:
+    global _cancelled
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--procs", type=int, default=2)
+    parser.add_argument("--devices-per-proc", type=int, default=2)
+    parser.add_argument("--workdir", default="/tmp/dtf-gang",
+                        help="worker log directory")
+    parser.add_argument("--max-attempts", type=int, default=10)
+    parser.add_argument("--retry-sleep", type=float, default=5.0,
+                        help="backoff BASE seconds (doubles per consecutive "
+                             "failure, jittered)")
+    parser.add_argument("--backoff-max", type=float, default=120.0)
+    parser.add_argument("--jitter", type=float, default=0.5)
+    parser.add_argument("--heartbeat-timeout", type=float, default=0.0,
+                        help="kill a worker whose heartbeat-p<i>.json is "
+                             "older than this many seconds and restart the "
+                             "gang (0 disables)")
+    parser.add_argument("--heartbeat-poll", type=float, default=2.0)
+    parser.add_argument("--startup-grace", type=float, default=0.0,
+                        help="kill a worker with NO heartbeat this many "
+                             "seconds after launch (0 disables; compile "
+                             "time counts against it)")
+    parser.add_argument("--rejoin-timeout", type=float, default=None,
+                        help="drop a worker that fails to rejoin within "
+                             "this many seconds while peers did, and refit "
+                             "the gang (default: the command's "
+                             "cluster.rejoin_timeout_s knob; 0 disables)")
+    parser.add_argument("--chaos-tick", type=float, default=1.0,
+                        help="gang_chaos fault-point tick period in "
+                             "seconds (0 disables the chaos clock)")
+    parser.add_argument("--crash-loop-threshold", type=int, default=3)
+    parser.add_argument("--max-preemptions", type=int, default=50)
+    parser.add_argument("--max-reshards", type=int, default=8,
+                        help="safety bound on gang refits + child-led "
+                             "elastic reshards (they never consume "
+                             "attempts)")
+    parser.add_argument("--events", default=None,
+                        help="supervisor telemetry JSONL (default: "
+                             "<checkpoint.directory>/supervisor_events"
+                             ".jsonl; '-' disables)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="train.py arguments after --")
+    args = parser.parse_args(argv)
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no train.py arguments given (put them after `--`)")
+    if args.procs < 1:
+        parser.error("--procs must be >= 1")
+    if args.max_attempts < 1:
+        parser.error("--max-attempts must be >= 1")
+
+    ckpt_dir, ckpt_enabled = find_checkpoint_dir(cmd)
+    if not ckpt_enabled:
+        print("train_cluster: WARNING — no checkpoint.directory in the "
+              "command; every gang restart will lose all progress AND the "
+              "per-worker heartbeat/rejoin watchdogs are blind",
+              file=sys.stderr)
+    rejoin_timeout = (args.rejoin_timeout if args.rejoin_timeout is not None
+                      else parse_rejoin_timeout(cmd))
+
+    events_path = args.events
+    if events_path is None and ckpt_dir:
+        events_path = os.path.join(ckpt_dir, "supervisor_events.jsonl")
+    writer = telemetry.TelemetryWriter(
+        None if events_path in (None, "-") else events_path)
+    writer.emit_run_meta(
+        argv=[sys.argv[0]], supervisor=True, gang=True,
+        command=" ".join(cmd), procs=args.procs,
+        devices_per_proc=args.devices_per_proc,
+        max_attempts=args.max_attempts,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        rejoin_timeout_s=rejoin_timeout,
+        checkpoint_dir=ckpt_dir or "",
+    )
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _forward_signal)
+        except (ValueError, OSError):  # non-main thread (tests importing us)
+            pass
+
+    env = build_env()
+    breaker = cluster.GangBreaker(args.crash_loop_threshold)
+    cur_sizes, cur_batch, cur_accum = parse_training_params(cmd)
+    active = args.procs
+    rc = 1
+    attempt = failures = preemptions = reshards = 0
+    while attempt < args.max_attempts:
+        attempt += 1
+        print(f"train_cluster: attempt {attempt}/{args.max_attempts} "
+              f"(gang of {active})", file=sys.stderr)
+        res = _run_gang_attempt(
+            cmd, env, procs=active,
+            devices_per_proc=args.devices_per_proc,
+            workdir=args.workdir, ckpt_dir=ckpt_dir,
+            hb_timeout=args.heartbeat_timeout,
+            hb_poll=args.heartbeat_poll,
+            startup_grace=args.startup_grace,
+            rejoin_timeout_s=rejoin_timeout,
+            chaos_tick_s=args.chaos_tick)
+        rc = res.first_rc or 0
+        worker = res.first_worker
+        # Progress accounting: the failing worker's own heartbeat,
+        # pid-scoped to THIS attempt's child so a predecessor's record
+        # cannot fake forward progress.
+        last_step = None
+        if worker is not None and ckpt_dir:
+            hb = supervision.read_heartbeat(
+                cluster.heartbeat_path(ckpt_dir, worker, active))
+            if hb and hb.get("pid") in (None, res.pids.get(worker)):
+                last_step = hb.get("last_completed_step", hb.get("step"))
+        ckpt_step = latest_committed_step(ckpt_dir) if ckpt_dir else None
+
+        if res.done:
+            print(f"train_cluster: done (attempt {attempt})", file=sys.stderr)
+            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                        attempt=attempt, rc=0, classification="done",
+                        process_id=0, process_count=active,
+                        last_step=last_step, ckpt_step=ckpt_step)
+            return 0
+        hung = worker in res.hung
+        if _cancelled or rc in (130, 143):
+            print(f"train_cluster: gang cancelled (rc={rc}) — not retrying",
+                  file=sys.stderr)
+            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                        attempt=attempt, rc=rc, classification="cancelled",
+                        process_id=worker, process_count=active,
+                        last_step=last_step, ckpt_step=ckpt_step)
+            return rc
+
+        if res.dropped:
+            # Permanent worker loss (drop_worker chaos or rejoin timeout):
+            # the gang-level rc-84 path. Refit the mesh to the survivors
+            # and relaunch smaller — topology change, not failure, so no
+            # attempt is consumed and the breaker streak never feeds.
+            survivors = active - len(res.dropped)
+            reshards += 1
+            attempt -= 1
+            for w in res.dropped:
+                breaker.record(w, rc=rc, last_step=last_step,
+                               ckpt_step=ckpt_step, transient=True)
+            if survivors < 1:
+                print("train_cluster: every worker dropped — giving up",
+                      file=sys.stderr)
+                return rc or 1
+            try:
+                refit = cluster.decide_refit(
+                    cur_sizes, cur_batch, cur_accum,
+                    process_count=survivors,
+                    devices_per_proc=args.devices_per_proc)
+            except cluster.ClusterSpecError as e:
+                print(f"train_cluster: {e} — giving up", file=sys.stderr)
+                return rc or 1
+            if not refit.batch_preserved:
+                print("train_cluster: WARNING — could not preserve the "
+                      f"effective batch across {_fmt_axes(cur_sizes)} -> "
+                      f"{_fmt_axes(refit.sizes)}", file=sys.stderr)
+            env[supervision.ELASTIC_OVERRIDES_ENV] = ",".join(refit.overrides)
+            print(f"train_cluster: gang refit #{reshards} — workers "
+                  f"{sorted(res.dropped)} lost, {active} -> {survivors} "
+                  f"processes ({refit.n_devices} devices), mesh "
+                  f"{_fmt_axes(cur_sizes)} -> {_fmt_axes(refit.sizes)}, "
+                  f"global_batch {cur_batch} -> {refit.global_batch}, "
+                  f"grad_accum {cur_accum} -> {refit.grad_accum} — "
+                  "relaunching immediately", file=sys.stderr)
+            writer.emit(telemetry.KIND_MESH_RESIZED,
+                        attempt=attempt + 1, rc=rc, reshards=reshards,
+                        from_axes=dict(cur_sizes), to_axes=dict(refit.sizes),
+                        visible_devices=refit.n_devices,
+                        process_count=survivors,
+                        dropped_workers=sorted(res.dropped),
+                        global_batch=refit.global_batch,
+                        grad_accum=refit.grad_accum,
+                        effective_batch_preserved=refit.batch_preserved,
+                        overrides=" ".join(refit.overrides),
+                        last_step=last_step, ckpt_step=ckpt_step)
+            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                        attempt=attempt + 1, rc=rc,
+                        classification="gang_refit", reshards=reshards,
+                        process_id=worker, process_count=survivors,
+                        last_step=last_step, ckpt_step=ckpt_step)
+            cur_sizes, cur_batch, cur_accum = (
+                refit.sizes, refit.global_batch, refit.grad_accum)
+            active = survivors
+            if reshards >= args.max_reshards:
+                print("train_cluster: topology churn exceeded "
+                      f"--max-reshards={args.max_reshards} — giving up",
+                      file=sys.stderr)
+                return rc
+            continue
+
+        if rc == supervision.GRACEFUL_PREEMPT_RC:
+            # The FIRST exit was already rc 83 — the whole gang was
+            # preempted externally (our own coordinated shutdown only
+            # SIGTERMs peers AFTER a nonzero root cause, so it cannot
+            # produce an 83-first gang).
+            preemptions += 1
+            attempt -= 1
+            print(f"train_cluster: gang preempted (rc={rc}, "
+                  f"#{preemptions}) — relaunching immediately",
+                  file=sys.stderr)
+            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                        attempt=attempt + 1, rc=rc,
+                        classification="preempted", preemptions=preemptions,
+                        process_id=worker, process_count=active,
+                        last_step=last_step, ckpt_step=ckpt_step)
+            if preemptions >= args.max_preemptions:
+                print("train_cluster: preemption churn exceeded "
+                      f"--max-preemptions={args.max_preemptions} — giving "
+                      "up", file=sys.stderr)
+                return rc
+            continue
+
+        if rc == supervision.ELASTIC_RESHARD_RC:
+            # A child could not build its mesh on the devices it saw
+            # (child-led elastic, e.g. a drop_devices drill inside the
+            # gang). Refit over the reported device set at the SAME
+            # process count; the gang-shrink path above handles lost
+            # workers.
+            report = supervision.read_device_report(ckpt_dir) \
+                if ckpt_dir else None
+            visible = (report or {}).get("visible_devices")
+            if not visible:
+                failures += 1
+                print(f"train_cluster: attempt {attempt} exited rc={rc} "
+                      "(elastic) but left no device report — treating as "
+                      "a plain failure", file=sys.stderr)
+                writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                            attempt=attempt, rc=rc,
+                            classification="elastic_no_report",
+                            process_id=worker, process_count=active,
+                            last_step=last_step, ckpt_step=ckpt_step)
+                if worker is not None and breaker.record(
+                        worker, rc=rc, last_step=last_step,
+                        ckpt_step=ckpt_step):
+                    print("train_cluster: CRASH LOOP — not retrying",
+                          file=sys.stderr)
+                    return rc
+                continue
+            reshards += 1
+            attempt -= 1
+            try:
+                fitted = supervision.fit_axis_sizes(cur_sizes, int(visible))
+            except ValueError as e:
+                print(f"train_cluster: no mesh fits {visible} devices "
+                      f"({e}) — giving up", file=sys.stderr)
+                return rc
+            old_dp = cur_sizes.get("data", 1)
+            new_batch, new_accum, preserved = (cur_batch, cur_accum, False)
+            if old_dp > 0:
+                new_batch, new_accum, preserved = \
+                    supervision.rescale_for_devices(
+                        cur_batch, cur_accum, old_dp, fitted.get("data", 1))
+            if not preserved:
+                new_batch, new_accum = cur_batch, cur_accum
+            overrides = [f"mesh.{a}={v}" for a, v in fitted.items()]
+            overrides.append("checkpoint.allow_reshard=true")
+            if preserved:
+                overrides += [f"data.global_batch_size={new_batch}",
+                              f"train.grad_accum_steps={new_accum}"]
+            env[supervision.ELASTIC_OVERRIDES_ENV] = ",".join(overrides)
+            print(f"train_cluster: elastic reshard #{reshards} (rc={rc}) — "
+                  f"mesh {_fmt_axes(cur_sizes)} -> {_fmt_axes(fitted)} on "
+                  f"{visible} devices — relaunching immediately",
+                  file=sys.stderr)
+            writer.emit(telemetry.KIND_MESH_RESIZED,
+                        attempt=attempt + 1, rc=rc, reshards=reshards,
+                        from_axes=dict(cur_sizes), to_axes=dict(fitted),
+                        visible_devices=int(visible), process_count=active,
+                        global_batch=new_batch, grad_accum=new_accum,
+                        effective_batch_preserved=preserved,
+                        overrides=" ".join(overrides),
+                        last_step=last_step, ckpt_step=ckpt_step)
+            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                        attempt=attempt + 1, rc=rc,
+                        classification="elastic_reshard", reshards=reshards,
+                        process_id=worker, process_count=active,
+                        last_step=last_step, ckpt_step=ckpt_step)
+            cur_sizes, cur_batch, cur_accum = fitted, new_batch, new_accum
+            if reshards >= args.max_reshards:
+                print("train_cluster: topology churn exceeded "
+                      f"--max-reshards={args.max_reshards} — giving up",
+                      file=sys.stderr)
+                return rc
+            continue
+
+        if rc == supervision.ANOMALY_ESCALATION_RC:
+            failures += 1
+            print(f"train_cluster: attempt {attempt} exited rc={rc} "
+                  f"(persistent_anomaly on worker {worker}; "
+                  f"last_step={last_step}, ckpt_step={ckpt_step})",
+                  file=sys.stderr)
+            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                        attempt=attempt, rc=rc,
+                        classification="persistent_anomaly",
+                        process_id=worker, process_count=active,
+                        last_step=last_step, ckpt_step=ckpt_step)
+            if worker is not None:
+                breaker.record(worker, rc=rc, last_step=last_step,
+                               ckpt_step=ckpt_step, transient=True)
+            if attempt < args.max_attempts:
+                delay = supervision.backoff_seconds(
+                    failures, base=args.retry_sleep, cap=args.backoff_max,
+                    jitter=args.jitter)
+                print(f"train_cluster: backing off {delay:.1f}s",
+                      file=sys.stderr)
+                time.sleep(delay)
+            continue
+
+        if worker is not None and not hung and llc.is_bind_failure(
+                llc.log_tail(llc.log_path(args.workdir, worker))):
+            # The coordinator lost the free-port bind race at boot: pure
+            # launch-infrastructure noise, not a training failure —
+            # relaunch on a fresh port (chosen per attempt) for free.
+            attempt -= 1
+            print(f"train_cluster: worker {worker} lost the port-bind "
+                  "race — relaunching the gang on a fresh port",
+                  file=sys.stderr)
+            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                        attempt=attempt + 1, rc=rc,
+                        classification="port_race",
+                        process_id=worker, process_count=active,
+                        last_step=last_step, ckpt_step=ckpt_step)
+            continue
+
+        failures += 1
+        classification = "hung" if hung else "crashed"
+        print(f"train_cluster: attempt {attempt} exited rc={rc} "
+              f"({classification} on worker {worker}, "
+              f"last_step={last_step}, ckpt_step={ckpt_step})",
+              file=sys.stderr)
+        writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                    attempt=attempt, rc=rc, classification=classification,
+                    hung=hung, process_id=worker, process_count=active,
+                    last_step=last_step, ckpt_step=ckpt_step)
+        if worker is not None and breaker.record(
+                worker, rc=rc, last_step=last_step, ckpt_step=ckpt_step,
+                hung=hung):
+            report = breaker.report(worker)
+            print(f"train_cluster: CRASH LOOP on worker {worker} — "
+                  "deterministic failure, not retrying:\n"
+                  + json.dumps(report, indent=2), file=sys.stderr)
+            writer.emit(telemetry.KIND_CRASH_LOOP, **report)
+            return rc
+        if attempt < args.max_attempts:
+            delay = supervision.backoff_seconds(
+                failures, base=args.retry_sleep, cap=args.backoff_max,
+                jitter=args.jitter)
+            print(f"train_cluster: backing off {delay:.1f}s",
+                  file=sys.stderr)
+            time.sleep(delay)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

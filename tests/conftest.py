@@ -38,6 +38,29 @@ def devices():
     return devs
 
 
+@pytest.fixture(scope="session")
+def gang_capability():
+    """Gate for tests that need a REAL multi-process jax.distributed gang.
+
+    Stock CPU jaxlib forms the gang (coordinator handshake + global
+    device discovery succeed) but rejects any computation spanning
+    processes at compile time ("Multiprocess computations aren't
+    implemented on the CPU backend"), so every end-to-end gang test
+    would fail identically.  Probe once per session and SKIP those
+    tests with the probe's evidence — the supervisor/launcher decision
+    logic stays covered by the stubbed fast tiers (tests/test_cluster.py,
+    tests/test_local_cluster_launcher.py).
+    """
+    from distributed_tensorflow_framework_tpu.core import cluster
+
+    ok, detail = cluster.probe_gang(procs=2, devices_per_proc=2)
+    if not ok:
+        reason = ("backend cannot run real multi-process gangs"
+                  if cluster.is_gang_unsupported(detail)
+                  else "gang probe failed")
+        pytest.skip(f"{reason}:\n{detail[-800:]}")
+
+
 def write_imagenet_records(root, *, split="train", counts=(8, 8),
                            size=(64, 48), label_fn=None):
     """The ONE fabricated ImageNet-layout TFRecord writer for the suite

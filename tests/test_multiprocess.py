@@ -48,7 +48,7 @@ def _run_workers(extra_args=()):
 
 
 @pytest.mark.slow
-def test_two_process_training():
+def test_two_process_training(gang_capability):
     outs = _run_workers()
     losses = []
     for out in outs:
@@ -60,7 +60,7 @@ def test_two_process_training():
 
 @pytest.mark.slow
 @pytest.mark.slowest
-def test_two_process_exact_eval_uneven_shards(tmp_path):
+def test_two_process_exact_eval_uneven_shards(tmp_path, gang_capability):
     """Multi-host exact eval: hosts hold UNEVEN file shards (proc0: 2
     files/8 records, proc1: 1 file/4 records), agree on the padded batch
     count via process_allgather, and must report identical full-set
