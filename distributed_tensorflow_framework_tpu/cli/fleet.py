@@ -109,7 +109,7 @@ def main(argv=None) -> int:
     replicas = args.replicas if args.replicas is not None \
         else srv.fleet_replicas
 
-    from distributed_tensorflow_framework_tpu.core import telemetry
+    from distributed_tensorflow_framework_tpu.core import telemetry, tracing
     from distributed_tensorflow_framework_tpu.serve.fleet import FleetRouter
 
     log_dir = srv.log_dir or os.path.join(artifact_dir, "fleet_logs")
@@ -129,7 +129,16 @@ def main(argv=None) -> int:
                                         "serve.fleet_"))]
     launcher = make_replica_launcher(
         os.path.abspath(artifact_dir), log_dir, passthrough)
-    router = FleetRouter(srv, telemetry_writer=writer, launcher=launcher)
+    # Router-side flight recorder: ring of recent route/attempt/eject
+    # telemetry, dumped when the prober observes a replica die (and on
+    # SIGUSR1) so the fault's causal neighborhood survives the crash.
+    recorder = tracing.FlightRecorder(
+        config.trace.ring_size,
+        dump_dir=config.trace.dump_dir or log_dir).attach(writer)
+    recorder.install_sigusr1()
+    router = FleetRouter(srv, telemetry_writer=writer, launcher=launcher,
+                         trace_enabled=config.trace.enabled,
+                         flight_recorder=recorder)
     router.spawn_replicas(replicas)
     router.start()
     if not router.wait_ready(min_replicas=1, timeout=180.0):

@@ -56,7 +56,7 @@ def main(argv=None) -> int:
         log.error("no artifact: pass --artifact or set serve.artifact_dir")
         return 2
 
-    from distributed_tensorflow_framework_tpu.core import telemetry
+    from distributed_tensorflow_framework_tpu.core import telemetry, tracing
     from distributed_tensorflow_framework_tpu.serve.engine import (
         InferenceEngine,
     )
@@ -76,7 +76,16 @@ def main(argv=None) -> int:
         argv=list(argv if argv is not None else sys.argv),
         config=config.name, role="serve", artifact=artifact_dir,
         model=artifact.model_config.name, step=artifact.step)
-    engine = InferenceEngine(artifact, srv, telemetry_writer=writer)
+    engine = InferenceEngine(artifact, srv, telemetry_writer=writer,
+                             trace_enabled=config.trace.enabled)
+    # Flight recorder on the replica: ring of recent telemetry (spans
+    # included), dumped on SIGUSR1 or by the fleet router observing this
+    # process die (docs/OBSERVABILITY.md "Tracing and flight recorder").
+    recorder = tracing.FlightRecorder(
+        config.trace.ring_size,
+        dump_dir=config.trace.dump_dir or log_dir,
+        tracer=engine.tracer).attach(writer)
+    recorder.install_sigusr1()
     server = ServingServer(engine, srv, telemetry_writer=writer)
     # The resolved endpoint record: with serve.port=0 the OS picked the
     # port, so tooling polls this file instead of guessing.

@@ -40,7 +40,7 @@ import importlib.util
 import os
 import time
 
-from distributed_tensorflow_framework_tpu.core import supervision
+from distributed_tensorflow_framework_tpu.core import supervision, tracing
 
 # Single-process runs keep the legacy name so scripts/train_resilient.py
 # and every existing drill stay untouched.
@@ -102,6 +102,7 @@ def worker_env(
     process_id: int,
     devices_per_proc: int,
     coordinator_host: str = "127.0.0.1",
+    trace_ctx: str | None = None,
 ) -> dict[str, str]:
     """Environment for one gang worker on the local discovery path.
 
@@ -110,6 +111,13 @@ def worker_env(
     ``devices_per_proc`` virtual devices per process.  A gang refit down
     to one process strips the discovery vars entirely so the survivor
     initializes as a plain single-process run.
+
+    ``trace_ctx`` is an encoded :class:`core.tracing.SpanContext` (the
+    supervisor's attempt span): it rides ``DTF_TRACE_CTX`` so every
+    worker's ``worker.run`` span parents on the same attempt and the
+    whole gang stitches into one trace tree.  ``None`` leaves whatever
+    ``base_env`` carried untouched (the supervisor usually injects the
+    var into the shared base env once per attempt).
     """
     if not 0 <= process_id < num_processes:
         raise ClusterSpecError(
@@ -126,6 +134,8 @@ def worker_env(
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = supervision.mask_host_device_count(
         env.get("XLA_FLAGS", ""), devices_per_proc)
+    if trace_ctx is not None:
+        env[tracing.TRACE_CTX_ENV] = trace_ctx
     return env
 
 

@@ -640,6 +640,25 @@ class ServeConfig:
 
 
 @config_dataclass
+class TraceConfig:
+    """Distributed tracing + flight recorder (core/tracing.py,
+    docs/OBSERVABILITY.md "Tracing and flight recorder")."""
+
+    # Master switch for span emission (KIND_SPAN events) and the
+    # per-process flight recorder. Off, propagation headers/env are
+    # still accepted and forwarded but no spans are recorded.
+    enabled: bool = True
+    # Flight-recorder ring capacity: the last N telemetry events (spans
+    # included) kept in memory per process for the flightrec-<pid>.json
+    # dump. Sized so a fault's causal neighborhood survives a few
+    # hundred ms of peak serve-path event rate.
+    ring_size: int = 512
+    # Directory for flight-recorder dumps ("" = the DTF_TRACE_DIR env
+    # var, falling back to the process's telemetry log directory).
+    dump_dir: str = ""
+
+
+@config_dataclass
 class ExperimentConfig:
     name: str = "experiment"
     mesh: MeshConfig = field(default_factory=MeshConfig)
@@ -654,6 +673,7 @@ class ExperimentConfig:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     precision: PrecisionConfig = field(default_factory=PrecisionConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    trace: TraceConfig = field(default_factory=TraceConfig)
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -836,6 +856,10 @@ def load_config(
         raise ValueError(
             "cluster.heartbeat_interval_s must be > 0, got "
             f"{clu.heartbeat_interval_s}"
+        )
+    if cfg.trace.ring_size < 1:
+        raise ValueError(
+            f"trace.ring_size must be >= 1, got {cfg.trace.ring_size}"
         )
     srv = cfg.serve
     if srv.max_batch_size < 1:

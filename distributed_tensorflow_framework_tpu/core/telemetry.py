@@ -140,6 +140,13 @@ KIND_GOODPUT = "goodput"
 # compiled.memory_analysis() captures of a program's argument/output/
 # temp/generated-code bytes in ``extra.analysis``.
 KIND_MEMORY = "memory"
+# Distributed-tracing span (core/tracing.py, docs/OBSERVABILITY.md
+# "Tracing and flight recorder"): one record per FINISHED span, carrying
+# ``extra.trace``/``extra.span``/``extra.parent`` ids, the span ``name``,
+# root-frame start time + duration, the emitting ``service``, and the
+# process's estimated clock offset so scripts/analyze_trace.py --spans can
+# stitch per-process streams into one causally ordered trace tree.
+KIND_SPAN = "span"
 
 
 def make_run_id() -> str:
@@ -319,6 +326,22 @@ class TelemetryWriter:
             **describe,
         )
 
+    def flush(self) -> None:
+        """Push buffered lines to the kernel AND to disk (fsync).
+
+        Lines are already line-buffered, so this exists for the hard-exit
+        window: the graceful-preemption path calls it as soon as SIGTERM
+        lands so every record is durable even if the supervisor's SIGKILL
+        grace expires before close() runs.
+        """
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                try:
+                    os.fsync(self._fh.fileno())
+                except OSError:  # non-seekable sinks (pipes) can't fsync
+                    pass
+
     def close(self) -> None:
         with self._lock:
             if self._fh is not None:
@@ -431,6 +454,10 @@ def summarize_events(path: str) -> dict:
         "samples": 0, "sources": {},
         "peak_bytes_in_use": 0, "bytes_in_use_last": None,
         "analysis": None,
+    }
+    spans = {
+        "count": 0, "traces": set(), "services": {}, "names": {},
+        "errors": 0, "dur_ms_total": 0.0,
     }
     for ev in read_events(path, strict=False):
         kind = ev["kind"]
@@ -621,6 +648,18 @@ def summarize_events(path: str) -> dict:
                 memory["bytes_in_use_last"] = int(m["bytes_in_use"])
             if extra.get("analysis"):
                 memory["analysis"] = dict(extra["analysis"])
+        elif kind == KIND_SPAN:
+            m = ev.get("metrics") or {}
+            spans["count"] += 1
+            if extra.get("trace"):
+                spans["traces"].add(str(extra["trace"]))
+            svc = str(extra.get("service", "unknown"))
+            spans["services"][svc] = spans["services"].get(svc, 0) + 1
+            name = str(extra.get("name", "unknown"))
+            spans["names"][name] = spans["names"].get(name, 0) + 1
+            if str(extra.get("status", "ok")) != "ok":
+                spans["errors"] += 1
+            spans["dur_ms_total"] += float(m.get("dur_ms", 0.0) or 0.0)
         elif kind == KIND_TRAIN_STEP:
             m = ev.get("metrics") or {}
             if pipeline is not None and "pipe_bubble_frac" in m:
@@ -705,6 +744,14 @@ def summarize_events(path: str) -> dict:
                             or fleet["reloads"]) else None),
         "goodput": goodput,
         "memory": (memory if memory["samples"] else None),
+        "spans": ({
+            "count": spans["count"],
+            "traces": len(spans["traces"]),
+            "services": spans["services"],
+            "names": spans["names"],
+            "errors": spans["errors"],
+            "dur_ms_total": spans["dur_ms_total"],
+        } if spans["count"] else None),
         "recovery": {
             "quarantined": quarantined,
             "restore_fallbacks": fallbacks,
@@ -748,7 +795,7 @@ def format_run_summary(summary: dict) -> str:
     if summary["run_ids"]:
         lines.append(f"  run ids: {', '.join(summary['run_ids'])}")
     span = ""
-    if summary["last_step"] is not None:
+    if summary["last_step"] is not None:  # KIND_TRAIN_STEP rollup
         span = f", steps {summary['first_step']}..{summary['last_step']}"
     lines.append(f"  {summary['event_count']} events{span}")
     lines.append(
@@ -790,7 +837,7 @@ def format_run_summary(summary: dict) -> str:
             )
         )
     saves = summary.get("ckpt_saves") or {}
-    if saves.get("count"):
+    if saves.get("count"):  # KIND_CKPT_SAVE rollup
         lines.append(
             "  checkpoint saves: {count} ({async_count} async), loop "
             "blocked {blocked:.0f} ms of {total:.0f} ms total "
@@ -802,7 +849,7 @@ def format_run_summary(summary: dict) -> str:
             )
         )
     pipe = summary.get("pipeline")
-    if pipe:
+    if pipe:  # KIND_PIPELINE rollup
         bits = [
             f"{pipe.get('schedule', '?')} "
             f"S={pipe.get('stages', '?')} M={pipe.get('microbatches', '?')}"
@@ -912,6 +959,15 @@ def format_run_summary(summary: dict) -> str:
         if buckets:
             lines.append("    buckets: " + ", ".join(
                 f"{b} {s:.1f}s" for b, s in buckets))
+    spans = summary.get("spans")
+    if spans:  # KIND_SPAN rollup (core/tracing.py trace spans)
+        svcs = ", ".join(
+            f"{k}={v}" for k, v in sorted(spans.get("services", {}).items()))
+        lines.append(
+            f"  spans: {spans['count']} across {spans['traces']} trace(s)"
+            + (f" [{svcs}]" if svcs else "")
+            + (f", {spans['errors']} error(s)" if spans.get("errors") else "")
+        )
     mem = summary.get("memory")
     if mem:  # KIND_MEMORY rollup
         srcs = ", ".join(
@@ -933,7 +989,7 @@ def format_run_summary(summary: dict) -> str:
                     c=fmt_bytes(ana.get("generated_code_bytes")),
                 )
             )
-    for s in summary.get("startups") or []:
+    for s in summary.get("startups") or []:  # KIND_STARTUP rollup
         t = s.get("time_to_first_step_s")
         t_str = f"{t:.1f}s" if isinstance(t, (int, float)) else "?"
         lines.append(
@@ -954,18 +1010,18 @@ def format_run_summary(summary: dict) -> str:
         lines.append("  recovery activity: none")
         return "\n".join(lines)
     lines.append("  recovery activity:")
-    for a in rec.get("anomalies") or []:
+    for a in rec.get("anomalies") or []:  # KIND_ANOMALY rollup
         lines.append(
             f"    anomaly at step {a.get('step')}: "
             f"{a.get('anomaly', 'unknown')} ({a.get('metric')})"
         )
-    for r in rec.get("rollbacks") or []:
+    for r in rec.get("rollbacks") or []:  # KIND_ROLLBACK rollup
         lines.append(
             f"    rollback: step {r['from_step']} -> {r['to_step']}"
         )
-    if rec.get("batches_skipped"):
+    if rec.get("batches_skipped"):  # KIND_BATCH_SKIPPED rollup
         lines.append(f"    batches skipped: {rec['batches_skipped']}")
-    if rec.get("infeed_stalls"):
+    if rec.get("infeed_stalls"):  # KIND_INFEED_STALL rollup
         lines.append(f"    infeed stalls retried: {rec['infeed_stalls']}")
     for m in rec.get("mesh_resizes") or []:  # KIND_MESH_RESIZED
         lines.append(
@@ -979,15 +1035,15 @@ def format_run_summary(summary: dict) -> str:
             f"{_fmt_axes(r.get('from_axes'))} -> {_fmt_axes(r.get('to_axes'))}"
             f" ({r.get('leaf_count', '?')} leaves)"
         )
-    for q in rec["quarantined"]:
+    for q in rec["quarantined"]:  # KIND_CKPT_QUARANTINED rollup
         lines.append(
             f"    quarantined checkpoint step {q['step']} ({q['reason']})"
         )
-    for f in rec["restore_fallbacks"]:
+    for f in rec["restore_fallbacks"]:  # KIND_RESTORE_FALLBACK rollup
         lines.append(
             f"    restore fell back: step {f['from_step']} -> {f['to_step']}"
         )
-    if rec["supervisor_attempts"]:
+    if rec["supervisor_attempts"]:  # KIND_SUPERVISOR_ATTEMPT rollup
         lines.append(
             "    supervisor attempts: " + ", ".join(
                 f"{k}={v}"
@@ -998,9 +1054,9 @@ def format_run_summary(summary: dict) -> str:
         lines.append(
             f"    graceful preemptions: {rec['graceful_preemptions']}"
         )
-    for f in rec["failures"]:
+    for f in rec["failures"]:  # KIND_FAILURE rollup
         lines.append(f"    failure at step {f.get('step')}: "
                      f"{f.get('failure', 'unknown')}")
-    if rec["crash_loop"]:
+    if rec["crash_loop"]:  # KIND_CRASH_LOOP rollup
         lines.append(f"    CRASH LOOP: {json.dumps(rec['crash_loop'])}")
     return "\n".join(lines)

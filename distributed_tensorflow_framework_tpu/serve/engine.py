@@ -38,7 +38,11 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding
 
-from distributed_tensorflow_framework_tpu.core import memstats, telemetry
+from distributed_tensorflow_framework_tpu.core import (
+    memstats,
+    telemetry,
+    tracing,
+)
 from distributed_tensorflow_framework_tpu.core.config import ServeConfig
 from distributed_tensorflow_framework_tpu.core.mesh import (
     MeshConfig,
@@ -161,6 +165,9 @@ class _Request:
     seq_len: int  # 0 for classification
     future: Future = field(default_factory=Future)
     t_enqueue: float = field(default_factory=time.monotonic)
+    # Incoming trace context (tracing.SpanContext) — queue-wait, batch
+    # membership and compute become spans in the request's trace tree.
+    trace: Any = None
 
 
 class InferenceEngine:
@@ -174,7 +181,7 @@ class InferenceEngine:
     """
 
     def __init__(self, artifact: Artifact, serve_cfg: ServeConfig, *,
-                 mesh=None, telemetry_writer=None):
+                 mesh=None, telemetry_writer=None, trace_enabled=True):
         self.artifact = artifact
         self.cfg = serve_cfg
         self.mesh = mesh if mesh is not None else serving_mesh(serve_cfg.data)
@@ -208,6 +215,12 @@ class InferenceEngine:
         self._pending_reload: tuple | None = None
         self._reloads = 0
         self._replica_label = os.environ.get("DTF_REPLICA_ID", "engine")
+        # One tracer per replica process (server.py shares it): queue
+        # wait, batch membership and compute become KIND_SPAN events in
+        # each request's trace tree (trace.enabled gates emission).
+        self.tracer = tracing.Tracer(
+            telemetry_writer if trace_enabled else None,
+            service=self._replica_label)
         self._t_start = time.monotonic()
         self._latency = PercentileReservoir()
         self._requests = 0
@@ -305,10 +318,12 @@ class InferenceEngine:
 
     # ------------------------------------------------------- public API
 
-    def submit(self, inputs: dict[str, Any]) -> Future:
+    def submit(self, inputs: dict[str, Any],
+               trace: "tracing.SpanContext | None" = None) -> Future:
         """Validate + enqueue; returns a Future resolving to the per-row
         logits (np.ndarray, request rows only — padding stripped)."""
         req = self._validate(inputs)
+        req.trace = trace
         with self._cond:
             if self._state != "running":
                 raise EngineClosedError(
@@ -322,8 +337,9 @@ class InferenceEngine:
         return req.future
 
     def predict(self, inputs: dict[str, Any],
-                timeout: float | None = None) -> np.ndarray:
-        return self.submit(inputs).result(timeout)
+                timeout: float | None = None,
+                trace: "tracing.SpanContext | None" = None) -> np.ndarray:
+        return self.submit(inputs, trace=trace).result(timeout)
 
     def request_reload(self, artifact_dir: str) -> Future:
         """Stage a live weight swap; the batcher applies it BETWEEN
@@ -542,7 +558,8 @@ class InferenceEngine:
         t0 = time.monotonic()
         logits = self._fn(self._variables, inputs)
         logits = np.asarray(jax.block_until_ready(logits))
-        compute_ms = (time.monotonic() - t0) * 1e3
+        t_done = time.monotonic()
+        compute_ms = (t_done - t0) * 1e3
         if first_use:
             self._compiled.add(key)
             label = (f"rows{key[1]}" if self.task != "mlm"
@@ -581,6 +598,26 @@ class InferenceEngine:
                     telemetry.KIND_SERVE_REQUEST,
                     metrics={"rows": req.rows, "queue_wait_ms": wait_ms,
                              "latency_ms": latency_ms})
+            if req.trace is not None:
+                # Backfilled from the timestamps already measured above:
+                # queue wait, this request's membership in the padded
+                # batch, and the batch's device compute.
+                self.tracer.emit_span(
+                    "engine.queue", req.trace,
+                    start_mono=req.t_enqueue, end_mono=t_form,
+                    rows=req.rows)
+                bev = self.tracer.emit_span(
+                    "engine.batch", req.trace,
+                    start_mono=t_form, end_mono=t_done,
+                    batch=self._batches, rows=rows,
+                    padded_rows=row_bucket, queue_depth=depth)
+                bspan = (bev.get("extra") or {}).get("span")
+                self.tracer.emit_span(
+                    "engine.compute",
+                    tracing.SpanContext(req.trace.trace_id, bspan or "")
+                    if req.trace.trace_id else req.trace,
+                    start_mono=t0, end_mono=t_done,
+                    first_use=first_use)
             req.future.set_result(out)
 
     def _apply_pending_reload(self) -> None:

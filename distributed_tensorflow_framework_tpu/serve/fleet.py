@@ -68,6 +68,7 @@ from distributed_tensorflow_framework_tpu.core import (
     faults,
     supervision,
     telemetry,
+    tracing,
 )
 from distributed_tensorflow_framework_tpu.core.config import ServeConfig
 
@@ -139,12 +140,14 @@ class Replica:
 
 
 def _http_json(url: str, *, data: bytes | None = None,
-               timeout: float = 5.0) -> tuple[int, dict]:
+               timeout: float = 5.0,
+               headers: dict[str, str] | None = None) -> tuple[int, dict]:
     """One HTTP exchange; transport failures (refused, reset, timed out)
-    come back as status 0 so callers treat them like any 5xx."""
+    come back as status 0 so callers treat them like any 5xx. ``headers``
+    adds to the defaults (trace propagation rides X-DTF-Trace here)."""
     req = urllib.request.Request(
         url, data=data, method="POST" if data is not None else "GET",
-        headers={"Content-Type": "application/json"})
+        headers={"Content-Type": "application/json", **(headers or {})})
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, json.loads(resp.read() or b"{}")
@@ -180,9 +183,22 @@ class FleetRouter:
     """
 
     def __init__(self, serve_cfg: ServeConfig, *, telemetry_writer=None,
-                 launcher: Callable[[int], tuple[Any, str]] | None = None):
+                 launcher: Callable[[int], tuple[Any, str]] | None = None,
+                 trace_enabled: bool = True,
+                 flight_recorder: "tracing.FlightRecorder | None" = None):
         self.cfg = serve_cfg
         self._tw = telemetry_writer
+        # Router-side tracing: a client's X-DTF-Trace becomes a
+        # router.request span with one fleet.attempt child per hedged
+        # try; each attempt's context rides the header to the replica.
+        self.tracer = tracing.Tracer(
+            telemetry_writer if trace_enabled else None, service="router")
+        # Flight recorder (cli/fleet.py attaches it to the writer): the
+        # prober dumps it when it observes a replica die, so the fault's
+        # causal neighborhood survives even a torn replica JSONL.
+        self.flightrec = flight_recorder
+        if flight_recorder is not None and flight_recorder.tracer is None:
+            flight_recorder.tracer = self.tracer
         # launcher(index) -> (Popen, endpoint_json_path). It must spawn
         # WITHOUT blocking on readiness — the prober resolves the
         # endpoint and readmits once /healthz answers, so one booting
@@ -364,15 +380,29 @@ class FleetRouter:
                           action=action, reason=reason, **extra)
 
     def _proxy_predict(
-            self, body: bytes) -> tuple[int, dict, Replica | None, dict]:
+            self, body: bytes,
+            client_ctx: "tracing.SpanContext | None" = None,
+    ) -> tuple[int, dict, Replica | None, dict]:
         """Deadline-bounded, hedged proxying of one idempotent /predict.
 
         Each attempt gets ``min(remaining deadline, attempt timeout)``;
         a failed or abandoned attempt retries on a DIFFERENT replica
         after a doubling backoff. When every admitted replica has been
         tried, reuse beats refusal (one survivor still serves a
-        3-replica fleet with two down)."""
+        3-replica fleet with two down).
+
+        With a client trace context, the whole exchange becomes one
+        ``router.request`` span with a ``fleet.attempt`` child per try
+        (and ``fleet.backoff`` children for the sleeps between); each
+        attempt's own context rides ``X-DTF-Trace`` to the replica, so a
+        hedged retry yields ONE tree: failed attempt and winning attempt
+        side by side under the same root."""
         cfg = self.cfg
+        tr = self.tracer
+        root = None
+        if client_ctx is not None:
+            tr.adopt(client_ctx)
+            root = tr.start("router.request", client_ctx)
         t0 = time.monotonic()
         deadline = t0 + cfg.fleet_deadline_s
         backoff = cfg.fleet_retry_backoff_ms / 1e3
@@ -394,21 +424,34 @@ class FleetRouter:
                 deadline_exceeded = True
                 break
             attempts += 1
+            aspan = (tr.start("fleet.attempt", root, replica=rep.label,
+                              attempt=attempts)
+                     if root is not None else None)
+            headers = ({tracing.TRACE_HEADER: aspan.context().encode()}
+                       if aspan is not None else None)
             try:
                 status, payload = _http_json(
                     rep.url + "/predict", data=body,
-                    timeout=min(remaining, cfg.fleet_attempt_timeout_s))
+                    timeout=min(remaining, cfg.fleet_attempt_timeout_s),
+                    headers=headers)
             finally:
                 self._release_replica(rep)
             if status == 200:
+                if aspan is not None:
+                    aspan.end(status="ok", http_status=status)
                 served_by = rep
                 self._record_success(rep)
                 break
             if 400 <= status < 500:
                 # Deterministic request error — the replica is fine and
                 # another replica would answer identically.
+                if aspan is not None:
+                    aspan.end(status=f"http_{status}", http_status=status)
                 served_by = rep
                 break
+            if aspan is not None:
+                aspan.end(status="error", http_status=status,
+                          error=str(payload.get("error") or "")[:200])
             self._record_failure(rep, f"predict failed (status {status})")
             tried.add(rep.index)
             remaining = deadline - time.monotonic()
@@ -416,7 +459,13 @@ class FleetRouter:
                 deadline_exceeded = True
                 break
             if attempts <= cfg.fleet_retries:
-                time.sleep(min(backoff, remaining, 1.0))
+                sleep_s = min(backoff, remaining, 1.0)
+                bspan = (tr.start("fleet.backoff", root,
+                                  after_attempt=attempts, backoff_s=sleep_s)
+                         if root is not None else None)
+                time.sleep(sleep_s)
+                if bspan is not None:
+                    bspan.end()
                 backoff *= 2
         retries = max(0, attempts - 1)
         latency_ms = (time.monotonic() - t0) * 1e3
@@ -427,13 +476,23 @@ class FleetRouter:
                 self._shed += 1
             if deadline_exceeded:
                 self._deadline_exceeded += 1
+        if root is not None:
+            root.end(
+                status="ok" if status == 200 else (
+                    "shed" if shed else
+                    "deadline" if deadline_exceeded and status != 200
+                    else f"status_{status}"),
+                retries=retries, shed=shed,
+                deadline_exceeded=deadline_exceeded,
+                replica=served_by.label if served_by else None)
         if self._tw:
             self._tw.emit(
                 telemetry.KIND_SERVE_ROUTE,
                 metrics={"latency_ms": latency_ms, "retries": retries,
                          "status": status},
                 replica=served_by.label if served_by else None,
-                shed=shed, deadline_exceeded=deadline_exceeded)
+                shed=shed, deadline_exceeded=deadline_exceeded,
+                trace=client_ctx.trace_id if client_ctx else None)
         info = {"shed": shed, "deadline_exceeded": deadline_exceeded,
                 "retries": retries}
         return status, payload, served_by, info
@@ -450,7 +509,10 @@ class FleetRouter:
                 handler._reply(400, {"error": f"bad Content-Length {length}"})
                 return
             body = handler.rfile.read(length)
-            status, payload, served_by, info = self._proxy_predict(body)
+            client_ctx = tracing.safe_parse(
+                handler.headers.get(tracing.TRACE_HEADER))
+            status, payload, served_by, info = self._proxy_predict(
+                body, client_ctx)
             if info["shed"]:
                 handler._reply(
                     503,
@@ -736,6 +798,12 @@ class FleetRouter:
             rep, action="eject", reason=f"dead (rc={rc})",
             give_up=rep.give_up, crash_loop=bool(stop),
             restarts=rep.restarts)
+        if self.flightrec is not None:
+            # Forensics at the moment of observation: the ring holds the
+            # route/attempt/eject events (spans included) leading up to
+            # the death, plus every router span still open.
+            self.flightrec.dump(f"replica {rep.label} dead (rc={rc})",
+                                open_spans=self.tracer.open_spans())
 
     def _restart_due(self, now: float) -> None:
         with self._lock:

@@ -33,7 +33,7 @@ from typing import Any
 
 import numpy as np
 
-from distributed_tensorflow_framework_tpu.core import telemetry
+from distributed_tensorflow_framework_tpu.core import telemetry, tracing
 from distributed_tensorflow_framework_tpu.core.config import ServeConfig
 from distributed_tensorflow_framework_tpu.serve.engine import (
     EngineClosedError,
@@ -120,38 +120,59 @@ class ServingServer:
     # ------------------------------------------------------------ routes
 
     def handle_predict(self, handler) -> None:
-        if self._draining.is_set():
-            handler._reply(503, {"error": "draining", "retryable": True})
-            return
+        # Incoming X-DTF-Trace (router attempt or direct client): adopt
+        # the sender's clock sample, open the replica-side request span,
+        # and hand its context to the engine so queue/batch/compute spans
+        # chain under it. A malformed header never fails the request.
+        ctx = tracing.safe_parse(handler.headers.get(tracing.TRACE_HEADER))
+        tracer = self.engine.tracer
+        span = None
+        if ctx is not None:
+            tracer.adopt(ctx)
+            span = tracer.start("serve.request", ctx)
+        sent: dict[str, int] = {}
+
+        def reply(status: int, payload: dict) -> None:
+            sent["status"] = status
+            handler._reply(status, payload)
+
         try:
+            if self._draining.is_set():
+                reply(503, {"error": "draining", "retryable": True})
+                return
             length = int(handler.headers.get("Content-Length", 0))
             if length <= 0 or length > _MAX_BODY:
-                handler._reply(400, {"error": f"bad Content-Length {length}"})
+                reply(400, {"error": f"bad Content-Length {length}"})
                 return
             payload = json.loads(handler.rfile.read(length))
             inputs = payload.get("inputs")
             if not isinstance(inputs, dict):
-                handler._reply(
-                    400, {"error": "body must be {\"inputs\": {...}}"})
+                reply(400, {"error": "body must be {\"inputs\": {...}}"})
                 return
             outputs = self.engine.predict(
-                inputs, timeout=self.cfg.drain_timeout_s)
-            handler._reply(200, {
+                inputs, timeout=self.cfg.drain_timeout_s,
+                trace=span.context() if span is not None else None)
+            reply(200, {
                 "outputs": np.asarray(outputs).tolist(),
                 "rows": int(np.asarray(outputs).shape[0]),
                 "step": self.engine.artifact.step,
             })
         except (OversizeRequestError, SequenceTooLongError) as e:
-            handler._reply(400, {"error": str(e)})
+            reply(400, {"error": str(e)})
         except (QueueFullError, EngineClosedError) as e:
-            handler._reply(503, {"error": str(e), "retryable": True})
+            reply(503, {"error": str(e), "retryable": True})
         except ServeError as e:
-            handler._reply(400, {"error": str(e)})
+            reply(400, {"error": str(e)})
         except json.JSONDecodeError as e:
-            handler._reply(400, {"error": f"invalid JSON: {e}"})
+            reply(400, {"error": f"invalid JSON: {e}"})
         except Exception as e:  # noqa: BLE001 — server must outlive a bad request
             log.exception("predict failed")
-            handler._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            reply(500, {"error": f"{type(e).__name__}: {e}"})
+        finally:
+            if span is not None:
+                status = sent.get("status", 500)
+                span.end(status="ok" if status < 400 else f"http_{status}",
+                         http_status=status)
 
     def handle_reload(self, handler) -> None:
         """``POST /reload {"artifact_dir": ...}`` — live weight swap.

@@ -172,14 +172,29 @@ class CheckpointHook(BaseHook):
         self.manager = manager
         self.interval = max(1, interval)
 
+    def _traced_save(self, trainer, step: int, *, force: bool = False):
+        """Save under a ``ckpt.save`` span when the trainer carries a
+        tracer + run span (core/tracing.py) — with async_save on, the
+        span covers the device→host snapshot the step loop actually
+        blocks on, not the background commit."""
+        tracer = getattr(trainer, "tracer", None)
+        run_span = getattr(trainer, "run_span", None)
+        span = (tracer.start("ckpt.save", run_span, step=step, force=force)
+                if tracer is not None and run_span is not None else None)
+        try:
+            self.manager.save(step, trainer.state,
+                              dataset_state=trainer.data_ckpt_state,
+                              force=force)
+        finally:
+            if span is not None:
+                span.end()
+
     def after_step(self, trainer, step, metrics) -> None:
         if step > 0 and step % self.interval == 0:
-            self.manager.save(step, trainer.state,
-                              dataset_state=trainer.data_ckpt_state)
+            self._traced_save(trainer, step)
 
     def on_end(self, trainer) -> None:
-        self.manager.save(int(trainer.host_step), trainer.state,
-                          dataset_state=trainer.data_ckpt_state, force=True)
+        self._traced_save(trainer, int(trainer.host_step), force=True)
         self.manager.wait_until_finished()
 
 

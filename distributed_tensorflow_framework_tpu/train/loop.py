@@ -26,7 +26,8 @@ import jax
 
 from distributed_tensorflow_framework_tpu.core.config import ExperimentConfig
 from distributed_tensorflow_framework_tpu.core import (
-    cluster, faults, goodput, memstats, profiling, supervision, telemetry)
+    cluster, faults, goodput, memstats, profiling, supervision, telemetry,
+    tracing)
 from distributed_tensorflow_framework_tpu.core.mesh import MeshRuntime, initialize_runtime
 from distributed_tensorflow_framework_tpu.core.metrics import MetricWriter, setup_logging
 from distributed_tensorflow_framework_tpu.data import get_dataset
@@ -75,6 +76,7 @@ class Trainer:
         # restore + input build + compile, the relaunch cost a supervisor
         # pays on every preemption (emitted as a KIND_STARTUP event).
         self._init_t = time.perf_counter()
+        self._init_mono = time.monotonic()  # train.startup span backfill
         self._startup_emitted = False
         self._restored_step: int | None = None
         self.config = config
@@ -119,6 +121,25 @@ class Trainer:
         self.memstats = memstats.MemoryMonitor(
             self.writer.telemetry,
             interval_s=config.train.memory_interval_s, source="train")
+        # Distributed tracing (core/tracing.py): spans for this worker's
+        # run/startup/step-windows/ckpt-saves/rollbacks, parented on the
+        # gang supervisor's attempt span when DTF_TRACE_CTX is set — the
+        # whole gang then reconstructs as ONE supervisor-rooted tree.
+        self.tracer = tracing.Tracer(
+            self.writer.telemetry if config.trace.enabled else None,
+            service=f"worker{self.runtime.process_index}")
+        self._trace_parent = tracing.env_context()
+        self.tracer.adopt(self._trace_parent)
+        self.run_span: tracing.Span | None = None  # opened by train()
+        # Flight recorder: recent telemetry ring, dumped on anomaly
+        # escalation, graceful preemption, or SIGUSR1 — forensics that
+        # survive a SIGKILLed or torn-JSONL attempt.
+        self.flightrec = tracing.FlightRecorder(
+            config.trace.ring_size,
+            dump_dir=(config.trace.dump_dir
+                      or config.checkpoint.directory or None),
+            tracer=self.tracer).attach(self.writer.telemetry)
+        self.flightrec.install_sigusr1()
         # Set by _rebuild_with_rewarmup: the next dispatch re-jits, so its
         # wall time belongs in the recompile bucket, not step_compute.
         self._recompile_pending = False
@@ -350,6 +371,14 @@ class Trainer:
             )
         cfg = self.config.train
         hooks = self.default_hooks() if hooks is None else hooks
+        # The worker-side root span: parented on the supervisor's attempt
+        # span (DTF_TRACE_CTX) when one launched us, a fresh trace
+        # otherwise. Startup/step-window/ckpt/rollback spans chain under
+        # it; left open on a crash so the flight recorder's open-span
+        # snapshot still shows the fault's ancestry.
+        self.run_span = self.tracer.start(
+            "worker.run", self._trace_parent,
+            process=self.runtime.process_index, start_step=self.host_step)
         for h in hooks:
             h.on_start(self)
 
@@ -399,6 +428,11 @@ class Trainer:
                         health={"event": "graceful_preemption",
                                 "step": self.host_step},
                     )
+                    # Hard-exit durability: the supervisor SIGKILLs after
+                    # its grace window, so make the JSONL durable and dump
+                    # the flight recorder NOW, not at interpreter exit.
+                    self.writer.telemetry.flush()
+                    self.flightrec.dump("graceful_preemption")
                     break
                 with timer.phase("infeed"):
                     batch, self.data_ckpt_state = self._next_batch(infeed)
@@ -451,6 +485,18 @@ class Trainer:
                         compilation_cache_dir=(
                             self.config.train.compilation_cache_dir or None),
                     )
+                    # Construction → first completed step as one span:
+                    # the relaunch cost a coordinated restart pays, and
+                    # the segment the gang drill expects on the critical
+                    # path after a supervisor-driven relaunch.
+                    self.tracer.emit_span(
+                        "train.startup", self.run_span,
+                        start_mono=self._init_mono,
+                        end_mono=time.monotonic(),
+                        first_step=self.host_step,
+                        restored_step=self._restored_step)
+                    self._window_mono = time.monotonic()
+                    self._window_step = self.host_step
                 fetch = (
                     self.host_step % cfg.log_interval == 0
                     or self.host_step >= cfg.total_steps
@@ -476,6 +522,20 @@ class Trainer:
                     host_metrics = self._maybe_recover(host_metrics)
                     self.goodput.maybe_emit(step=self.host_step)
                     self.memstats.maybe_sample(step=self.host_step)
+                    # One span per log-interval window of steps — coarse
+                    # enough to stay cheap, fine enough that a gang
+                    # restart's dead time shows as a gap between the last
+                    # window of attempt N and startup of attempt N+1.
+                    now_mono = time.monotonic()
+                    self.tracer.emit_span(
+                        "train.steps", self.run_span,
+                        start_mono=getattr(self, "_window_mono", now_mono),
+                        end_mono=now_mono,
+                        start_step=getattr(self, "_window_step",
+                                           self.host_step),
+                        end_step=self.host_step)
+                    self._window_mono = now_mono
+                    self._window_step = self.host_step
                     if host_metrics is not None:
                         last_metrics = host_metrics
                 for h in hooks:
@@ -485,7 +545,11 @@ class Trainer:
                     # explosion past max_rollbacks): NaNGuardHook only
                     # fires on non-finite metrics, so the loop itself is
                     # the escalation tail here — also covers
-                    # train.nan_guard=false runs.
+                    # train.nan_guard=false runs. Dump the flight
+                    # recorder FIRST: the ring holds the rollback spans
+                    # and anomaly events leading up to this escalation,
+                    # and the open worker.run span is its ancestry.
+                    self.flightrec.dump("persistent_anomaly")
                     raise anomaly_lib.PersistentAnomalyError(
                         self.recovery.escalation_message(),
                         provenance=self.recovery.provenance(),
@@ -526,6 +590,10 @@ class Trainer:
         # blocked-ms lands in the rollup, not past it.
         self.goodput.finalize(step=self.host_step)
         self.memstats.sample(step=self.host_step, final=True)
+        if self.run_span is not None:
+            self.run_span.end(
+                status="preempted" if self.preempted else "ok",
+                end_step=self.host_step)
         return last_metrics
 
     # ----------------------------------------------------- recovery ladder --
@@ -586,6 +654,8 @@ class Trainer:
         if not rec.can_rollback():
             rec.exhausted = True
             return host_metrics
+        from_step = self.host_step
+        t_rb = time.monotonic()
         with self.goodput.timed("rollback"):
             self.state, snap = rec.rollback(self.state, from_step=self.host_step)
             # Skip-batch semantics: host_step rewinds, the data iterator
@@ -594,6 +664,10 @@ class Trainer:
             self.host_step = snap.step
             if self.config.resilience.lr_rewarmup_steps > 0:
                 self._rebuild_with_rewarmup(snap.step)
+        self.tracer.emit_span(
+            "train.rollback", self.run_span,
+            start_mono=t_rb, end_mono=time.monotonic(),
+            from_step=from_step, to_step=snap.step)
         return None
 
     def _rebuild_with_rewarmup(self, resume_step: int) -> None:

@@ -58,6 +58,7 @@ from distributed_tensorflow_framework_tpu.core import telemetry  # noqa: E402
 from distributed_tensorflow_framework_tpu.core import trace_analysis as ta  # noqa: E402
 
 RUN_SUMMARY_SCHEMA = "dtf-run-summary/1"
+TRACE_SPANS_SCHEMA = "dtf-trace-spans/1"
 
 
 def _events_files(target: str) -> list[str]:
@@ -152,6 +153,251 @@ def summarize_run(targets, json_out: str | None = None) -> bool:
     return True
 
 
+# ---------------------------------------------------------------------------
+# --spans: cross-process trace trees from KIND_SPAN telemetry
+# ---------------------------------------------------------------------------
+
+def collect_spans(paths: list[str]) -> list[dict]:
+    """Normalized span records from every events JSONL given.
+
+    Each record's ``t0``/``t1`` are ROOT-frame wall seconds: the raw
+    ``t_start`` minus the emitting process's ``offset_s`` estimate
+    (core/tracing.py clock model). Torn/non-JSON lines are skipped — a
+    crashed writer must not take the post-mortem down with it.
+    """
+    spans: list[dict] = []
+    seen: set = set()
+    for path in paths:
+        try:
+            fh = open(path)
+        except OSError:
+            continue
+        with fh:
+            for line in fh:
+                try:
+                    ev = json.loads(line)
+                except (ValueError, TypeError):
+                    continue
+                if not isinstance(ev, dict) \
+                        or ev.get("kind") != telemetry.KIND_SPAN:
+                    continue
+                extra = ev.get("extra") or {}
+                trace_id = extra.get("trace")
+                span_id = extra.get("span")
+                if not trace_id or not span_id \
+                        or (trace_id, span_id) in seen:
+                    continue
+                seen.add((trace_id, span_id))
+                try:
+                    t_start = float(extra.get("t_start", 0.0))
+                    offset_s = float(extra.get("offset_s", 0.0) or 0.0)
+                    dur_ms = float(
+                        (ev.get("metrics") or {}).get("dur_ms", 0.0))
+                except (TypeError, ValueError):
+                    continue
+                t0 = t_start - offset_s
+                spans.append({
+                    "trace": str(trace_id), "span": str(span_id),
+                    "parent": extra.get("parent") or None,
+                    "name": str(extra.get("name", "?")),
+                    "service": str(extra.get("service", "?")),
+                    "status": str(extra.get("status", "?")),
+                    "t0": t0, "t1": t0 + dur_ms / 1e3,
+                    "dur_ms": dur_ms,
+                    "attrs": extra.get("attrs") or {},
+                })
+    return spans
+
+
+def _children_of(spans: list[dict]) -> dict:
+    kids: dict = {}
+    for s in spans:
+        kids.setdefault(s["parent"], []).append(s)
+    for group in kids.values():
+        group.sort(key=lambda s: (s["t0"], -s["dur_ms"]))
+    return kids
+
+
+def build_traces(spans: list[dict]) -> list[dict]:
+    """Group spans into per-trace trees and causally order them.
+
+    Offset subtraction (done in collect_spans) handles skew between
+    processes; the residual transmission-delay term can still float a
+    child EARLIER than its parent's start, which is causally impossible
+    — so children are clamped forward into the parent's window, the
+    shift cascading down the subtree. Spans whose parent never got
+    emitted (a crashed process) become extra roots rather than
+    disappearing.
+    """
+    by_trace: dict = {}
+    for s in spans:
+        by_trace.setdefault(s["trace"], []).append(s)
+    traces = []
+    for trace_id, group in by_trace.items():
+        ids = {s["span"] for s in group}
+        roots = [s for s in group
+                 if s["parent"] is None or s["parent"] not in ids]
+        kids = _children_of(group)
+        # Causal clamp, parents before children.
+        stack = list(roots)
+        while stack:
+            parent = stack.pop()
+            for child in kids.get(parent["span"], []):
+                if child["t0"] < parent["t0"]:
+                    shift = parent["t0"] - child["t0"]
+                    child["t0"] += shift
+                    child["t1"] += shift
+                stack.append(child)
+        t0 = min(s["t0"] for s in group)
+        t1 = max(s["t1"] for s in group)
+        traces.append({
+            "trace": trace_id,
+            "t0": t0,
+            "dur_ms": (t1 - t0) * 1e3,
+            "services": sorted({s["service"] for s in group}),
+            "roots": sorted(roots, key=lambda s: s["t0"]),
+            "children": kids,
+            "spans": sorted(group, key=lambda s: (s["t0"], -s["dur_ms"])),
+        })
+    traces.sort(key=lambda t: t["t0"])
+    return traces
+
+
+def critical_path(trace: dict) -> dict:
+    """Where a trace's wall-clock went, in ms buckets.
+
+    queue        engine admission wait (engine.queue)
+    compute      device time (engine.compute)
+    batch_wait   in the batch window but not under compute
+    retry        failed router attempts + backoff sleeps
+    restart_gap  dead time between supervisor attempts
+    """
+    buckets = {"queue": 0.0, "compute": 0.0, "batch_wait": 0.0,
+               "retry": 0.0, "restart_gap": 0.0}
+    batch_ms = 0.0
+    for s in trace["spans"]:
+        name, dur = s["name"], s["dur_ms"]
+        if name == "engine.queue":
+            buckets["queue"] += dur
+        elif name == "engine.compute":
+            buckets["compute"] += dur
+        elif name == "engine.batch":
+            batch_ms += dur
+        elif name == "fleet.attempt" and s["status"] != "ok":
+            buckets["retry"] += dur
+        elif name == "fleet.backoff":
+            buckets["retry"] += dur
+        elif name == "supervisor.restart_gap":
+            buckets["restart_gap"] += dur
+    buckets["batch_wait"] = max(0.0, batch_ms - buckets["compute"])
+    buckets["total"] = trace["dur_ms"]
+    return buckets
+
+
+def format_trace_tree(trace: dict) -> str:
+    """One trace as an indented tree, offsets relative to the trace root."""
+    lines = [
+        f"trace {trace['trace']}  "
+        f"({trace['dur_ms']:.1f} ms, {len(trace['spans'])} span(s), "
+        f"services: {', '.join(trace['services'])})"
+    ]
+
+    def walk(span: dict, depth: int) -> None:
+        rel = (span["t0"] - trace["t0"]) * 1e3
+        attrs = ""
+        if span["attrs"]:
+            attrs = "  " + " ".join(
+                f"{k}={v}" for k, v in sorted(span["attrs"].items())
+                if v is not None)
+        lines.append(
+            f"  {'  ' * depth}{span['name']} [{span['service']}]  "
+            f"+{rel:.1f}ms {span['dur_ms']:.1f}ms {span['status']}{attrs}")
+        for child in trace["children"].get(span["span"], []):
+            walk(child, depth + 1)
+
+    for root in trace["roots"]:
+        walk(root, 0)
+    cp = critical_path(trace)
+    parts = [f"{k} {v:.1f}" for k, v in cp.items()
+             if k != "total" and v > 0]
+    if parts:
+        lines.append("  critical path (ms): " + ", ".join(parts)
+                     + f"  / total {cp['total']:.1f}")
+    return "\n".join(lines)
+
+
+def perfetto_export(traces: list[dict]) -> dict:
+    """Chrome trace-event JSON (load in Perfetto / chrome://tracing).
+
+    Complete events (``ph: "X"``), one process track per emitting
+    service, timestamps in microseconds relative to the earliest span.
+    """
+    events: list[dict] = []
+    services: dict[str, int] = {}
+    epoch = min((t["t0"] for t in traces), default=0.0)
+    for trace in traces:
+        for s in trace["spans"]:
+            pid = services.setdefault(s["service"], len(services) + 1)
+            events.append({
+                "name": s["name"], "cat": s["service"], "ph": "X",
+                "pid": pid, "tid": 1,
+                "ts": (s["t0"] - epoch) * 1e6,
+                "dur": s["dur_ms"] * 1e3,
+                "args": {"trace": s["trace"], "span": s["span"],
+                         "parent": s["parent"], "status": s["status"],
+                         **{k: v for k, v in s["attrs"].items()}},
+            })
+    for service, pid in services.items():
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 1, "args": {"name": service}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def summarize_spans(targets, json_out: str | None = None,
+                    perfetto_out: str | None = None) -> bool:
+    """--spans driver: trace trees + critical paths across run dirs;
+    False when no events file holds a single span."""
+    if isinstance(targets, str):
+        targets = [targets]
+    paths: list[str] = []
+    for target in targets:
+        for path in _events_files(target):
+            if path not in paths:
+                paths.append(path)
+    spans = collect_spans(paths)
+    if not spans:
+        return False
+    traces = build_traces(spans)
+    if perfetto_out:
+        with open(perfetto_out, "w") as fh:
+            json.dump(perfetto_export(traces), fh)
+            fh.write("\n")
+    if json_out:
+        obj = {
+            "schema": TRACE_SPANS_SCHEMA,
+            "traces": [{
+                "trace": t["trace"], "t0": t["t0"], "dur_ms": t["dur_ms"],
+                "services": t["services"],
+                "critical_path": critical_path(t),
+                "spans": [{k: v for k, v in s.items()}
+                          for s in t["spans"]],
+            } for t in traces],
+        }
+        text = json.dumps(obj, sort_keys=True, default=str)
+        if json_out == "-":
+            print(text)
+            return True
+        with open(json_out, "w") as fh:
+            fh.write(text + "\n")
+    for i, trace in enumerate(traces):
+        if i:
+            print()
+        print(format_trace_tree(trace))
+    if perfetto_out:
+        print(f"\nperfetto export written to {perfetto_out}")
+    return True
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", nargs="+",
@@ -171,7 +417,22 @@ def main(argv=None) -> int:
                          "from the run's events.jsonl to make them joinable)")
     ap.add_argument("--top", type=int, default=15,
                     help="number of top ops to list")
+    ap.add_argument("--spans", action="store_true",
+                    help="span mode: stitch KIND_SPAN telemetry across the "
+                         "given run dirs into causally ordered trace trees "
+                         "with per-request critical paths (--json '-' for "
+                         "the machine-readable object)")
+    ap.add_argument("--perfetto", default=None, metavar="PATH",
+                    help="span mode: also write a Chrome trace-event JSON "
+                         "(open in https://ui.perfetto.dev)")
     args = ap.parse_args(argv)
+
+    if args.spans:
+        if not summarize_spans(args.trace, json_out=args.json,
+                               perfetto_out=args.perfetto):
+            print(f"no span events under {args.trace!r}", file=sys.stderr)
+            return 2
+        return 0
 
     # events.jsonl → run summary (recovery activity); a run DIRECTORY gets
     # both the run summary and, below, its newest trace when one exists.

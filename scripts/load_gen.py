@@ -42,13 +42,17 @@ import urllib.request
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+from distributed_tensorflow_framework_tpu.core import tracing  # noqa: E402
 from distributed_tensorflow_framework_tpu.core.metrics import (  # noqa: E402
     PercentileReservoir,
 )
 
 # /2 is additive over /1: per-run "by_replica" and a top-level "fleet"
 # section (router counter deltas + replica distribution) appear when the
-# endpoint is a fleet router; every /1 field is unchanged.
+# endpoint is a fleet router; every /1 field is unchanged.  Per-run
+# "trace_ids" (one fresh trace id per request, dispatch order) is a
+# later additive field: join them against the server-side span events to
+# reconstruct any request's causal story (docs/OBSERVABILITY.md).
 BENCH_SCHEMA = "dtf-serve-bench/2"
 
 
@@ -100,15 +104,19 @@ def make_payload(spec: dict, rows: int, *, vocab_size: int,
     return {"inputs": inputs}
 
 
-def post_predict(url: str, payload: dict, timeout: float = 60.0) -> tuple:
+def post_predict(url: str, payload: dict, timeout: float = 60.0,
+                 trace: tracing.SpanContext | None = None) -> tuple:
     """(status, latency_ms, rows_returned, replica). Network errors count
     as status 0 — a closed connection mid-drain must not crash the bench.
     ``replica`` is the fleet router's X-DTF-Replica attribution header
-    (None against a single server)."""
+    (None against a single server). ``trace`` rides the X-DTF-Trace
+    header so the router/server open spans under this client's trace."""
     body = json.dumps(payload).encode()
+    headers = {"Content-Type": "application/json"}
+    if trace is not None:
+        headers[tracing.TRACE_HEADER] = trace.encode()
     req = urllib.request.Request(
-        url + "/predict", data=body,
-        headers={"Content-Type": "application/json"})
+        url + "/predict", data=body, headers=headers)
     t0 = time.monotonic()
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -146,6 +154,11 @@ def _drive(url: str, payloads: list[dict], *, concurrency: int,
             else:
                 counts["errors"] += 1
 
+    # One fresh trace per request: the client is the trace root, so a
+    # request that fans out into router attempts / hedges / batches still
+    # reads as ONE tree when the span events are stitched.
+    ctxs = [tracing.fresh_context() for _ in payloads]
+
     t_start = time.monotonic()
     if rate is None:  # closed loop: each worker keeps one request in flight
         def worker():
@@ -155,13 +168,13 @@ def _drive(url: str, payloads: list[dict], *, concurrency: int,
                     if i >= len(payloads):
                         return
                     idx["next"] = i + 1
-                record(*post_predict(url, payloads[i]))
+                record(*post_predict(url, payloads[i], trace=ctxs[i]))
 
         threads = [threading.Thread(target=worker, daemon=True)
                    for _ in range(concurrency)]
     else:  # open loop: dispatch on schedule, completion be damned
-        def fire(payload):
-            record(*post_predict(url, payload))
+        def fire(payload, ctx):
+            record(*post_predict(url, payload, trace=ctx))
 
         threads = []
         for i, payload in enumerate(payloads):
@@ -169,7 +182,8 @@ def _drive(url: str, payloads: list[dict], *, concurrency: int,
             delay = t_due - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
-            t = threading.Thread(target=fire, args=(payload,), daemon=True)
+            t = threading.Thread(target=fire, args=(payload, ctxs[i]),
+                                 daemon=True)
             threads.append(t)
             t.start()
     if rate is None:
@@ -197,6 +211,7 @@ def _drive(url: str, payloads: list[dict], *, concurrency: int,
            if counts["by_replica"] else {}),
         **({"offered_rate": rate} if rate is not None else
            {"concurrency": concurrency}),
+        "trace_ids": [c.trace_id for c in ctxs],
     }
 
 

@@ -30,8 +30,11 @@ echo "=== tier-1 pytest (log → $ART/pytest.log) ==="
 # DTF_GANG_DRILL_DIR: same contract for the gang chaos drills
 # (tests/test_cluster_drill.py) — their supervisor_events.jsonl is the
 # attempt-by-attempt record of the coordinated restart / gang refit.
+# DTF_TRACE_DIR: the drills' Perfetto trace exports and any
+# flight-recorder dumps land here too (docs/OBSERVABILITY.md "Tracing
+# and flight recorder").
 timeout -k 10 870 env JAX_PLATFORMS=cpu DTF_SERVE_BENCH_DIR="$ART" \
-    DTF_GANG_DRILL_DIR="$ART" \
+    DTF_GANG_DRILL_DIR="$ART" DTF_TRACE_DIR="$ART" \
     python -m pytest tests/ -q \
     -m "$MARKERS" --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
@@ -44,6 +47,12 @@ fi
 if [ -f "$ART/GANG_DRILL_EVENTS.jsonl" ]; then
   echo "=== gang drill events archived: $ART/GANG_DRILL_EVENTS.jsonl ==="
 fi
+for trace in "$ART"/*TRACE*.json; do
+  [ -f "$trace" ] && echo "=== perfetto trace archived: $trace ==="
+done
+for dump in "$ART"/flightrec-*.json; do
+  [ -f "$dump" ] && echo "=== flight-recorder dump archived: $dump ==="
+done
 
 echo "=== tier-1 summary: graftcheck rc=$gc_rc pytest rc=$py_rc ==="
 [ "$gc_rc" -eq 0 ] && [ "$py_rc" -eq 0 ]

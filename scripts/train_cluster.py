@@ -71,6 +71,7 @@ from distributed_tensorflow_framework_tpu.core import (  # noqa: E402
     faults,
     supervision,
     telemetry,
+    tracing,
 )
 from scripts import launch_local_cluster as llc  # noqa: E402
 from scripts.train_resilient import (  # noqa: E402
@@ -406,225 +407,304 @@ def main(argv=None) -> int:
         except (ValueError, OSError):  # non-main thread (tests importing us)
             pass
 
+    # The gang's ONE causal root: supervisor.run → supervisor.attempt per
+    # attempt (its context rides DTF_TRACE_CTX into every worker, whose
+    # worker.run spans parent on it) → supervisor.restart_gap spans for
+    # the dead time between attempts — the coordinated-restart cost on
+    # the trace's critical path.
+    tracer = tracing.Tracer(writer, service="supervisor")
+    flightrec = tracing.FlightRecorder(
+        512, dump_dir=ckpt_dir or args.workdir, tracer=tracer).attach(writer)
+    flightrec.install_sigusr1()
+    root = tracer.start("supervisor.run", None, procs=args.procs,
+                        command=" ".join(cmd)[:200])
+
     env = build_env()
     breaker = cluster.GangBreaker(args.crash_loop_threshold)
     cur_sizes, cur_batch, cur_accum = parse_training_params(cmd)
     active = args.procs
     rc = 1
     attempt = failures = preemptions = reshards = 0
-    while attempt < args.max_attempts:
-        attempt += 1
-        print(f"train_cluster: attempt {attempt}/{args.max_attempts} "
-              f"(gang of {active})", file=sys.stderr)
-        res = _run_gang_attempt(
-            cmd, env, procs=active,
-            devices_per_proc=args.devices_per_proc,
-            workdir=args.workdir, ckpt_dir=ckpt_dir,
-            hb_timeout=args.heartbeat_timeout,
-            hb_poll=args.heartbeat_poll,
-            startup_grace=args.startup_grace,
-            rejoin_timeout_s=rejoin_timeout,
-            chaos_tick_s=args.chaos_tick)
-        rc = res.first_rc or 0
-        worker = res.first_worker
-        # Progress accounting: the failing worker's own heartbeat,
-        # pid-scoped to THIS attempt's child so a predecessor's record
-        # cannot fake forward progress.
-        last_step = None
-        if worker is not None and ckpt_dir:
-            hb = supervision.read_heartbeat(
-                cluster.heartbeat_path(ckpt_dir, worker, active))
-            if hb and hb.get("pid") in (None, res.pids.get(worker)):
-                last_step = hb.get("last_completed_step", hb.get("step"))
-        ckpt_step = latest_committed_step(ckpt_dir) if ckpt_dir else None
+    prev_end_mono: float | None = None
+    try:
+        while attempt < args.max_attempts:
+            attempt += 1
+            if prev_end_mono is not None:
+                # The dead time since the previous attempt ended (backoff +
+                # relaunch): retroactive, so it lands between the attempts'
+                # spans and the restart cost is ON the reconstructed
+                # critical path, not an invisible gap.
+                tracer.emit_span(
+                    "supervisor.restart_gap", root,
+                    start_mono=prev_end_mono, end_mono=time.monotonic(),
+                    before_attempt=attempt)
+            attempt_span = tracer.start(
+                "supervisor.attempt", root, attempt=attempt, gang=active)
+            env[tracing.TRACE_CTX_ENV] = attempt_span.context().encode()
+            print(f"train_cluster: attempt {attempt}/{args.max_attempts} "
+                  f"(gang of {active})", file=sys.stderr)
+            res = _run_gang_attempt(
+                cmd, env, procs=active,
+                devices_per_proc=args.devices_per_proc,
+                workdir=args.workdir, ckpt_dir=ckpt_dir,
+                hb_timeout=args.heartbeat_timeout,
+                hb_poll=args.heartbeat_poll,
+                startup_grace=args.startup_grace,
+                rejoin_timeout_s=rejoin_timeout,
+                chaos_tick_s=args.chaos_tick)
+            attempt_span.end(
+                status="ok" if res.done else f"rc_{res.first_rc}",
+                rc=res.first_rc, worker=res.first_worker,
+                hung=sorted(res.hung), dropped=sorted(res.dropped))
+            prev_end_mono = time.monotonic()
+            rc = res.first_rc or 0
+            worker = res.first_worker
+            # Progress accounting: the failing worker's own heartbeat,
+            # pid-scoped to THIS attempt's child so a predecessor's record
+            # cannot fake forward progress.
+            last_step = None
+            if worker is not None and ckpt_dir:
+                hb = supervision.read_heartbeat(
+                    cluster.heartbeat_path(ckpt_dir, worker, active))
+                if hb and hb.get("pid") in (None, res.pids.get(worker)):
+                    last_step = hb.get("last_completed_step", hb.get("step"))
+            ckpt_step = latest_committed_step(ckpt_dir) if ckpt_dir else None
 
-        if res.done:
-            print(f"train_cluster: done (attempt {attempt})", file=sys.stderr)
-            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                        attempt=attempt, rc=0, classification="done",
-                        process_id=0, process_count=active,
-                        last_step=last_step, ckpt_step=ckpt_step)
-            return 0
-        hung = worker in res.hung
-        if _cancelled or rc in (130, 143):
-            print(f"train_cluster: gang cancelled (rc={rc}) — not retrying",
-                  file=sys.stderr)
-            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                        attempt=attempt, rc=rc, classification="cancelled",
-                        process_id=worker, process_count=active,
-                        last_step=last_step, ckpt_step=ckpt_step)
-            return rc
-
-        if res.dropped:
-            # Permanent worker loss (drop_worker chaos or rejoin timeout):
-            # the gang-level rc-84 path. Refit the mesh to the survivors
-            # and relaunch smaller — topology change, not failure, so no
-            # attempt is consumed and the breaker streak never feeds.
-            survivors = active - len(res.dropped)
-            reshards += 1
-            attempt -= 1
-            for w in res.dropped:
-                breaker.record(w, rc=rc, last_step=last_step,
-                               ckpt_step=ckpt_step, transient=True)
-            if survivors < 1:
-                print("train_cluster: every worker dropped — giving up",
-                      file=sys.stderr)
-                return rc or 1
-            try:
-                refit = cluster.decide_refit(
-                    cur_sizes, cur_batch, cur_accum,
-                    process_count=survivors,
-                    devices_per_proc=args.devices_per_proc)
-            except cluster.ClusterSpecError as e:
-                print(f"train_cluster: {e} — giving up", file=sys.stderr)
-                return rc or 1
-            if not refit.batch_preserved:
-                print("train_cluster: WARNING — could not preserve the "
-                      f"effective batch across {_fmt_axes(cur_sizes)} -> "
-                      f"{_fmt_axes(refit.sizes)}", file=sys.stderr)
-            env[supervision.ELASTIC_OVERRIDES_ENV] = ",".join(refit.overrides)
-            print(f"train_cluster: gang refit #{reshards} — workers "
-                  f"{sorted(res.dropped)} lost, {active} -> {survivors} "
-                  f"processes ({refit.n_devices} devices), mesh "
-                  f"{_fmt_axes(cur_sizes)} -> {_fmt_axes(refit.sizes)}, "
-                  f"global_batch {cur_batch} -> {refit.global_batch}, "
-                  f"grad_accum {cur_accum} -> {refit.grad_accum} — "
-                  "relaunching immediately", file=sys.stderr)
-            writer.emit(telemetry.KIND_MESH_RESIZED,
-                        attempt=attempt + 1, rc=rc, reshards=reshards,
-                        from_axes=dict(cur_sizes), to_axes=dict(refit.sizes),
-                        visible_devices=refit.n_devices,
-                        process_count=survivors,
-                        dropped_workers=sorted(res.dropped),
-                        global_batch=refit.global_batch,
-                        grad_accum=refit.grad_accum,
-                        effective_batch_preserved=refit.batch_preserved,
-                        overrides=" ".join(refit.overrides),
-                        last_step=last_step, ckpt_step=ckpt_step)
-            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                        attempt=attempt + 1, rc=rc,
-                        classification="gang_refit", reshards=reshards,
-                        process_id=worker, process_count=survivors,
-                        last_step=last_step, ckpt_step=ckpt_step)
-            cur_sizes, cur_batch, cur_accum = (
-                refit.sizes, refit.global_batch, refit.grad_accum)
-            active = survivors
-            if reshards >= args.max_reshards:
-                print("train_cluster: topology churn exceeded "
-                      f"--max-reshards={args.max_reshards} — giving up",
-                      file=sys.stderr)
-                return rc
-            continue
-
-        if rc == supervision.GRACEFUL_PREEMPT_RC:
-            # The FIRST exit was already rc 83 — the whole gang was
-            # preempted externally (our own coordinated shutdown only
-            # SIGTERMs peers AFTER a nonzero root cause, so it cannot
-            # produce an 83-first gang).
-            preemptions += 1
-            attempt -= 1
-            print(f"train_cluster: gang preempted (rc={rc}, "
-                  f"#{preemptions}) — relaunching immediately",
-                  file=sys.stderr)
-            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                        attempt=attempt + 1, rc=rc,
-                        classification="preempted", preemptions=preemptions,
-                        process_id=worker, process_count=active,
-                        last_step=last_step, ckpt_step=ckpt_step)
-            if preemptions >= args.max_preemptions:
-                print("train_cluster: preemption churn exceeded "
-                      f"--max-preemptions={args.max_preemptions} — giving "
-                      "up", file=sys.stderr)
-                return rc
-            continue
-
-        if rc == supervision.ELASTIC_RESHARD_RC:
-            # A child could not build its mesh on the devices it saw
-            # (child-led elastic, e.g. a drop_devices drill inside the
-            # gang). Refit over the reported device set at the SAME
-            # process count; the gang-shrink path above handles lost
-            # workers.
-            report = supervision.read_device_report(ckpt_dir) \
-                if ckpt_dir else None
-            visible = (report or {}).get("visible_devices")
-            if not visible:
-                failures += 1
-                print(f"train_cluster: attempt {attempt} exited rc={rc} "
-                      "(elastic) but left no device report — treating as "
-                      "a plain failure", file=sys.stderr)
+            if res.done:
+                print(f"train_cluster: done (attempt {attempt})", file=sys.stderr)
                 writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                            attempt=attempt, rc=rc,
-                            classification="elastic_no_report",
+                            attempt=attempt, rc=0, classification="done",
+                            process_id=0, process_count=active,
+                            last_step=last_step, ckpt_step=ckpt_step)
+                return 0
+            hung = worker in res.hung
+            if _cancelled or rc in (130, 143):
+                print(f"train_cluster: gang cancelled (rc={rc}) — not retrying",
+                      file=sys.stderr)
+                writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                            attempt=attempt, rc=rc, classification="cancelled",
                             process_id=worker, process_count=active,
                             last_step=last_step, ckpt_step=ckpt_step)
-                if worker is not None and breaker.record(
-                        worker, rc=rc, last_step=last_step,
-                        ckpt_step=ckpt_step):
-                    print("train_cluster: CRASH LOOP — not retrying",
+                return rc
+
+            if res.dropped:
+                # Permanent worker loss (drop_worker chaos or rejoin timeout):
+                # the gang-level rc-84 path. Refit the mesh to the survivors
+                # and relaunch smaller — topology change, not failure, so no
+                # attempt is consumed and the breaker streak never feeds.
+                survivors = active - len(res.dropped)
+                reshards += 1
+                attempt -= 1
+                for w in res.dropped:
+                    breaker.record(w, rc=rc, last_step=last_step,
+                                   ckpt_step=ckpt_step, transient=True)
+                if survivors < 1:
+                    print("train_cluster: every worker dropped — giving up",
+                          file=sys.stderr)
+                    return rc or 1
+                try:
+                    refit = cluster.decide_refit(
+                        cur_sizes, cur_batch, cur_accum,
+                        process_count=survivors,
+                        devices_per_proc=args.devices_per_proc)
+                except cluster.ClusterSpecError as e:
+                    print(f"train_cluster: {e} — giving up", file=sys.stderr)
+                    return rc or 1
+                if not refit.batch_preserved:
+                    print("train_cluster: WARNING — could not preserve the "
+                          f"effective batch across {_fmt_axes(cur_sizes)} -> "
+                          f"{_fmt_axes(refit.sizes)}", file=sys.stderr)
+                env[supervision.ELASTIC_OVERRIDES_ENV] = ",".join(refit.overrides)
+                print(f"train_cluster: gang refit #{reshards} — workers "
+                      f"{sorted(res.dropped)} lost, {active} -> {survivors} "
+                      f"processes ({refit.n_devices} devices), mesh "
+                      f"{_fmt_axes(cur_sizes)} -> {_fmt_axes(refit.sizes)}, "
+                      f"global_batch {cur_batch} -> {refit.global_batch}, "
+                      f"grad_accum {cur_accum} -> {refit.grad_accum} — "
+                      "relaunching immediately", file=sys.stderr)
+                writer.emit(telemetry.KIND_MESH_RESIZED,
+                            attempt=attempt + 1, rc=rc, reshards=reshards,
+                            from_axes=dict(cur_sizes), to_axes=dict(refit.sizes),
+                            visible_devices=refit.n_devices,
+                            process_count=survivors,
+                            dropped_workers=sorted(res.dropped),
+                            global_batch=refit.global_batch,
+                            grad_accum=refit.grad_accum,
+                            effective_batch_preserved=refit.batch_preserved,
+                            overrides=" ".join(refit.overrides),
+                            last_step=last_step, ckpt_step=ckpt_step)
+                writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                            attempt=attempt + 1, rc=rc,
+                            classification="gang_refit", reshards=reshards,
+                            process_id=worker, process_count=survivors,
+                            last_step=last_step, ckpt_step=ckpt_step)
+                cur_sizes, cur_batch, cur_accum = (
+                    refit.sizes, refit.global_batch, refit.grad_accum)
+                active = survivors
+                if reshards >= args.max_reshards:
+                    print("train_cluster: topology churn exceeded "
+                          f"--max-reshards={args.max_reshards} — giving up",
                           file=sys.stderr)
                     return rc
                 continue
-            reshards += 1
-            attempt -= 1
-            try:
-                fitted = supervision.fit_axis_sizes(cur_sizes, int(visible))
-            except ValueError as e:
-                print(f"train_cluster: no mesh fits {visible} devices "
-                      f"({e}) — giving up", file=sys.stderr)
-                return rc
-            old_dp = cur_sizes.get("data", 1)
-            new_batch, new_accum, preserved = (cur_batch, cur_accum, False)
-            if old_dp > 0:
-                new_batch, new_accum, preserved = \
-                    supervision.rescale_for_devices(
-                        cur_batch, cur_accum, old_dp, fitted.get("data", 1))
-            if not preserved:
-                new_batch, new_accum = cur_batch, cur_accum
-            overrides = [f"mesh.{a}={v}" for a, v in fitted.items()]
-            overrides.append("checkpoint.allow_reshard=true")
-            if preserved:
-                overrides += [f"data.global_batch_size={new_batch}",
-                              f"train.grad_accum_steps={new_accum}"]
-            env[supervision.ELASTIC_OVERRIDES_ENV] = ",".join(overrides)
-            print(f"train_cluster: elastic reshard #{reshards} (rc={rc}) — "
-                  f"mesh {_fmt_axes(cur_sizes)} -> {_fmt_axes(fitted)} on "
-                  f"{visible} devices — relaunching immediately",
-                  file=sys.stderr)
-            writer.emit(telemetry.KIND_MESH_RESIZED,
-                        attempt=attempt + 1, rc=rc, reshards=reshards,
-                        from_axes=dict(cur_sizes), to_axes=dict(fitted),
-                        visible_devices=int(visible), process_count=active,
-                        global_batch=new_batch, grad_accum=new_accum,
-                        effective_batch_preserved=preserved,
-                        overrides=" ".join(overrides),
-                        last_step=last_step, ckpt_step=ckpt_step)
-            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                        attempt=attempt + 1, rc=rc,
-                        classification="elastic_reshard", reshards=reshards,
-                        process_id=worker, process_count=active,
-                        last_step=last_step, ckpt_step=ckpt_step)
-            cur_sizes, cur_batch, cur_accum = fitted, new_batch, new_accum
-            if reshards >= args.max_reshards:
-                print("train_cluster: topology churn exceeded "
-                      f"--max-reshards={args.max_reshards} — giving up",
-                      file=sys.stderr)
-                return rc
-            continue
 
-        if rc == supervision.ANOMALY_ESCALATION_RC:
+            if rc == supervision.GRACEFUL_PREEMPT_RC:
+                # The FIRST exit was already rc 83 — the whole gang was
+                # preempted externally (our own coordinated shutdown only
+                # SIGTERMs peers AFTER a nonzero root cause, so it cannot
+                # produce an 83-first gang).
+                preemptions += 1
+                attempt -= 1
+                print(f"train_cluster: gang preempted (rc={rc}, "
+                      f"#{preemptions}) — relaunching immediately",
+                      file=sys.stderr)
+                writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                            attempt=attempt + 1, rc=rc,
+                            classification="preempted", preemptions=preemptions,
+                            process_id=worker, process_count=active,
+                            last_step=last_step, ckpt_step=ckpt_step)
+                if preemptions >= args.max_preemptions:
+                    print("train_cluster: preemption churn exceeded "
+                          f"--max-preemptions={args.max_preemptions} — giving "
+                          "up", file=sys.stderr)
+                    return rc
+                continue
+
+            if rc == supervision.ELASTIC_RESHARD_RC:
+                # A child could not build its mesh on the devices it saw
+                # (child-led elastic, e.g. a drop_devices drill inside the
+                # gang). Refit over the reported device set at the SAME
+                # process count; the gang-shrink path above handles lost
+                # workers.
+                report = supervision.read_device_report(ckpt_dir) \
+                    if ckpt_dir else None
+                visible = (report or {}).get("visible_devices")
+                if not visible:
+                    failures += 1
+                    print(f"train_cluster: attempt {attempt} exited rc={rc} "
+                          "(elastic) but left no device report — treating as "
+                          "a plain failure", file=sys.stderr)
+                    writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                                attempt=attempt, rc=rc,
+                                classification="elastic_no_report",
+                                process_id=worker, process_count=active,
+                                last_step=last_step, ckpt_step=ckpt_step)
+                    if worker is not None and breaker.record(
+                            worker, rc=rc, last_step=last_step,
+                            ckpt_step=ckpt_step):
+                        print("train_cluster: CRASH LOOP — not retrying",
+                              file=sys.stderr)
+                        return rc
+                    continue
+                reshards += 1
+                attempt -= 1
+                try:
+                    fitted = supervision.fit_axis_sizes(cur_sizes, int(visible))
+                except ValueError as e:
+                    print(f"train_cluster: no mesh fits {visible} devices "
+                          f"({e}) — giving up", file=sys.stderr)
+                    return rc
+                old_dp = cur_sizes.get("data", 1)
+                new_batch, new_accum, preserved = (cur_batch, cur_accum, False)
+                if old_dp > 0:
+                    new_batch, new_accum, preserved = \
+                        supervision.rescale_for_devices(
+                            cur_batch, cur_accum, old_dp, fitted.get("data", 1))
+                if not preserved:
+                    new_batch, new_accum = cur_batch, cur_accum
+                overrides = [f"mesh.{a}={v}" for a, v in fitted.items()]
+                overrides.append("checkpoint.allow_reshard=true")
+                if preserved:
+                    overrides += [f"data.global_batch_size={new_batch}",
+                                  f"train.grad_accum_steps={new_accum}"]
+                env[supervision.ELASTIC_OVERRIDES_ENV] = ",".join(overrides)
+                print(f"train_cluster: elastic reshard #{reshards} (rc={rc}) — "
+                      f"mesh {_fmt_axes(cur_sizes)} -> {_fmt_axes(fitted)} on "
+                      f"{visible} devices — relaunching immediately",
+                      file=sys.stderr)
+                writer.emit(telemetry.KIND_MESH_RESIZED,
+                            attempt=attempt + 1, rc=rc, reshards=reshards,
+                            from_axes=dict(cur_sizes), to_axes=dict(fitted),
+                            visible_devices=int(visible), process_count=active,
+                            global_batch=new_batch, grad_accum=new_accum,
+                            effective_batch_preserved=preserved,
+                            overrides=" ".join(overrides),
+                            last_step=last_step, ckpt_step=ckpt_step)
+                writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                            attempt=attempt + 1, rc=rc,
+                            classification="elastic_reshard", reshards=reshards,
+                            process_id=worker, process_count=active,
+                            last_step=last_step, ckpt_step=ckpt_step)
+                cur_sizes, cur_batch, cur_accum = fitted, new_batch, new_accum
+                if reshards >= args.max_reshards:
+                    print("train_cluster: topology churn exceeded "
+                          f"--max-reshards={args.max_reshards} — giving up",
+                          file=sys.stderr)
+                    return rc
+                continue
+
+            if rc == supervision.ANOMALY_ESCALATION_RC:
+                failures += 1
+                print(f"train_cluster: attempt {attempt} exited rc={rc} "
+                      f"(persistent_anomaly on worker {worker}; "
+                      f"last_step={last_step}, ckpt_step={ckpt_step})",
+                      file=sys.stderr)
+                writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                            attempt=attempt, rc=rc,
+                            classification="persistent_anomaly",
+                            process_id=worker, process_count=active,
+                            last_step=last_step, ckpt_step=ckpt_step)
+                if worker is not None:
+                    breaker.record(worker, rc=rc, last_step=last_step,
+                                   ckpt_step=ckpt_step, transient=True)
+                if attempt < args.max_attempts:
+                    delay = supervision.backoff_seconds(
+                        failures, base=args.retry_sleep, cap=args.backoff_max,
+                        jitter=args.jitter)
+                    print(f"train_cluster: backing off {delay:.1f}s",
+                          file=sys.stderr)
+                    time.sleep(delay)
+                continue
+
+            if worker is not None and not hung and llc.is_bind_failure(
+                    llc.log_tail(llc.log_path(args.workdir, worker))):
+                # The coordinator lost the free-port bind race at boot: pure
+                # launch-infrastructure noise, not a training failure —
+                # relaunch on a fresh port (chosen per attempt) for free.
+                attempt -= 1
+                print(f"train_cluster: worker {worker} lost the port-bind "
+                      "race — relaunching the gang on a fresh port",
+                      file=sys.stderr)
+                writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                            attempt=attempt + 1, rc=rc,
+                            classification="port_race",
+                            process_id=worker, process_count=active,
+                            last_step=last_step, ckpt_step=ckpt_step)
+                continue
+
             failures += 1
+            classification = "hung" if hung else "crashed"
             print(f"train_cluster: attempt {attempt} exited rc={rc} "
-                  f"(persistent_anomaly on worker {worker}; "
+                  f"({classification} on worker {worker}, "
                   f"last_step={last_step}, ckpt_step={ckpt_step})",
                   file=sys.stderr)
             writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                        attempt=attempt, rc=rc,
-                        classification="persistent_anomaly",
-                        process_id=worker, process_count=active,
+                        attempt=attempt, rc=rc, classification=classification,
+                        hung=hung, process_id=worker, process_count=active,
                         last_step=last_step, ckpt_step=ckpt_step)
-            if worker is not None:
-                breaker.record(worker, rc=rc, last_step=last_step,
-                               ckpt_step=ckpt_step, transient=True)
+            # Supervisor-observed crash/hang: dump the flight recorder — the
+            # ring holds the attempt/restart-gap spans and the attempt
+            # events leading to this fault, plus the open supervisor.run.
+            flightrec.dump(f"worker {worker} {classification} (rc={rc})")
+            if worker is not None and breaker.record(
+                    worker, rc=rc, last_step=last_step, ckpt_step=ckpt_step,
+                    hung=hung):
+                report = breaker.report(worker)
+                print(f"train_cluster: CRASH LOOP on worker {worker} — "
+                      "deterministic failure, not retrying:\n"
+                      + json.dumps(report, indent=2), file=sys.stderr)
+                writer.emit(telemetry.KIND_CRASH_LOOP, **report)
+                return rc
             if attempt < args.max_attempts:
                 delay = supervision.backoff_seconds(
                     failures, base=args.retry_sleep, cap=args.backoff_max,
@@ -632,51 +712,14 @@ def main(argv=None) -> int:
                 print(f"train_cluster: backing off {delay:.1f}s",
                       file=sys.stderr)
                 time.sleep(delay)
-            continue
-
-        if worker is not None and not hung and llc.is_bind_failure(
-                llc.log_tail(llc.log_path(args.workdir, worker))):
-            # The coordinator lost the free-port bind race at boot: pure
-            # launch-infrastructure noise, not a training failure —
-            # relaunch on a fresh port (chosen per attempt) for free.
-            attempt -= 1
-            print(f"train_cluster: worker {worker} lost the port-bind "
-                  "race — relaunching the gang on a fresh port",
-                  file=sys.stderr)
-            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                        attempt=attempt + 1, rc=rc,
-                        classification="port_race",
-                        process_id=worker, process_count=active,
-                        last_step=last_step, ckpt_step=ckpt_step)
-            continue
-
-        failures += 1
-        classification = "hung" if hung else "crashed"
-        print(f"train_cluster: attempt {attempt} exited rc={rc} "
-              f"({classification} on worker {worker}, "
-              f"last_step={last_step}, ckpt_step={ckpt_step})",
-              file=sys.stderr)
-        writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                    attempt=attempt, rc=rc, classification=classification,
-                    hung=hung, process_id=worker, process_count=active,
-                    last_step=last_step, ckpt_step=ckpt_step)
-        if worker is not None and breaker.record(
-                worker, rc=rc, last_step=last_step, ckpt_step=ckpt_step,
-                hung=hung):
-            report = breaker.report(worker)
-            print(f"train_cluster: CRASH LOOP on worker {worker} — "
-                  "deterministic failure, not retrying:\n"
-                  + json.dumps(report, indent=2), file=sys.stderr)
-            writer.emit(telemetry.KIND_CRASH_LOOP, **report)
-            return rc
-        if attempt < args.max_attempts:
-            delay = supervision.backoff_seconds(
-                failures, base=args.retry_sleep, cap=args.backoff_max,
-                jitter=args.jitter)
-            print(f"train_cluster: backing off {delay:.1f}s",
-                  file=sys.stderr)
-            time.sleep(delay)
-    return rc
+        return rc
+    finally:
+        # Every exit path (done, cancelled, crash loop, churn caps)
+        # closes the gang's root span; a SIGKILLed supervisor leaves
+        # it open for the flight recorder's open-span snapshot.
+        root.end(status="ok" if rc == 0 else f"rc_{rc}",
+                 attempts=attempt, failures=failures,
+                 reshards=reshards, preemptions=preemptions)
 
 
 if __name__ == "__main__":

@@ -74,6 +74,7 @@ from distributed_tensorflow_framework_tpu.core import (  # noqa: E402
     faults,
     supervision,
     telemetry,
+    tracing,
 )
 from distributed_tensorflow_framework_tpu.core.platform import (  # noqa: E402
     FAST_FAIL_COLLECTIVE_FLAGS,
@@ -330,186 +331,240 @@ def main(argv=None) -> int:
             pass
 
     env = build_env()
+    # Same trace shape as the gang supervisor (scripts/train_cluster.py):
+    # supervisor.run root → supervisor.attempt per attempt, the attempt's
+    # context handed to the child via DTF_TRACE_CTX so its worker.run
+    # span (train/loop.py) parents on it; restart gaps are retroactive
+    # spans between attempts.
+    tracer = tracing.Tracer(writer, service="supervisor")
+    flightrec = tracing.FlightRecorder(
+        512, dump_dir=ckpt_dir or None, tracer=tracer).attach(writer)
+    flightrec.install_sigusr1()
+    root = tracer.start("supervisor.run", None,
+                        command=" ".join(cmd)[:200])
     breaker = supervision.CrashLoopBreaker(args.crash_loop_threshold)
     rc = 1
     attempt = failures = preemptions = reshards = 0
+    prev_end_mono: float | None = None
     # Elastic state: what the child's mesh/batch currently are (command
     # line + any refit overrides already applied), and the device count
     # a drop_devices drill has masked the child to (None = unmasked).
     cur_sizes, cur_batch, cur_accum = parse_training_params(cmd)
     masked_devices: int | None = None
-    while attempt < args.max_attempts:
-        attempt += 1
-        # The supervisor-side fault point: drop_devices drills fire here,
-        # keyed on the 1-based attempt ordinal, and shrink/grow the
-        # child's visible device set (CPU stand-in for losing a slice —
-        # on real TPUs the devices disappear by themselves).
-        for fault in faults.fire("relaunch", step=attempt):
-            if fault.kind != "drop_devices":
-                continue
-            masked_devices = fault.devices
-            if env.get("JAX_PLATFORMS", "").split(",")[0] != "cpu":
-                print("train_resilient: WARNING — drop_devices masks the "
-                      "virtual-CPU host device count; JAX_PLATFORMS is "
-                      "not cpu, the mask may have no effect",
+    try:
+        while attempt < args.max_attempts:
+            attempt += 1
+            if prev_end_mono is not None:
+                # Retroactive span for the dead time between attempts (backoff
+                # + relaunch latency) so the restart gap lands on the trace's
+                # critical path instead of vanishing between siblings.
+                tracer.emit_span("supervisor.restart_gap", root,
+                                 start_mono=prev_end_mono,
+                                 end_mono=time.monotonic(),
+                                 before_attempt=attempt)
+            attempt_span = tracer.start("supervisor.attempt", root,
+                                        attempt=attempt)
+            env[tracing.TRACE_CTX_ENV] = attempt_span.context().encode()
+            # The supervisor-side fault point: drop_devices drills fire here,
+            # keyed on the 1-based attempt ordinal, and shrink/grow the
+            # child's visible device set (CPU stand-in for losing a slice —
+            # on real TPUs the devices disappear by themselves).
+            for fault in faults.fire("relaunch", step=attempt):
+                if fault.kind != "drop_devices":
+                    continue
+                masked_devices = fault.devices
+                if env.get("JAX_PLATFORMS", "").split(",")[0] != "cpu":
+                    print("train_resilient: WARNING — drop_devices masks the "
+                          "virtual-CPU host device count; JAX_PLATFORMS is "
+                          "not cpu, the mask may have no effect",
+                          file=sys.stderr)
+                env["XLA_FLAGS"] = supervision.mask_host_device_count(
+                    env.get("XLA_FLAGS", ""), masked_devices)
+                print(f"train_resilient: drop_devices drill — child device "
+                      f"set masked to {masked_devices}", file=sys.stderr)
+            print(f"train_resilient: attempt {attempt}/{args.max_attempts}",
+                  file=sys.stderr)
+            rc, hung, child_pid = _run_attempt(
+                cmd, env, hb_path=hb_path, hb_timeout=args.heartbeat_timeout,
+                hb_poll=args.heartbeat_poll, startup_grace=args.startup_grace)
+            if rc < 0:
+                # Child died to a signal (e.g. the XLA terminate timeout's
+                # SIGABRT → -6): report the shell's 128+signal convention so
+                # outer automation can classify the failure (134 = SIGABRT).
+                rc = 128 - rc
+            attempt_span.end(status="ok" if rc == 0 else f"rc_{rc}",
+                             rc=rc, hung=hung)
+            prev_end_mono = time.monotonic()
+            # Progress accounting for the crash-loop breaker: the heartbeat
+            # record only counts when the just-dead child wrote it (pid match);
+            # a predecessor's stale record would fake forward progress.
+            hb = supervision.read_heartbeat(hb_path) if hb_path else None
+            last_step = None
+            if hb and hb.get("pid") in (None, child_pid):
+                last_step = hb.get("last_completed_step", hb.get("step"))
+            ckpt_step = latest_committed_step(ckpt_dir) if ckpt_dir else None
+
+            if rc == 0:
+                print(f"train_resilient: done (attempt {attempt})",
                       file=sys.stderr)
-            env["XLA_FLAGS"] = supervision.mask_host_device_count(
-                env.get("XLA_FLAGS", ""), masked_devices)
-            print(f"train_resilient: drop_devices drill — child device "
-                  f"set masked to {masked_devices}", file=sys.stderr)
-        print(f"train_resilient: attempt {attempt}/{args.max_attempts}",
-              file=sys.stderr)
-        rc, hung, child_pid = _run_attempt(
-            cmd, env, hb_path=hb_path, hb_timeout=args.heartbeat_timeout,
-            hb_poll=args.heartbeat_poll, startup_grace=args.startup_grace)
-        if rc < 0:
-            # Child died to a signal (e.g. the XLA terminate timeout's
-            # SIGABRT → -6): report the shell's 128+signal convention so
-            # outer automation can classify the failure (134 = SIGABRT).
-            rc = 128 - rc
-        # Progress accounting for the crash-loop breaker: the heartbeat
-        # record only counts when the just-dead child wrote it (pid match);
-        # a predecessor's stale record would fake forward progress.
-        hb = supervision.read_heartbeat(hb_path) if hb_path else None
-        last_step = None
-        if hb and hb.get("pid") in (None, child_pid):
-            last_step = hb.get("last_completed_step", hb.get("step"))
-        ckpt_step = latest_committed_step(ckpt_dir) if ckpt_dir else None
-
-        if rc == 0:
-            print(f"train_resilient: done (attempt {attempt})",
-                  file=sys.stderr)
-            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                        attempt=attempt, rc=0, classification="done",
-                        last_step=last_step, ckpt_step=ckpt_step)
-            return 0
-        if _cancelled or rc in (130, 143):
-            # SIGINT/SIGTERM death — or a signal we forwarded ourselves —
-            # is CANCELLATION, not infrastructure failure: honor the
-            # operator instead of relaunching. (A supervisor-level SIGTERM
-            # also ends the loop when the child preempted gracefully: the
-            # whole tree is being evicted, relaunching would fight the
-            # scheduler.)
-            print(f"train_resilient: child cancelled (rc={rc}) — "
-                  "not retrying", file=sys.stderr)
-            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                        attempt=attempt, rc=rc, classification="cancelled",
-                        last_step=last_step, ckpt_step=ckpt_step)
-            return rc
-        if rc == supervision.GRACEFUL_PREEMPT_RC:
-            preemptions += 1
-            attempt -= 1  # graceful preemption never consumes the budget
-            print(f"train_resilient: graceful preemption (rc={rc}, "
-                  f"#{preemptions}) — relaunching immediately",
-                  file=sys.stderr)
-            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                        attempt=attempt + 1, rc=rc,
-                        classification="preempted", preemptions=preemptions,
-                        last_step=last_step, ckpt_step=ckpt_step)
-            if preemptions >= args.max_preemptions:
-                print("train_resilient: preemption churn exceeded "
-                      f"--max-preemptions={args.max_preemptions} — giving "
-                      "up", file=sys.stderr)
-                return rc
-            continue
-
-        if rc == supervision.ELASTIC_RESHARD_RC:
-            # The child could not build its mesh on the devices it saw —
-            # a topology change, not a failure. Refit and relaunch
-            # without consuming an attempt or feeding the breaker.
-            report = supervision.read_device_report(ckpt_dir) if ckpt_dir \
-                else None
-            visible = (report or {}).get("visible_devices") or masked_devices
-            if not visible:
-                failures += 1
-                print(f"train_resilient: attempt {attempt} exited rc={rc} "
-                      "(elastic) but left no device report — treating as a "
-                      "plain failure", file=sys.stderr)
                 writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                            attempt=attempt, rc=rc,
-                            classification="elastic_no_report",
+                            attempt=attempt, rc=0, classification="done",
                             last_step=last_step, ckpt_step=ckpt_step)
-                if breaker.record(rc=rc, last_step=last_step,
-                                  ckpt_step=ckpt_step):
-                    print("train_resilient: CRASH LOOP — not retrying",
+                return 0
+            if _cancelled or rc in (130, 143):
+                # SIGINT/SIGTERM death — or a signal we forwarded ourselves —
+                # is CANCELLATION, not infrastructure failure: honor the
+                # operator instead of relaunching. (A supervisor-level SIGTERM
+                # also ends the loop when the child preempted gracefully: the
+                # whole tree is being evicted, relaunching would fight the
+                # scheduler.)
+                print(f"train_resilient: child cancelled (rc={rc}) — "
+                      "not retrying", file=sys.stderr)
+                writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                            attempt=attempt, rc=rc, classification="cancelled",
+                            last_step=last_step, ckpt_step=ckpt_step)
+                return rc
+            if rc == supervision.GRACEFUL_PREEMPT_RC:
+                preemptions += 1
+                attempt -= 1  # graceful preemption never consumes the budget
+                print(f"train_resilient: graceful preemption (rc={rc}, "
+                      f"#{preemptions}) — relaunching immediately",
+                      file=sys.stderr)
+                writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                            attempt=attempt + 1, rc=rc,
+                            classification="preempted", preemptions=preemptions,
+                            last_step=last_step, ckpt_step=ckpt_step)
+                if preemptions >= args.max_preemptions:
+                    print("train_resilient: preemption churn exceeded "
+                          f"--max-preemptions={args.max_preemptions} — giving "
+                          "up", file=sys.stderr)
+                    return rc
+                continue
+
+            if rc == supervision.ELASTIC_RESHARD_RC:
+                # The child could not build its mesh on the devices it saw —
+                # a topology change, not a failure. Refit and relaunch
+                # without consuming an attempt or feeding the breaker.
+                report = supervision.read_device_report(ckpt_dir) if ckpt_dir \
+                    else None
+                visible = (report or {}).get("visible_devices") or masked_devices
+                if not visible:
+                    failures += 1
+                    print(f"train_resilient: attempt {attempt} exited rc={rc} "
+                          "(elastic) but left no device report — treating as a "
+                          "plain failure", file=sys.stderr)
+                    writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                                attempt=attempt, rc=rc,
+                                classification="elastic_no_report",
+                                last_step=last_step, ckpt_step=ckpt_step)
+                    if breaker.record(rc=rc, last_step=last_step,
+                                      ckpt_step=ckpt_step):
+                        print("train_resilient: CRASH LOOP — not retrying",
+                              file=sys.stderr)
+                        return rc
+                    continue
+                reshards += 1
+                attempt -= 1  # topology changes never consume the budget
+                breaker.record(rc=rc, last_step=last_step, ckpt_step=ckpt_step,
+                               transient=True)
+                try:
+                    fitted = supervision.fit_axis_sizes(cur_sizes, int(visible))
+                except ValueError as e:
+                    print(f"train_resilient: no mesh fits {visible} devices "
+                          f"({e}) — giving up", file=sys.stderr)
+                    return rc
+                old_dp = cur_sizes.get("data", 1)
+                new_batch, new_accum, preserved = (cur_batch, cur_accum, False)
+                if old_dp > 0:
+                    new_batch, new_accum, preserved = \
+                        supervision.rescale_for_devices(
+                            cur_batch, cur_accum, old_dp, fitted.get("data", 1))
+                if not preserved:
+                    print("train_resilient: WARNING — could not preserve the "
+                          f"effective batch across {_fmt_axes(cur_sizes)} -> "
+                          f"{_fmt_axes(fitted)}; keeping "
+                          f"global_batch={cur_batch}, accum={cur_accum}",
+                          file=sys.stderr)
+                    new_batch, new_accum = cur_batch, cur_accum
+                overrides = [f"mesh.{a}={v}" for a, v in fitted.items()]
+                overrides.append("checkpoint.allow_reshard=true")
+                if preserved:
+                    overrides += [f"data.global_batch_size={new_batch}",
+                                  f"train.grad_accum_steps={new_accum}"]
+                env[supervision.ELASTIC_OVERRIDES_ENV] = ",".join(overrides)
+                print(f"train_resilient: elastic reshard #{reshards} (rc={rc}) "
+                      f"— mesh {_fmt_axes(cur_sizes)} -> {_fmt_axes(fitted)} on "
+                      f"{visible} devices, global_batch {cur_batch} -> "
+                      f"{new_batch}, grad_accum {cur_accum} -> {new_accum} — "
+                      "relaunching immediately", file=sys.stderr)
+                writer.emit(telemetry.KIND_MESH_RESIZED,
+                            attempt=attempt + 1, rc=rc, reshards=reshards,
+                            from_axes=dict(cur_sizes), to_axes=dict(fitted),
+                            visible_devices=int(visible),
+                            global_batch=new_batch, grad_accum=new_accum,
+                            effective_batch_preserved=preserved,
+                            overrides=" ".join(overrides),
+                            last_step=last_step, ckpt_step=ckpt_step)
+                writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                            attempt=attempt + 1, rc=rc,
+                            classification="elastic_reshard", reshards=reshards,
+                            last_step=last_step, ckpt_step=ckpt_step)
+                cur_sizes, cur_batch, cur_accum = fitted, new_batch, new_accum
+                if reshards >= args.max_reshards:
+                    print("train_resilient: topology churn exceeded "
+                          f"--max-reshards={args.max_reshards} — giving up",
                           file=sys.stderr)
                     return rc
                 continue
-            reshards += 1
-            attempt -= 1  # topology changes never consume the budget
-            breaker.record(rc=rc, last_step=last_step, ckpt_step=ckpt_step,
-                           transient=True)
-            try:
-                fitted = supervision.fit_axis_sizes(cur_sizes, int(visible))
-            except ValueError as e:
-                print(f"train_resilient: no mesh fits {visible} devices "
-                      f"({e}) — giving up", file=sys.stderr)
-                return rc
-            old_dp = cur_sizes.get("data", 1)
-            new_batch, new_accum, preserved = (cur_batch, cur_accum, False)
-            if old_dp > 0:
-                new_batch, new_accum, preserved = \
-                    supervision.rescale_for_devices(
-                        cur_batch, cur_accum, old_dp, fitted.get("data", 1))
-            if not preserved:
-                print("train_resilient: WARNING — could not preserve the "
-                      f"effective batch across {_fmt_axes(cur_sizes)} -> "
-                      f"{_fmt_axes(fitted)}; keeping "
-                      f"global_batch={cur_batch}, accum={cur_accum}",
-                      file=sys.stderr)
-                new_batch, new_accum = cur_batch, cur_accum
-            overrides = [f"mesh.{a}={v}" for a, v in fitted.items()]
-            overrides.append("checkpoint.allow_reshard=true")
-            if preserved:
-                overrides += [f"data.global_batch_size={new_batch}",
-                              f"train.grad_accum_steps={new_accum}"]
-            env[supervision.ELASTIC_OVERRIDES_ENV] = ",".join(overrides)
-            print(f"train_resilient: elastic reshard #{reshards} (rc={rc}) "
-                  f"— mesh {_fmt_axes(cur_sizes)} -> {_fmt_axes(fitted)} on "
-                  f"{visible} devices, global_batch {cur_batch} -> "
-                  f"{new_batch}, grad_accum {cur_accum} -> {new_accum} — "
-                  "relaunching immediately", file=sys.stderr)
-            writer.emit(telemetry.KIND_MESH_RESIZED,
-                        attempt=attempt + 1, rc=rc, reshards=reshards,
-                        from_axes=dict(cur_sizes), to_axes=dict(fitted),
-                        visible_devices=int(visible),
-                        global_batch=new_batch, grad_accum=new_accum,
-                        effective_batch_preserved=preserved,
-                        overrides=" ".join(overrides),
-                        last_step=last_step, ckpt_step=ckpt_step)
-            writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                        attempt=attempt + 1, rc=rc,
-                        classification="elastic_reshard", reshards=reshards,
-                        last_step=last_step, ckpt_step=ckpt_step)
-            cur_sizes, cur_batch, cur_accum = fitted, new_batch, new_accum
-            if reshards >= args.max_reshards:
-                print("train_resilient: topology churn exceeded "
-                      f"--max-reshards={args.max_reshards} — giving up",
-                      file=sys.stderr)
-                return rc
-            continue
 
-        if rc == supervision.ANOMALY_ESCALATION_RC:
-            # The child's IN-PROCESS recovery ladder (train/anomaly.py)
-            # exhausted max_rollbacks on one incident: a poisoned data
-            # region or deterministic numeric bug, already diagnosed and
-            # telemetered by the child. Relaunching from the checkpoint is
-            # still the right move (the restored iterator has advanced past
-            # part of the region), but this is NOT a crash signature — the
-            # breaker's streak must not accumulate toward "deterministic
-            # bug, stop retrying" on a failure mode the child already
-            # classified. Attempts are still consumed (bounded retries).
+            if rc == supervision.ANOMALY_ESCALATION_RC:
+                # The child's IN-PROCESS recovery ladder (train/anomaly.py)
+                # exhausted max_rollbacks on one incident: a poisoned data
+                # region or deterministic numeric bug, already diagnosed and
+                # telemetered by the child. Relaunching from the checkpoint is
+                # still the right move (the restored iterator has advanced past
+                # part of the region), but this is NOT a crash signature — the
+                # breaker's streak must not accumulate toward "deterministic
+                # bug, stop retrying" on a failure mode the child already
+                # classified. Attempts are still consumed (bounded retries).
+                failures += 1
+                print(f"train_resilient: attempt {attempt} exited rc={rc} "
+                      f"(persistent_anomaly — the child exhausted its in-process "
+                      f"rollback ladder; last_step={last_step}, "
+                      f"ckpt_step={ckpt_step})", file=sys.stderr)
+                writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
+                            attempt=attempt, rc=rc,
+                            classification="persistent_anomaly",
+                            last_step=last_step, ckpt_step=ckpt_step)
+                breaker.record(rc=rc, last_step=last_step, ckpt_step=ckpt_step,
+                               transient=True)
+                if attempt < args.max_attempts:
+                    delay = supervision.backoff_seconds(
+                        failures, base=args.retry_sleep, cap=args.backoff_max,
+                        jitter=args.jitter)
+                    print(f"train_resilient: backing off {delay:.1f}s",
+                          file=sys.stderr)
+                    time.sleep(delay)
+                continue
+
             failures += 1
+            classification = "hung" if hung else "crashed"
             print(f"train_resilient: attempt {attempt} exited rc={rc} "
-                  f"(persistent_anomaly — the child exhausted its in-process "
-                  f"rollback ladder; last_step={last_step}, "
+                  f"({classification}, last_step={last_step}, "
                   f"ckpt_step={ckpt_step})", file=sys.stderr)
             writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                        attempt=attempt, rc=rc,
-                        classification="persistent_anomaly",
-                        last_step=last_step, ckpt_step=ckpt_step)
-            breaker.record(rc=rc, last_step=last_step, ckpt_step=ckpt_step,
-                           transient=True)
+                        attempt=attempt, rc=rc, classification=classification,
+                        hung=hung, last_step=last_step, ckpt_step=ckpt_step)
+            flightrec.dump(f"child {classification} (rc={rc})",
+                           open_spans=tracer.open_spans())
+            if breaker.record(rc=rc, last_step=last_step, ckpt_step=ckpt_step,
+                              hung=hung):
+                report = breaker.report()
+                print("train_resilient: CRASH LOOP — deterministic failure, "
+                      "not retrying:\n" + json.dumps(report, indent=2),
+                      file=sys.stderr)
+                writer.emit(telemetry.KIND_CRASH_LOOP, **report)
+                return rc
             if attempt < args.max_attempts:
                 delay = supervision.backoff_seconds(
                     failures, base=args.retry_sleep, cap=args.backoff_max,
@@ -517,32 +572,11 @@ def main(argv=None) -> int:
                 print(f"train_resilient: backing off {delay:.1f}s",
                       file=sys.stderr)
                 time.sleep(delay)
-            continue
-
-        failures += 1
-        classification = "hung" if hung else "crashed"
-        print(f"train_resilient: attempt {attempt} exited rc={rc} "
-              f"({classification}, last_step={last_step}, "
-              f"ckpt_step={ckpt_step})", file=sys.stderr)
-        writer.emit(telemetry.KIND_SUPERVISOR_ATTEMPT,
-                    attempt=attempt, rc=rc, classification=classification,
-                    hung=hung, last_step=last_step, ckpt_step=ckpt_step)
-        if breaker.record(rc=rc, last_step=last_step, ckpt_step=ckpt_step,
-                          hung=hung):
-            report = breaker.report()
-            print("train_resilient: CRASH LOOP — deterministic failure, "
-                  "not retrying:\n" + json.dumps(report, indent=2),
-                  file=sys.stderr)
-            writer.emit(telemetry.KIND_CRASH_LOOP, **report)
-            return rc
-        if attempt < args.max_attempts:
-            delay = supervision.backoff_seconds(
-                failures, base=args.retry_sleep, cap=args.backoff_max,
-                jitter=args.jitter)
-            print(f"train_resilient: backing off {delay:.1f}s",
-                  file=sys.stderr)
-            time.sleep(delay)
-    return rc
+        return rc
+    finally:
+        root.end(status="ok" if rc == 0 else f"rc_{rc}",
+                 attempts=attempt, failures=failures,
+                 reshards=reshards, preemptions=preemptions)
 
 
 if __name__ == "__main__":

@@ -1,4 +1,4 @@
-"""Fixture telemetry: every kind summarized and test-referenced."""
+"""Fixture telemetry: every kind summarized, formatted and test-referenced."""
 
 KIND_GOOD = "good"
 KIND_OTHER = "other"
@@ -9,4 +9,5 @@ def summarize_events(events):
 
 
 def format_run_summary(summary):
-    return str(summary)
+    # KIND_GOOD rollup line; KIND_OTHER rollup line.
+    return f"good={summary[KIND_GOOD]} other={summary[KIND_OTHER]}"

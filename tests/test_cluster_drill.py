@@ -27,6 +27,8 @@ preflight contract as chip_window_queue.sh §0b/§15.  The supervisor's
 decision logic itself is covered without JAX in tests/test_cluster.py.
 """
 
+import glob
+import json
 import os
 import re
 import shutil
@@ -42,6 +44,8 @@ sys.path.insert(0, REPO)
 
 from distributed_tensorflow_framework_tpu.core import goodput  # noqa: E402
 from distributed_tensorflow_framework_tpu.core import telemetry  # noqa: E402
+from distributed_tensorflow_framework_tpu.core import tracing  # noqa: E402
+from scripts import analyze_trace  # noqa: E402
 
 SCRIPT = os.path.join(REPO, "scripts", "train_cluster.py")
 
@@ -160,6 +164,56 @@ def test_kill_worker_gang_restart_resumes_bit_exact(tmp_path, gang_capability):
     assert g["buckets"]["restart_gap"] > 0
     # Per-host section joins both workers' streams by process_id.
     assert "0" in (g.get("per_host") or {}), sorted(g)
+
+    # Tracing: the whole recovery is ONE supervisor-rooted trace across
+    # three processes — supervisor.run → supervisor.attempt per attempt,
+    # each attempt parenting both workers' worker.run spans via
+    # DTF_TRACE_CTX, with the restart gap a span on the critical path.
+    traces = analyze_trace.build_traces(
+        analyze_trace.collect_spans(analyze_trace._events_files(str(ck))))
+    sup = [t for t in traces
+           if any(s["name"] == "supervisor.run" for s in t["spans"])]
+    assert len(sup) == 1, [t["trace"] for t in traces]
+    tree = sup[0]
+    names = [s["name"] for s in tree["spans"]]
+    assert names.count("supervisor.attempt") >= 2, names
+    assert "supervisor.restart_gap" in names
+    workers = {s["service"] for s in tree["spans"]
+               if s["name"] == "worker.run"}
+    assert {"worker0", "worker1"} <= workers, workers
+    by_id = {s["span"]: s for s in tree["spans"]}
+    for s in tree["spans"]:
+        if s["name"] == "worker.run":
+            assert by_id[s["parent"]]["name"] == "supervisor.attempt", s
+    assert analyze_trace.critical_path(tree)["restart_gap"] > 0
+
+    # Flight recorders fired on both sides of the fault: the supervisor
+    # dumped when it classified the crash (ring holds the crashed
+    # attempt's span; the still-open supervisor.run is its parent), and
+    # the SIGTERMed survivor flushed its telemetry and dumped before the
+    # supervisor's SIGKILL grace expired (the dump existing at all is
+    # the satellite-2 durability pin).
+    dumps = [json.loads(open(p).read())
+             for p in glob.glob(str(ck / "flightrec-*.json"))]
+    assert dumps, "no flight-recorder dump under the checkpoint dir"
+    sup_dump = next(d for d in dumps if "crashed" in d["reason"])
+    ring_spans = [(e.get("extra") or {}).get("name")
+                  for e in sup_dump["events"]
+                  if e.get("kind") == telemetry.KIND_SPAN]
+    assert "supervisor.attempt" in ring_spans, ring_spans
+    assert any(s["name"] == "supervisor.run"
+               for s in sup_dump["open_spans"])
+    assert any(d["reason"] == "graceful_preemption" for d in dumps), \
+        [d["reason"] for d in dumps]
+    assert all(d["schema"] == tracing.FLIGHTREC_SCHEMA for d in dumps)
+
+    # Perfetto export for the tier driver's artifact dir.
+    trace_dir = os.environ.get("DTF_TRACE_DIR")
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        assert analyze_trace.main(
+            [str(ck), "--spans", "--perfetto",
+             os.path.join(trace_dir, "GANG_TRACE.json")]) == 0
 
 
 def test_drop_worker_refits_gang_without_consuming_attempt(tmp_path, gang_capability):

@@ -40,7 +40,7 @@ from test_train_lenet import lenet_config
 from distributed_tensorflow_framework_tpu.cli.fleet import (
     make_replica_launcher,
 )
-from distributed_tensorflow_framework_tpu.core import faults, telemetry
+from distributed_tensorflow_framework_tpu.core import faults, telemetry, tracing
 from distributed_tensorflow_framework_tpu.serve import (
     FleetRouter,
     export_checkpoint,
@@ -116,8 +116,10 @@ def test_fleet_chaos_drill(devices, tmp_path):
     launcher = make_replica_launcher(
         art_dir, str(log_dir),
         ["serve.max_batch_size=8", "serve.max_wait_ms=5"])
+    recorder = tracing.FlightRecorder(
+        256, dump_dir=str(log_dir)).attach(writer)
     router = FleetRouter(serve_cfg, telemetry_writer=writer,
-                         launcher=launcher)
+                         launcher=launcher, flight_recorder=recorder)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     serve_thread = threading.Thread(target=router.serve_forever,
                                     daemon=True)
@@ -229,6 +231,65 @@ def test_fleet_chaos_drill(devices, tmp_path):
         assert summary["fleet"]["restarts"] >= 1
         text = telemetry.format_run_summary(summary)
         assert "fleet:" in text and "ejections:" in text
+        assert summary["spans"]["count"] > 0  # KIND_SPAN rode the stream
+
+        # 9. One causal story per request: every load_gen request carried
+        # a fresh trace context, and a request that survived the chaos by
+        # retrying stitches into ONE tree — router root, the failed
+        # attempt, the retried attempt, and the winning replica's
+        # queue/batch/compute spans from its own events stream.
+        from scripts import analyze_trace
+        assert len(run["trace_ids"]) == 300  # per-request ids, /2-additive
+        trace_ids = set(run["trace_ids"])
+        span_paths = [p for p in (
+            events_path,
+            *(str(log_dir / f"r{i}" / "events.jsonl") for i in range(3)),
+        ) if os.path.exists(p)]
+        traces = analyze_trace.build_traces(
+            analyze_trace.collect_spans(span_paths))
+        retried = None
+        for t in traces:
+            if t["trace"] not in trace_ids:
+                continue
+            names = {s["name"] for s in t["spans"]}
+            failed = any(s["name"] == "fleet.attempt"
+                         and s["status"] != "ok" for s in t["spans"])
+            won = any(s["name"] == "fleet.attempt"
+                      and s["status"] == "ok" for s in t["spans"])
+            if failed and won and "engine.compute" in names:
+                retried = t
+                break
+        assert retried is not None, \
+            "no retried request produced a full router→engine trace tree"
+        assert [r["name"] for r in retried["roots"]] == ["router.request"]
+        assert {"router.request", "fleet.attempt", "serve.request",
+                "engine.queue", "engine.batch",
+                "engine.compute"} <= {s["name"] for s in retried["spans"]}
+        cp = analyze_trace.critical_path(retried)
+        assert cp["retry"] > 0, cp  # the failed attempt cost is visible
+
+        # Perfetto export: valid Chrome trace-event JSON, archived for
+        # the tier driver when DTF_TRACE_DIR is set.
+        trace_dir = os.environ.get("DTF_TRACE_DIR") or str(tmp_path)
+        os.makedirs(trace_dir, exist_ok=True)
+        perfetto_path = os.path.join(trace_dir, "FLEET_TRACE.json")
+        assert analyze_trace.main(
+            [str(log_dir),
+             *(str(log_dir / f"r{i}") for i in range(3)),
+             "--spans", "--perfetto", perfetto_path]) == 0
+        doc = json.loads(pathlib.Path(perfetto_path).read_text())
+        assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+
+        # 10. The flight recorder dumped when the prober saw r0 die; the
+        # dump's ring holds the fault's causal neighborhood (the eject
+        # record and the spans that led up to it).
+        rec_path = str(log_dir / f"flightrec-{os.getpid()}.json")
+        assert os.path.exists(rec_path), os.listdir(str(log_dir))
+        rec = json.loads(pathlib.Path(rec_path).read_text())
+        assert rec["schema"] == tracing.FLIGHTREC_SCHEMA
+        assert "r0" in rec["reason"] and "dead" in rec["reason"]
+        kinds = {e.get("kind") for e in rec["events"]}
+        assert telemetry.KIND_SERVE_EJECT in kinds, sorted(kinds)
     finally:
         faults.install(None)
         clean = router.shutdown("drill teardown")

@@ -514,6 +514,10 @@ def test_summarize_rolls_up_every_kind(tmp_path):
            reason="stale healthz")
     w.emit(telemetry.KIND_SERVE_RELOAD, metrics={"reload_ms": 120.0},
            replica="r0", ok=True, from_digest="aaaa", to_digest="bbbb")
+    w.emit(telemetry.KIND_SPAN, metrics={"dur_ms": 12.5},
+           trace="t" * 16, span="s" * 16, parent=None,
+           name="serve.request", service="replica0", status="ok",
+           t_start=1000.0, offset_s=0.0, attrs=None)
     w.emit(telemetry.KIND_GOODPUT, step=5,
            metrics={"wall_s": 10.0, "goodput_frac": 0.8},
            buckets={"step_compute": 8.0, "other": 2.0},
@@ -551,6 +555,8 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert s["goodput"]["goodput_frac"] == pytest.approx(0.8)
     assert s["memory"]["samples"] == 1
     assert s["memory"]["peak_bytes_in_use"] == 200
+    assert s["spans"]["count"] == 1 and s["spans"]["traces"] == 1
+    assert s["spans"]["services"] == {"replica0": 1}
     text = telemetry.format_run_summary(s)
     assert "run: config_name=lenet" in text
     assert "evals: 1 (last at step 2)" in text
@@ -563,4 +569,5 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert "fleet: 1 proxied" in text and "ejections: 1" in text
     assert "zero update sharding: 8 shards, 3 buckets" in text
     assert "goodput: 80.0% of 10.0 s wall over 1 attempt(s)" in text
+    assert "spans: 1 across 1 trace(s) [replica0=1]" in text
     assert "memory: 1 sample(s)" in text

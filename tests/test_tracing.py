@@ -1,0 +1,318 @@
+"""Distributed tracing + flight recorder (core/tracing.py) unit tests.
+
+Covers the pieces the end-to-end drills depend on but cannot isolate:
+the SpanContext codec, the Tracer's span emission as ``KIND_SPAN``
+telemetry, the clock model under injected wall skew (the analyzer must
+reconstruct a causally ordered tree from ±200 ms-skewed per-process
+streams — the satellite-3 stitching guarantee), the flight recorder's
+bounded ring + dump format, and the ``--spans`` analyzer surface
+(trace trees, critical path, Perfetto export).
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_tensorflow_framework_tpu.core import cluster, telemetry, tracing
+from scripts import analyze_trace
+
+
+def _spans_from(path: str) -> list[dict]:
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            ev = json.loads(line)
+            if ev.get("kind") == telemetry.KIND_SPAN:
+                out.append(ev)
+    return out
+
+
+# --------------------------------------------------------------- codec --
+
+def test_span_context_round_trips():
+    ctx = tracing.SpanContext("abcd1234abcd1234", "ef567890", 1723.456789)
+    back = tracing.SpanContext.parse(ctx.encode())
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sent_at == pytest.approx(ctx.sent_at, abs=1e-6)
+
+
+def test_span_context_empty_span_id_round_trips():
+    # A pure-client root (scripts/load_gen.py) names a trace but no span.
+    ctx = tracing.fresh_context(now=10.0)
+    back = tracing.SpanContext.parse(ctx.encode())
+    assert back.span_id == ""
+    assert back.trace_id == ctx.trace_id
+
+
+@pytest.mark.parametrize("bad", ["", "nocolons", "a:b", "t:s:notafloat",
+                                 ":span:1.0"])
+def test_span_context_parse_rejects_malformed(bad):
+    with pytest.raises(tracing.TraceContextError):
+        tracing.SpanContext.parse(bad)
+
+
+def test_safe_parse_answers_none_not_raise():
+    assert tracing.safe_parse(None) is None
+    assert tracing.safe_parse("garbage") is None
+    assert tracing.safe_parse("t:s:1.0").trace_id == "t"
+
+
+def test_env_context_reads_the_propagation_var():
+    ctx = tracing.fresh_context(now=5.0)
+    environ = {tracing.TRACE_CTX_ENV: ctx.encode()}
+    got = tracing.env_context(environ)
+    assert got is not None and got.trace_id == ctx.trace_id
+    assert tracing.env_context({}) is None
+
+
+def test_worker_env_carries_trace_ctx():
+    # core/cluster.py hands the supervisor's attempt context to every
+    # gang worker through the same env the discovery triple rides.
+    ctx = tracing.fresh_context(now=1.0)
+    env = cluster.worker_env(
+        {}, coordinator_port=1234, num_processes=2, process_id=1,
+        devices_per_proc=1, trace_ctx=ctx.encode())
+    assert tracing.env_context(env).trace_id == ctx.trace_id
+    untouched = cluster.worker_env(
+        {tracing.TRACE_CTX_ENV: ctx.encode()}, coordinator_port=1234,
+        num_processes=2, process_id=0, devices_per_proc=1)
+    assert tracing.env_context(untouched).trace_id == ctx.trace_id
+
+
+# -------------------------------------------------------------- tracer --
+
+def test_span_emits_kind_span_event(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    writer = telemetry.TelemetryWriter(path, run_id="t")
+    tracer = tracing.Tracer(writer, service="svc")
+    root = tracer.start("root.op", None, key="val")
+    child = tracer.start("child.op", root)
+    child.end()
+    root.end(status="ok", extra_attr=2)
+    writer.close()
+    spans = _spans_from(path)
+    assert [s["extra"]["name"] for s in spans] == ["child.op", "root.op"]
+    c, r = spans
+    assert c["extra"]["trace"] == r["extra"]["trace"]
+    assert c["extra"]["parent"] == r["extra"]["span"]
+    assert r["extra"]["parent"] is None
+    assert r["extra"]["service"] == "svc"
+    assert r["extra"]["attrs"] == {"key": "val", "extra_attr": 2}
+    assert r["metrics"]["dur_ms"] >= 0.0
+    # Schema-additive: a span event is a valid dtf-telemetry/1 record.
+    assert telemetry.validate_event(r) == []
+
+
+def test_span_end_is_idempotent(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    writer = telemetry.TelemetryWriter(path, run_id="t")
+    tracer = tracing.Tracer(writer)
+    span = tracer.start("op")
+    assert span.end()
+    assert span.end() == {}  # crash paths may race the normal end
+    writer.close()
+    assert len(_spans_from(path)) == 1
+
+
+def test_emit_span_backfills_from_monotonic_readings(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    writer = telemetry.TelemetryWriter(path, run_id="t")
+    tracer = tracing.Tracer(writer, service="engine")
+    import time
+    t0 = time.monotonic()
+    ev = tracer.emit_span("engine.batch", None, start_mono=t0 - 0.05,
+                          end_mono=t0, rows=4)
+    writer.close()
+    assert ev["extra"]["name"] == "engine.batch"
+    assert ev["metrics"]["dur_ms"] == pytest.approx(50.0, abs=5.0)
+    assert tracer.open_spans() == []  # backfill is never left open
+
+
+def test_open_spans_snapshot_until_ended():
+    tracer = tracing.Tracer(None, service="w")
+    span = tracer.start("worker.run", None, process=0)
+    snaps = tracer.open_spans()
+    assert len(snaps) == 1 and snaps[0]["name"] == "worker.run"
+    assert snaps[0]["open"] is True
+    span.end()
+    assert tracer.open_spans() == []
+
+
+def test_adopt_estimates_clock_offset():
+    sender = tracing.Tracer(None, service="sup", skew_s=0.0)
+    receiver = tracing.Tracer(None, service="wk", skew_s=0.2)
+    span = sender.start("supervisor.attempt")
+    receiver.adopt(span.context())
+    # Receiver runs 200 ms fast; transmission here is ~instant, so the
+    # estimate is dominated by the injected skew.
+    assert receiver.offset_s == pytest.approx(0.2, abs=0.05)
+    span.end()
+
+
+# -------------------------------------------- cross-process stitching --
+
+def _two_process_trace(tmp_path, skew_a: float, skew_b: float):
+    """Parent span in stream A, child span in stream B, with injected
+    wall skews — returns the run dir holding both events files."""
+    pa = str(tmp_path / "events.jsonl")
+    pb = str(tmp_path / "events-p1.jsonl")
+    wa = telemetry.TelemetryWriter(pa, run_id="g")
+    wb = telemetry.TelemetryWriter(pb, run_id="g")
+    ta = tracing.Tracer(wa, service="supervisor", skew_s=skew_a)
+    tb = tracing.Tracer(wb, service="worker0", skew_s=skew_b)
+    root = ta.start("supervisor.run")
+    attempt = ta.start("supervisor.attempt", root, attempt=1)
+    tb.adopt(attempt.context())
+    child = tb.start("worker.run", attempt.context())
+    child.end()
+    attempt.end()
+    root.end()
+    wa.close()
+    wb.close()
+    return str(tmp_path)
+
+
+@pytest.mark.parametrize("skew_a,skew_b", [(0.0, 0.2), (0.2, -0.2)])
+def test_skewed_streams_stitch_into_one_ordered_tree(tmp_path, skew_a,
+                                                     skew_b):
+    """±200 ms wall skew between processes must not break causal order:
+    after offset subtraction + the causal clamp, every child starts at
+    or after its parent in the reconstructed tree (satellite 3)."""
+    run_dir = _two_process_trace(tmp_path, skew_a, skew_b)
+    spans = analyze_trace.collect_spans(
+        analyze_trace._events_files(run_dir))
+    traces = analyze_trace.build_traces(spans)
+    assert len(traces) == 1
+    t = traces[0]
+    by_id = {s["span"]: s for s in t["spans"]}
+    assert {s["name"] for s in t["spans"]} == {
+        "supervisor.run", "supervisor.attempt", "worker.run"}
+    assert len(t["roots"]) == 1
+    assert t["roots"][0]["name"] == "supervisor.run"
+    for s in t["spans"]:
+        parent = by_id.get(s["parent"])
+        if parent is not None:
+            assert s["t0"] >= parent["t0"] - 1e-9, (s, parent)
+
+
+def test_trace_tree_text_and_critical_path(tmp_path):
+    run_dir = _two_process_trace(tmp_path, 0.0, 0.1)
+    spans = analyze_trace.collect_spans(
+        analyze_trace._events_files(run_dir))
+    traces = analyze_trace.build_traces(spans)
+    text = analyze_trace.format_trace_tree(traces[0])
+    assert "supervisor.run" in text
+    # Child indented under parent, one level per hop.
+    lines = text.splitlines()
+    run_i = next(i for i, ln in enumerate(lines)
+                 if "supervisor.run" in ln)
+    worker_i = next(i for i, ln in enumerate(lines) if "worker.run" in ln)
+    assert worker_i > run_i
+    cp = analyze_trace.critical_path(traces[0])
+    assert cp["total"] == pytest.approx(traces[0]["dur_ms"])
+
+
+def test_unparented_spans_become_roots_not_lost(tmp_path):
+    # A crashed process may never emit the parent: its children must
+    # surface as extra roots instead of silently disappearing.
+    path = str(tmp_path / "events.jsonl")
+    writer = telemetry.TelemetryWriter(path, run_id="t")
+    tracer = tracing.Tracer(writer, service="r0")
+    orphan_parent = tracing.SpanContext("feedface00000000", "dead0001", 0.0)
+    span = tracer.start("serve.request", orphan_parent)
+    span.end()
+    writer.close()
+    traces = analyze_trace.build_traces(
+        analyze_trace.collect_spans([path]))
+    assert len(traces) == 1
+    assert traces[0]["roots"][0]["name"] == "serve.request"
+
+
+def test_perfetto_export_shape(tmp_path):
+    run_dir = _two_process_trace(tmp_path, 0.0, 0.05)
+    traces = analyze_trace.build_traces(
+        analyze_trace.collect_spans(analyze_trace._events_files(run_dir)))
+    doc = analyze_trace.perfetto_export(traces)
+    events = doc["traceEvents"]
+    complete = [e for e in events if e["ph"] == "X"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert len(complete) == 3
+    assert {e["args"]["name"] for e in meta} == {"supervisor", "worker0"}
+    for e in complete:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert isinstance(e["pid"], int)
+    # The whole doc must be JSON-serializable (the export contract).
+    json.dumps(doc)
+
+
+def test_summarize_spans_cli(tmp_path, capsys):
+    run_dir = _two_process_trace(tmp_path, 0.0, 0.0)
+    perfetto = str(tmp_path / "perfetto.json")
+    rc = analyze_trace.main([run_dir, "--spans", "--json", "-"])
+    assert rc == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert obj["schema"] == analyze_trace.TRACE_SPANS_SCHEMA
+    assert len(obj["traces"]) == 1
+    assert analyze_trace.main(
+        [run_dir, "--spans", "--perfetto", perfetto]) == 0
+    with open(perfetto) as fh:
+        assert json.load(fh)["traceEvents"]
+    # No spans anywhere → exit 2, not a traceback.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert analyze_trace.main([str(empty), "--spans"]) == 2
+
+
+# ------------------------------------------------------ flight recorder --
+
+def test_flight_recorder_ring_is_bounded(tmp_path):
+    rec = tracing.FlightRecorder(4, dump_dir=str(tmp_path))
+    for i in range(10):
+        rec.record({"kind": "x", "i": i})
+    path = rec.dump("test")
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert doc["schema"] == tracing.FLIGHTREC_SCHEMA
+    assert doc["event_count"] == 4
+    assert [e["i"] for e in doc["events"]] == [6, 7, 8, 9]
+    assert doc["reason"] == "test"
+
+
+def test_flight_recorder_rejects_bad_capacity(tmp_path):
+    with pytest.raises(ValueError):
+        tracing.FlightRecorder(0, dump_dir=str(tmp_path))
+
+
+def test_flight_recorder_attach_captures_writer_events(tmp_path):
+    writer = telemetry.TelemetryWriter(
+        str(tmp_path / "events.jsonl"), run_id="t")
+    tracer = tracing.Tracer(writer, service="svc")
+    rec = tracing.FlightRecorder(
+        8, dump_dir=str(tmp_path), tracer=tracer).attach(writer)
+    open_span = tracer.start("worker.run")
+    done = tracer.start("ckpt.save", open_span)
+    done.end()
+    path = rec.dump("fault")
+    writer.close()
+    with open(path) as fh:
+        doc = json.load(fh)
+    # The ended span rode the listener into the ring; the still-open
+    # ancestor appears in open_spans so the dump shows the fault's
+    # causal neighborhood even though worker.run never finished.
+    assert any((e.get("extra") or {}).get("name") == "ckpt.save"
+               for e in doc["events"])
+    assert [s["name"] for s in doc["open_spans"]] == ["worker.run"]
+    open_span.end()
+
+
+def test_flight_recorder_default_path_honors_trace_dir(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv(tracing.TRACE_DIR_ENV, str(tmp_path))
+    rec = tracing.FlightRecorder(2)
+    assert rec.default_path() == os.path.join(
+        str(tmp_path), f"flightrec-{os.getpid()}.json")
+    # Explicit dump_dir wins over the env.
+    rec2 = tracing.FlightRecorder(2, dump_dir=str(tmp_path / "sub"))
+    assert rec2.default_path().startswith(str(tmp_path / "sub"))

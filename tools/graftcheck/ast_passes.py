@@ -275,13 +275,22 @@ def telemetry_coverage_pass(ctx: RepoContext) -> list[Finding]:
             "summarize_events/format_run_summary not found — the rollup "
             "surface moved; update the pass", severity="internal-error"))
         return findings
-    rollup_src = "".join(p for p in rollup_parts if p)
+    summarize_src, format_src = (p or "" for p in rollup_parts)
+    rollup_src = summarize_src + format_src
     corpus = "".join(ctx.source(p) for p in ctx.test_files())
     for name in kinds:
-        if name not in rollup_src:
+        # Per-part check: an event accumulated by summarize_events but
+        # never surfaced by format_run_summary (or vice versa) is still
+        # invisible in post-mortems — each part must name the kind (a
+        # per-kind rollup comment counts; the convention makes the
+        # printed line greppable back to its constant).
+        missing = [fn for fn, src in (("summarize_events", summarize_src),
+                                      ("format_run_summary", format_src))
+                   if name not in src]
+        if missing:
             findings.append(Finding(
                 "telemetry-kind-coverage", f"{rel}:{name}",
-                f"{name} has no summarize_events/format_run_summary rollup "
+                f"{name} has no rollup in {' or '.join(missing)} "
                 f"— the event is invisible in exactly the post-mortems it "
                 f"was added for"))
         if name not in corpus:
