@@ -9,8 +9,10 @@ Each replica is a ``cli/serve.py`` subprocess on an ephemeral port with
 its own log dir (``<log_dir>/r{i}/``); the router (serve/fleet.py)
 load-balances ``POST /predict`` across them with hedged retries, ejects
 and readmits them on health, restarts dead ones through the supervision
-machinery, and walks ``POST /reload`` across the fleet one drained
-replica at a time. The router's resolved endpoint lands in
+machinery, walks ``POST /reload`` across the fleet one drained replica
+at a time, and — with ``serve.fleet_autoscale=true`` — grows/shrinks
+the replica set from live pressure (serve/autoscale.py), enforcing the
+``X-DTF-Tenant`` QoS contract at the front door. The router's resolved endpoint lands in
 ``<log_dir>/endpoint.json`` — same contract as the single server, so
 scripts/load_gen.py points at a fleet unchanged.
 
@@ -122,11 +124,14 @@ def main(argv=None) -> int:
         replicas=replicas)
 
     # Replica serve.* knobs ride through verbatim; router-only knobs
-    # (host/port/log_dir) are overridden per replica by the launcher.
+    # (host/port/log_dir, the fleet_* control loop, tenant_* QoS — all
+    # enforced at the front door, never inside a replica) are overridden
+    # per replica by the launcher or simply withheld.
     passthrough = [o for o in args.overrides
                    if not o.startswith(("serve.port=", "serve.host=",
                                         "serve.log_dir=",
-                                        "serve.fleet_"))]
+                                        "serve.fleet_",
+                                        "serve.tenant_"))]
     launcher = make_replica_launcher(
         os.path.abspath(artifact_dir), log_dir, passthrough)
     # Router-side flight recorder: ring of recent route/attempt/eject

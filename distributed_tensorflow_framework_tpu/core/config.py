@@ -638,6 +638,43 @@ class ServeConfig:
     # attempts; the crash-loop breaker can stop earlier).
     fleet_max_restarts: int = 8
 
+    # ---- Autoscaler (serve/autoscale.py, driven from the prober tick) ----
+    # Master switch for the closed control loop. Off (default), the fleet
+    # stays at the fixed fleet_replicas count — exactly the PR 14 behavior.
+    fleet_autoscale: bool = False
+    # Hard bounds on live (non-retired, non-given-up) replicas. The
+    # autoscaler never drains below min or spawns above max, no matter
+    # what the pressure signal says.
+    fleet_min_replicas: int = 1
+    fleet_max_replicas: int = 8
+    # Hysteresis band on fleet pressure (0..1-ish utilization: queued +
+    # in-flight + chaos-injected synthetic load over admitted capacity).
+    # Scale up at/above the up threshold, down at/below the down
+    # threshold; the gap between them is what keeps the loop from
+    # flapping on a noisy signal. A shed since the last decision forces
+    # pressure to at least the up threshold (shedding IS saturation).
+    fleet_scale_up_threshold: float = 0.75
+    fleet_scale_down_threshold: float = 0.25
+    # Minimum seconds between scaling actions (either direction), so one
+    # spike produces a measured ramp instead of a thundering spawn herd.
+    fleet_scale_cooldown_s: float = 30.0
+
+    # ---- Multi-tenant QoS at the router (X-DTF-Tenant header) ----
+    # Priority class assumed when a request carries no tenant header.
+    # Known classes, best-first: "high", "default", "batch".
+    tenant_default_class: str = "default"
+    # Queue slots per replica reserved per priority step: a class that is
+    # p steps below "high" may only claim a replica whose load is under
+    # queue_capacity - p * reserve. Under exact-capacity load this sheds
+    # batch strictly before default before high. 0 = classless routing.
+    tenant_priority_reserve: int = 1
+    # Per-tenant token-bucket quota: sustained requests/second and burst
+    # capacity. Breach = HTTP 429 with Retry-After at the router, before
+    # a replica slot is ever claimed. rps 0.0 = quotas off (default).
+    tenant_quota_rps: float = 0.0
+    # Bucket depth; 0 = ceil(tenant_quota_rps), minimum 1.
+    tenant_quota_burst: int = 0
+
 
 @config_dataclass
 class TraceConfig:
@@ -873,6 +910,52 @@ def load_config(
     if srv.queue_capacity < 1:
         raise ValueError(
             f"serve.queue_capacity must be >= 1, got {srv.queue_capacity}"
+        )
+    if srv.fleet_min_replicas < 1:
+        raise ValueError(
+            "serve.fleet_min_replicas must be >= 1, got "
+            f"{srv.fleet_min_replicas}"
+        )
+    if srv.fleet_max_replicas < srv.fleet_min_replicas:
+        raise ValueError(
+            f"serve.fleet_max_replicas={srv.fleet_max_replicas} must be >= "
+            f"serve.fleet_min_replicas={srv.fleet_min_replicas}"
+        )
+    if not (0.0 < srv.fleet_scale_down_threshold
+            < srv.fleet_scale_up_threshold):
+        raise ValueError(
+            "serve autoscaler hysteresis requires 0 < "
+            f"fleet_scale_down_threshold={srv.fleet_scale_down_threshold} < "
+            f"fleet_scale_up_threshold={srv.fleet_scale_up_threshold} — a "
+            f"degenerate or inverted band makes the control loop flap"
+        )
+    if srv.fleet_scale_cooldown_s < 0:
+        raise ValueError(
+            "serve.fleet_scale_cooldown_s must be >= 0, got "
+            f"{srv.fleet_scale_cooldown_s}"
+        )
+    if srv.tenant_priority_reserve < 0:
+        raise ValueError(
+            "serve.tenant_priority_reserve must be >= 0, got "
+            f"{srv.tenant_priority_reserve}"
+        )
+    if srv.tenant_priority_reserve and (
+            2 * srv.tenant_priority_reserve >= srv.queue_capacity):
+        raise ValueError(
+            f"serve.tenant_priority_reserve={srv.tenant_priority_reserve} "
+            f"leaves no claimable capacity for the lowest priority class "
+            f"(2*reserve >= queue_capacity={srv.queue_capacity}) — batch "
+            f"traffic would shed even on an idle fleet"
+        )
+    if srv.tenant_quota_rps < 0:
+        raise ValueError(
+            f"serve.tenant_quota_rps must be >= 0, got "
+            f"{srv.tenant_quota_rps}"
+        )
+    if srv.tenant_quota_burst < 0:
+        raise ValueError(
+            f"serve.tenant_quota_burst must be >= 0, got "
+            f"{srv.tenant_quota_burst}"
         )
     if srv.seq_buckets:
         if (any(int(b) < 1 for b in srv.seq_buckets)

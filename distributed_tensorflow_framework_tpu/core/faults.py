@@ -69,6 +69,21 @@ Spec syntax (``DTF_FAULTS=crash_at_step:120,stall_infeed:30s``):
                      means "stopped forever". The router's hedged
                      per-attempt timeout must route around it and the
                      stale-healthz breaker must eject it.
+  spike:F:S          synthetic traffic spike: for S seconds after the
+                     first fleet chaos tick, the router's autoscaler
+                     sees F extra queued requests per admitted replica
+                     on top of real load — the deterministic stand-in
+                     for a client-side load ramp (scale-up must engage,
+                     bounded by fleet_max_replicas, and real traffic is
+                     never touched). Fired by serve/fleet.py at its
+                     ``fleet_chaos`` point.
+  tenant_stampede:T  low-priority stampede at chaos tick T (optional
+                     duration ``tenant_stampede:T:4s``, default 5s):
+                     synthetic batch-class load saturates every
+                     replica's unreserved queue slots, so batch/default
+                     admission sheds (503 + Retry-After) while the
+                     tenant_priority_reserve headroom keeps high-class
+                     traffic flowing — the QoS-under-saturation drill.
   corrupt_reload     before the next rolling reload begins, truncate the
                      largest payload file of the NEW artifact — every
                      replica's manifest verification must reject the
@@ -163,6 +178,8 @@ KIND_POINTS = {
     "drop_devices": "relaunch",
     "kill_replica": "fleet_chaos",
     "stall_replica": "fleet_chaos",
+    "spike": "fleet_chaos",
+    "tenant_stampede": "fleet_chaos",
     "corrupt_reload": "fleet_reload",
     "kill_worker": "gang_chaos",
     "stall_worker": "gang_chaos",
@@ -184,6 +201,9 @@ class Fault:
     replica: int | None = None
     # kill_worker / stall_worker / drop_worker: the 0-based gang process id.
     worker: int | None = None
+    # spike: synthetic queued requests per admitted replica added to the
+    # autoscaler's pressure signal while the window is open.
+    factor: float | None = None
     # A fault may fire at `count` distinct steps ([step, step+count) —
     # repeat_nan); it is spent once `fires` reaches it.
     count: int = 1
@@ -287,6 +307,46 @@ def _parse_one(entry: str) -> Fault:
         if fault.seconds == 0.0:
             fault.seconds = _STALL_FOREVER_S
         fault.step = 1  # first prober tick, like kill_replica's default
+    elif kind == "spike":
+        head, _, tail = arg.partition(":")
+        raw = tail[:-1] if tail.endswith("s") else tail
+        try:
+            fault.factor = float(head)
+            fault.seconds = float(raw) if raw else 0.0
+        except ValueError:
+            raise ValueError(
+                f"fault spike needs factor:seconds (e.g. spike:6:8s), "
+                f"got {arg!r}"
+            ) from None
+        if fault.factor <= 0:
+            raise ValueError(
+                f"fault spike factor must be > 0, got {arg!r}"
+            )
+        if fault.seconds <= 0:
+            raise ValueError(
+                f"fault spike needs a positive duration, got {arg!r}"
+            )
+        fault.step = 1  # first chaos tick: the spike starts at readiness
+    elif kind == "tenant_stampede":
+        head, _, tail = arg.partition(":")
+        raw = tail[:-1] if tail.endswith("s") else tail
+        try:
+            fault.step = int(head)
+            fault.seconds = float(raw) if raw else 5.0
+        except ValueError:
+            raise ValueError(
+                f"fault tenant_stampede needs tick[:seconds] (e.g. "
+                f"tenant_stampede:3:4s), got {arg!r}"
+            ) from None
+        if fault.step < 1:
+            raise ValueError(
+                f"fault tenant_stampede tick must be >= 1, got {arg!r}"
+            )
+        if fault.seconds <= 0:
+            raise ValueError(
+                f"fault tenant_stampede needs a positive duration, "
+                f"got {arg!r}"
+            )
     elif kind in ("kill_worker", "drop_worker"):
         head, _, tail = arg.partition(":")
         try:

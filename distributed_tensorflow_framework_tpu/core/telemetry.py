@@ -124,6 +124,16 @@ KIND_SERVE_RECOMPILE = "serve_bucket_recompile"
 KIND_SERVE_ROUTE = "serve_route"
 KIND_SERVE_EJECT = "serve_eject"
 KIND_SERVE_RELOAD = "serve_reload"
+# Serving control plane (serve/autoscale.py, docs/SERVING.md): one
+# KIND_SCALE event per autoscaler action (up/down, the pressure reading
+# that triggered it, the replica spawned or drained), and one
+# KIND_ADMISSION event per request the router REJECTED before a replica
+# slot was claimed — quota breach (429) or priority-ordered shed (503) —
+# carrying the tenant, priority class, verdict, and Retry-After. Routed
+# requests carry their tenant on KIND_SERVE_ROUTE instead; together the
+# three kinds are the per-tenant ledger in the run summary.
+KIND_SCALE = "fleet_scale"
+KIND_ADMISSION = "serve_admission"
 # Goodput ledger (core/goodput.py, docs/OBSERVABILITY.md): periodic +
 # end-of-run classification of every wall-clock second into productive
 # step compute vs overhead buckets (infeed wait, recompiles, metric
@@ -443,7 +453,24 @@ def summarize_events(path: str) -> dict:
         "requests": 0, "routed": {}, "retries": 0, "shed": 0,
         "deadline_exceeded": 0, "skew": None,
         "ejects": [], "readmits": 0, "restarts": 0, "reloads": [],
+        # KIND_SCALE: the autoscaler's action ledger (serve/autoscale.py).
+        "scaling": {"ups": 0, "downs": 0, "events": []},
+        # KIND_ADMISSION + tenant-tagged KIND_SERVE_ROUTE: per-tenant
+        # routed/shed/quota ledger with latency percentiles.
+        "tenants": {},
     }
+    tenant_latencies: dict[str, list[float]] = {}
+
+    def _tenant(name: str) -> dict:
+        led = fleet["tenants"].get(name)
+        if led is None:
+            led = {
+                "routed": 0, "shed": 0, "quota_rejected": 0,
+                "latency_ms": None,
+            }
+            fleet["tenants"][name] = led
+        return led
+
     last_collectives: dict | None = None
     # Per-attempt goodput rollups: one ledger per run_id (process); the
     # final rollup wins over periodic snapshots, else the last seen (a
@@ -600,6 +627,40 @@ def summarize_events(path: str) -> dict:
             if rep is not None:
                 rep = str(rep)
                 fleet["routed"][rep] = fleet["routed"].get(rep, 0) + 1
+            tenant = extra.get("tenant")
+            if tenant is not None:
+                led = _tenant(str(tenant))
+                if extra.get("shed"):
+                    led["shed"] += 1
+                else:
+                    led["routed"] += 1
+                    lat = m.get("latency_ms")
+                    if lat is not None:
+                        tenant_latencies.setdefault(
+                            str(tenant), []).append(float(lat))
+        elif kind == KIND_ADMISSION:
+            led = _tenant(str(extra.get("tenant", "default")))
+            if str(extra.get("verdict")) == "quota":
+                led["quota_rejected"] += 1
+            else:
+                led["shed"] += 1
+        elif kind == KIND_SCALE:
+            m = ev.get("metrics") or {}
+            action = str(extra.get("action", ""))
+            scaling = fleet["scaling"]
+            if action == "up":
+                scaling["ups"] += 1
+            elif action == "down":
+                scaling["downs"] += 1
+            # Event order IS the scaling timeline — keep it.
+            scaling["events"].append({
+                "action": action,
+                "reason": extra.get("reason"),
+                "replica": extra.get("replica"),
+                "from_replicas": extra.get("from_replicas"),
+                "to_replicas": extra.get("to_replicas"),
+                "pressure": m.get("pressure"),
+            })
         elif kind == KIND_SERVE_EJECT:
             action = str(extra.get("action", "eject"))
             if action == "readmit":
@@ -695,6 +756,15 @@ def summarize_events(path: str) -> dict:
         counts = list(fleet["routed"].values())
         mean = sum(counts) / len(counts)
         fleet["skew"] = round(max(counts) / mean, 3) if mean else None
+    for tenant, lats in tenant_latencies.items():
+        # Per-tenant latency percentiles over every routed request (the
+        # event file is the reservoir; nearest-rank on the sorted list).
+        lats.sort()
+        n = len(lats)
+        fleet["tenants"][tenant]["latency_ms"] = {
+            p: round(lats[min(n - 1, int(q * n))], 3)
+            for p, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
+        }
     goodput = None
     if goodput_by_run:
         # In-process accounting only: restart gaps BETWEEN attempts need
@@ -741,7 +811,8 @@ def summarize_events(path: str) -> dict:
                             or serve["recompiles"]) else None),
         "fleet": (fleet if (fleet["requests"] or fleet["ejects"]
                             or fleet["readmits"] or fleet["restarts"]
-                            or fleet["reloads"]) else None),
+                            or fleet["reloads"] or fleet["tenants"]
+                            or fleet["scaling"]["events"]) else None),
         "goodput": goodput,
         "memory": (memory if memory["samples"] else None),
         "spans": ({
@@ -944,6 +1015,28 @@ def format_run_summary(summary: dict) -> str:
                 f" -> {str(r.get('to_digest'))[:8]} "
                 + ("ok" if r.get("ok") else "REJECTED")
                 + (f" in {float(ms):.0f} ms" if ms is not None else "")
+            )
+        scaling = fleet.get("scaling") or {}
+        if scaling.get("events"):  # KIND_SCALE rollup (serve/autoscale.py)
+            timeline = ", ".join(
+                f"{e.get('action')}->{e.get('to_replicas')}"
+                + (f"@{float(e['pressure']):.2f}"
+                   if e.get("pressure") is not None else "")
+                for e in scaling["events"])
+            lines.append(
+                f"    scaling: {scaling.get('ups', 0)} up / "
+                f"{scaling.get('downs', 0)} down ({timeline})"
+            )
+        # KIND_ADMISSION rollup: one ledger line per tenant, best class
+        # first so the shed ordering is legible at a glance.
+        for tenant, led in sorted((fleet.get("tenants") or {}).items()):
+            lat = led.get("latency_ms") or {}
+            lines.append(
+                f"    tenant {tenant}: routed {led['routed']}"
+                f", shed {led['shed']}"
+                f", quota_rejected {led['quota_rejected']}"
+                + (f", p50/p90/p99 {lat['p50']}/{lat['p90']}/{lat['p99']} ms"
+                   if lat else "")
             )
     gp = summary.get("goodput")
     if gp:  # KIND_GOODPUT rollup (per-attempt ledgers summed)

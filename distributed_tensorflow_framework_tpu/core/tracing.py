@@ -49,6 +49,7 @@ import logging
 import os
 import signal
 import socket
+import tempfile
 import threading
 import time
 import uuid
@@ -329,6 +330,7 @@ class FlightRecorder:
         self.dump_dir = dump_dir or None
         self.tracer = tracer
         self.dumps = 0
+        self._writer: "telemetry.TelemetryWriter | None" = None
 
     def record(self, event: dict) -> None:
         """TelemetryWriter listener: must be fast, must not raise."""
@@ -337,11 +339,29 @@ class FlightRecorder:
 
     def attach(self, writer: telemetry.TelemetryWriter) -> "FlightRecorder":
         writer.add_listener(self.record)
+        # Remember the writer: its JSONL directory is the run's log dir,
+        # which default_path() prefers over littering the cwd.
+        if self._writer is None:
+            self._writer = writer
         return self
 
     def default_path(self) -> str:
-        base = (self.dump_dir or os.environ.get(TRACE_DIR_ENV) or ".")
-        return os.path.join(base, f"flightrec-{os.getpid()}.json")
+        """Dump location: explicit dump_dir → DTF_TRACE_DIR → the
+        attached writer's log directory → the system temp dir. The
+        writer fallback is what keeps `flightrec-*.json` out of the
+        repo root when tests (or ad-hoc runs) never set the env var —
+        the dump lands next to the run's own telemetry instead. A
+        recorder with no directory clue at all (stderr-only writer,
+        e.g. a supervisor run without checkpoint.directory) dumps to
+        tempfile.gettempdir(): never the process cwd, which under
+        pytest is the repo root."""
+        base = self.dump_dir or os.environ.get(TRACE_DIR_ENV)
+        if not base and self._writer is not None:
+            writer_path = getattr(self._writer, "path", None)
+            if writer_path:
+                base = os.path.dirname(os.path.abspath(writer_path))
+        return os.path.join(base or tempfile.gettempdir(),
+                            f"flightrec-{os.getpid()}.json")
 
     def dump(self, reason: str, *, path: str | None = None,
              open_spans: list[dict] | None = None) -> str | None:

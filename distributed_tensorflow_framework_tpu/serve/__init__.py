@@ -16,11 +16,22 @@ layers, bottom-up:
     contract;
   * serve/fleet.py — the health-aware router over N replica engines:
     least-loaded routing, hedged retries, circuit-breaker eject/readmit,
-    supervised restarts, load shedding, and rolling live weight reloads.
+    supervised restarts, load shedding, rolling live weight reloads,
+    multi-tenant QoS admission, and cross-model multiplexing;
+  * serve/autoscale.py — the pure control-plane policy the router's
+    prober tick runs: hysteresis autoscaling over the fleet pressure
+    signal plus per-tenant token-bucket quotas.
 
 See docs/SERVING.md for the architecture and knob reference.
 """
 
+from distributed_tensorflow_framework_tpu.serve.autoscale import (  # noqa: F401
+    Autoscaler,
+    FleetSnapshot,
+    ScaleDecision,
+    TenantQuotas,
+    priority_of,
+)
 from distributed_tensorflow_framework_tpu.serve.fleet import (  # noqa: F401
     FleetDrainError,
     FleetError,

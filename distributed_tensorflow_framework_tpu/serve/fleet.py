@@ -39,12 +39,32 @@ The moving parts, each mirroring an existing training-side contract:
     reload (the engine's between-batches swap, manifest-verified),
     probe (healthz must report the NEW digest), readmit. A rejected
     reload aborts the roll with every replica still serving weights
-    that passed verification.
+    that passed verification. Optional ``count`` / ``digest`` body
+    fields scope the roll to a subset, which is how one model version
+    rolls while another keeps serving (cross-model multiplexing: the
+    traffic split between artifacts IS the replica allocation, and
+    ``X-DTF-Model: <digest prefix>`` pins a request to one of them).
+  * autoscaling — with ``serve.fleet_autoscale`` the prober tick feeds
+    a fleet pressure snapshot to serve/autoscale.py's hysteresis policy
+    and actuates its verdicts: scale-up spawns ONE replica through the
+    same supervised launch path restarts use (so the crash-loop breaker
+    gates both and a broken artifact can't trigger infinite spawn),
+    scale-down retires the newest admitted replica through the same
+    drain path rolling reloads use, bounded by ``fleet_min_replicas``/
+    ``fleet_max_replicas`` and rate-limited by
+    ``fleet_scale_cooldown_s``.
+  * multi-tenant QoS — ``X-DTF-Tenant`` names a tenant whose class
+    (``high``/``default``/``batch``) decides how much per-replica queue
+    headroom it must leave free (``serve.tenant_priority_reserve``), so
+    under saturation batch sheds strictly before default before high;
+    per-tenant token buckets (``serve.tenant_quota_rps``) answer 429 +
+    Retry-After BEFORE a replica slot is claimed.
 
 Chaos drills ride core/faults.py: ``kill_replica`` / ``stall_replica``
-fire at the prober's ``fleet_chaos`` point, ``corrupt_reload`` at
-``fleet_reload``. Everything observable rides core/telemetry.py
-(KIND_SERVE_ROUTE / KIND_SERVE_EJECT / KIND_SERVE_RELOAD).
+/ ``spike`` / ``tenant_stampede`` fire at the prober's ``fleet_chaos``
+point, ``corrupt_reload`` at ``fleet_reload``. Everything observable
+rides core/telemetry.py (KIND_SERVE_ROUTE / KIND_SERVE_EJECT /
+KIND_SERVE_RELOAD / KIND_SCALE / KIND_ADMISSION).
 
 Stdlib-only by design — the router imports no jax and can front any
 HTTP replica, which is also what keeps its tests in tier 1.
@@ -71,10 +91,15 @@ from distributed_tensorflow_framework_tpu.core import (
     tracing,
 )
 from distributed_tensorflow_framework_tpu.core.config import ServeConfig
+from distributed_tensorflow_framework_tpu.serve import autoscale
 
 log = logging.getLogger(__name__)
 
 _MAX_BODY = 64 * 1024 * 1024  # refuse absurd request bodies outright
+
+# Client-facing QoS / multiplexing headers (docs/SERVING.md).
+TENANT_HEADER = "X-DTF-Tenant"
+MODEL_HEADER = "X-DTF-Model"
 
 
 class FleetError(RuntimeError):
@@ -118,8 +143,12 @@ class Replica:
     url: str = ""
     proc: Any = None  # subprocess.Popen when launcher-managed
     endpoint_path: str = ""  # resolved lazily after (re)launch
-    state: str = "ejected"  # admitted | ejected | draining | dead
+    state: str = "ejected"  # admitted | ejected | draining | dead | retired
     give_up: bool = False  # crash-loop verdict or restart budget spent
+    # Scale-down lifecycle: retiring = drain in progress (claim skips
+    # it, supervision must NOT restart it); retired = drained + gone.
+    retiring: bool = False
+    retire_deadline: float = 0.0
     inflight: int = 0
     routed: int = 0
     consecutive_failures: int = 0
@@ -221,6 +250,30 @@ class FleetRouter:
         self._shed = 0
         self._deadline_exceeded = 0
         self._reload_rolls = 0
+        # Multi-tenant QoS: per-tenant router ledger (routed / shed /
+        # quota_rejected, exposed on /healthz) + the token buckets.
+        self._tenants: dict[str, dict] = {}
+        self._quotas = autoscale.TenantQuotas(
+            serve_cfg.tenant_quota_rps, serve_cfg.tenant_quota_burst)
+        # Chaos windows (core/faults.py spike / tenant_stampede): while
+        # open they inject synthetic per-replica load — spike into the
+        # autoscaler's pressure signal only, stampede into the claim
+        # path too (saturating every unreserved queue slot).
+        self._spike_until = 0.0
+        self._spike_load = 0.0
+        self._stampede_until = 0.0
+        # Autoscaler (serve/autoscale.py): policy object + action ledger.
+        self._autoscaler = (
+            autoscale.Autoscaler(
+                min_replicas=serve_cfg.fleet_min_replicas,
+                max_replicas=serve_cfg.fleet_max_replicas,
+                up_threshold=serve_cfg.fleet_scale_up_threshold,
+                down_threshold=serve_cfg.fleet_scale_down_threshold,
+                cooldown_s=serve_cfg.fleet_scale_cooldown_s,
+            ) if serve_cfg.fleet_autoscale else None)
+        self._scale_ups = 0
+        self._scale_downs = 0
+        self._shed_seen = 0  # shed counter at the last autoscale look
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -322,13 +375,34 @@ class FleetRouter:
 
     # ----------------------------------------------------------- routing
 
-    def _claim_replica(self, exclude: set[int]) -> Replica | None:
+    def _stampede_load(self, now: float) -> int:
+        """Synthetic per-replica load while a tenant_stampede window is
+        open: batch-class traffic has filled every queue slot except the
+        priority reserve, so only classes with reserved headroom route.
+        Caller holds the lock."""
+        if now >= self._stampede_until:
+            return 0
+        return max(0, self.cfg.queue_capacity
+                   - max(1, self.cfg.tenant_priority_reserve))
+
+    def _claim_replica(self, exclude: set[int], *, priority: int = 0,
+                       digest: str | None = None) -> Replica | None:
         """Pick the least-loaded admitted replica (live healthz queue
         depth + router in-flight) and claim an in-flight slot on it.
         None = nothing routable (all ejected, excluded, stalled, or
-        saturated)."""
+        saturated for this priority class).
+
+        QoS: a class ``priority`` steps below high may only claim a
+        replica whose load leaves ``priority * tenant_priority_reserve``
+        queue slots free — under exact-capacity load that sheds batch
+        strictly before default before high. ``digest`` pins the claim
+        to replicas serving a matching artifact (cross-model
+        multiplexing via the X-DTF-Model header)."""
         now = time.monotonic()
+        allowed = (self.cfg.queue_capacity
+                   - priority * self.cfg.tenant_priority_reserve)
         with self._lock:
+            synthetic = self._stampede_load(now)
             best: Replica | None = None
             best_key: tuple | None = None
             for rep in self._replicas:
@@ -336,14 +410,19 @@ class FleetRouter:
                     continue
                 if rep.stalled_until > now:
                     continue  # known-wedged: don't feed it requests
+                if digest:
+                    rep_digest = str((rep.last_health.get("artifact") or {})
+                                     .get("content_digest") or "")
+                    if not rep_digest.startswith(digest):
+                        continue  # serving a different model
                 engine = rep.last_health.get("engine") or {}
                 try:
                     depth = int(engine.get("queue_depth") or 0)
                 except (TypeError, ValueError):
                     depth = 0
-                load = depth + rep.inflight
-                if load >= self.cfg.queue_capacity:
-                    continue  # saturated: the engine would 503 anyway
+                load = depth + rep.inflight + synthetic
+                if load >= allowed:
+                    continue  # saturated for this class: shed, not queue
                 # Tie-break equal load by total routed so sequential
                 # traffic still round-robins instead of pinning r0.
                 key = (load, rep.routed)
@@ -382,6 +461,8 @@ class FleetRouter:
     def _proxy_predict(
             self, body: bytes,
             client_ctx: "tracing.SpanContext | None" = None,
+            *, priority: int = 0, tenant: str | None = None,
+            model_digest: str | None = None,
     ) -> tuple[int, dict, Replica | None, dict]:
         """Deadline-bounded, hedged proxying of one idempotent /predict.
 
@@ -412,9 +493,11 @@ class FleetRouter:
         status, payload = 0, {"error": "no admitted replica"}
         served_by: Replica | None = None
         while attempts <= cfg.fleet_retries:
-            rep = self._claim_replica(tried)
+            rep = self._claim_replica(
+                tried, priority=priority, digest=model_digest)
             if rep is None and tried:
-                rep = self._claim_replica(set())
+                rep = self._claim_replica(
+                    set(), priority=priority, digest=model_digest)
             if rep is None:
                 shed = True
                 break
@@ -476,6 +559,11 @@ class FleetRouter:
                 self._shed += 1
             if deadline_exceeded:
                 self._deadline_exceeded += 1
+            if tenant is not None and not shed:
+                led = self._tenants.setdefault(
+                    tenant,
+                    {"routed": 0, "shed": 0, "quota_rejected": 0})
+                led["routed"] += 1
         if root is not None:
             root.end(
                 status="ok" if status == 200 else (
@@ -486,18 +574,36 @@ class FleetRouter:
                 deadline_exceeded=deadline_exceeded,
                 replica=served_by.label if served_by else None)
         if self._tw:
+            # Sheds carry no tenant here: the KIND_ADMISSION event
+            # handle_predict emits owns the per-tenant shed ledger, so
+            # the run summary never double-counts one rejection.
             self._tw.emit(
                 telemetry.KIND_SERVE_ROUTE,
                 metrics={"latency_ms": latency_ms, "retries": retries,
                          "status": status},
                 replica=served_by.label if served_by else None,
                 shed=shed, deadline_exceeded=deadline_exceeded,
+                tenant=None if shed else tenant,
                 trace=client_ctx.trace_id if client_ctx else None)
         info = {"shed": shed, "deadline_exceeded": deadline_exceeded,
                 "retries": retries}
         return status, payload, served_by, info
 
     # ------------------------------------------------------------ routes
+
+    def _emit_admission(self, tenant: str, priority: int, verdict: str,
+                        retry_after_s: float) -> None:
+        """Record one router-level rejection (quota 429 or shed 503) in
+        the per-tenant ledger and as a KIND_ADMISSION event."""
+        with self._lock:
+            led = self._tenants.setdefault(
+                tenant, {"routed": 0, "shed": 0, "quota_rejected": 0})
+            led["quota_rejected" if verdict == "quota" else "shed"] += 1
+        if self._tw:
+            self._tw.emit(
+                telemetry.KIND_ADMISSION,
+                tenant=tenant, priority=priority, verdict=verdict,
+                retry_after_s=retry_after_s)
 
     def handle_predict(self, handler) -> None:
         if self._draining.is_set():
@@ -511,14 +617,37 @@ class FleetRouter:
             body = handler.rfile.read(length)
             client_ctx = tracing.safe_parse(
                 handler.headers.get(tracing.TRACE_HEADER))
+            tenant = (handler.headers.get(TENANT_HEADER)
+                      or self.cfg.tenant_default_class)
+            priority = autoscale.priority_of(
+                tenant, default_class=self.cfg.tenant_default_class)
+            model_digest = handler.headers.get(MODEL_HEADER) or None
+            # Admission control BEFORE any replica slot is claimed: a
+            # tenant over its token bucket gets 429 + an honest
+            # Retry-After (seconds until the next token refills).
+            verdict = self._quotas.admit(tenant)
+            if not verdict.ok:
+                retry_after = max(0.05, verdict.retry_after_s)
+                self._emit_admission(tenant, priority, "quota", retry_after)
+                handler._reply(
+                    429,
+                    {"error": f"tenant {tenant!r} over quota "
+                              f"({self.cfg.tenant_quota_rps:g} rps)",
+                     "retryable": True, "tenant": tenant},
+                    headers={"Retry-After": f"{retry_after:.3f}"})
+                return
             status, payload, served_by, info = self._proxy_predict(
-                body, client_ctx)
+                body, client_ctx, priority=priority, tenant=tenant,
+                model_digest=model_digest)
             if info["shed"]:
+                self._emit_admission(
+                    tenant, priority, "shed",
+                    self.cfg.fleet_shed_retry_after_s)
                 handler._reply(
                     503,
                     {"error": "fleet saturated or no replica admitted — "
                               "retry after backoff",
-                     "retryable": True, "shed": True},
+                     "retryable": True, "shed": True, "tenant": tenant},
                     headers={"Retry-After":
                              f"{self.cfg.fleet_shed_retry_after_s:g}"})
                 return
@@ -562,7 +691,22 @@ class FleetRouter:
                 handler._reply(
                     400, {"error": "body must be {\"artifact_dir\": ...}"})
                 return
-            results, ok = self.rolling_reload(artifact_dir)
+            count = payload.get("count")
+            if count is not None and (
+                    not isinstance(count, int) or count < 1):
+                handler._reply(
+                    400, {"error": f"count must be a positive int, "
+                                   f"got {count!r}"})
+                return
+            digest = payload.get("digest")
+            if digest is not None and (
+                    not isinstance(digest, str) or not digest):
+                handler._reply(
+                    400, {"error": "digest must be a non-empty string "
+                                   "(content_digest prefix)"})
+                return
+            results, ok = self.rolling_reload(
+                artifact_dir, count=count, only_digest=digest)
             handler._reply(200 if ok else 409,
                            {"reloaded": ok, "replicas": results})
         except FleetError as e:
@@ -616,7 +760,31 @@ class FleetRouter:
                 "deadline_exceeded": self._deadline_exceeded,
                 "reload_rolls": self._reload_rolls,
                 "ticks": self._tick_count,
+                "scale_ups": self._scale_ups,
+                "scale_downs": self._scale_downs,
             }
+            tenants = {t: dict(led) for t, led in self._tenants.items()}
+            # Per-model rollup: the live traffic weights of a
+            # multiplexed fleet (replica allocation per content_digest).
+            models: dict[str, dict] = {}
+            for rep in self._replicas:
+                if rep.state == "retired":
+                    continue
+                dg = (rep.last_health.get("artifact") or {}).get(
+                    "content_digest")
+                if not dg:
+                    continue
+                m = models.setdefault(
+                    str(dg), {"replicas": 0, "routed": 0})
+                m["replicas"] += 1
+                m["routed"] += rep.routed
+            asc = self._autoscaler
+            autoscale_view = ({
+                "enabled": True,
+                "min_replicas": asc.min_replicas,
+                "max_replicas": asc.max_replicas,
+                "pressure": round(asc.last_pressure, 4),
+            } if asc is not None else {"enabled": False})
         admitted = sum(1 for r in reps if r["state"] == "admitted")
         return {
             "status": "draining" if self._draining.is_set() else "ok",
@@ -628,17 +796,28 @@ class FleetRouter:
             "input_spec": base.get("input_spec"),
             "engine": {"state": "running", **engine_agg},
             "fleet": {"replicas": reps, "router": router,
-                      "admitted": admitted},
+                      "admitted": admitted,
+                      "tenants": tenants, "models": models,
+                      "autoscale": autoscale_view},
         }
 
     # ----------------------------------------------------------- reload
 
-    def rolling_reload(self, artifact_dir: str) -> tuple[list[dict], bool]:
+    def rolling_reload(self, artifact_dir: str, *, count: int | None = None,
+                       only_digest: str | None = None
+                       ) -> tuple[list[dict], bool]:
         """Zero-downtime deploy: drain → reload → probe → readmit, one
         replica at a time. The first rejected reload ABORTS the roll —
         a tampered/incompatible artifact must never spread past the
         replica that refused it (every replica keeps serving weights
-        that passed verification either way)."""
+        that passed verification either way).
+
+        ``count`` caps how many replicas roll and ``only_digest`` scopes
+        the roll to replicas currently serving a matching artifact —
+        together they move part of the fleet to a new model while the
+        rest keeps serving the old one (the multiplexing deploy: the
+        per-model traffic weight IS the replica allocation, readable
+        from the healthz ``models`` rollup)."""
         with self._lock:
             if self._rolling:
                 raise FleetError("a rolling reload is already in progress")
@@ -652,15 +831,23 @@ class FleetRouter:
                 targets = [r for r in self._replicas]
             results: list[dict] = []
             ok = True
+            rolled = 0
             for rep in targets:
+                if count is not None and rolled >= count:
+                    break
                 with self._lock:
                     skip = rep.state not in ("admitted", "ejected")
+                    rep_digest = str((rep.last_health.get("artifact") or {})
+                                     .get("content_digest") or "")
+                if only_digest and not rep_digest.startswith(only_digest):
+                    continue  # serving a different model: not in scope
                 if skip:
                     results.append({"replica": rep.label, "ok": False,
                                     "skipped": True, "state": rep.state})
                     continue
                 result = self._reload_replica(rep, artifact_dir)
                 results.append(result)
+                rolled += 1
                 if not result["ok"]:
                     ok = False
                     break
@@ -735,7 +922,25 @@ class FleetRouter:
     def _apply_chaos(self, fault) -> None:
         """Execute a fleet_chaos fault against its target replica (the
         drill harness: kill = SIGKILL the child, stall = SIGSTOP it for
-        fault.seconds — alive, port open, answering nothing)."""
+        fault.seconds — alive, port open, answering nothing). The
+        traffic-shaped kinds (spike / tenant_stampede) target the
+        ROUTER itself: they open a synthetic-load window instead of
+        touching a subprocess."""
+        if fault.kind == "spike":
+            log.warning("chaos: traffic spike +%.0f req/replica for %.0fs",
+                        fault.factor or 0.0, fault.seconds or 0.0)
+            with self._lock:
+                self._spike_until = time.monotonic() + (fault.seconds or 0.0)
+                self._spike_load = float(fault.factor or 0.0)
+            return
+        if fault.kind == "tenant_stampede":
+            log.warning("chaos: tenant stampede for %.0fs (batch-class "
+                        "load saturates unreserved queue slots)",
+                        fault.seconds or 0.0)
+            with self._lock:
+                self._stampede_until = (time.monotonic()
+                                        + (fault.seconds or 0.0))
+            return
         with self._lock:
             target = (self._replicas[fault.replica]
                       if fault.replica is not None
@@ -778,6 +983,10 @@ class FleetRouter:
             return
         with self._lock:
             if rep.state == "dead":
+                return
+            if rep.retiring or rep.state == "retired":
+                # A scale-down victim exiting is the PLAN, not a death:
+                # supervision must not restart what autoscaling drained.
                 return
             rep.state = "dead"
             rep.consecutive_failures = 0
@@ -839,7 +1048,7 @@ class FleetRouter:
         with self._lock:
             state = rep.state
             stalled = rep.stalled_until > now
-        if state in ("dead", "draining") or stalled:
+        if state in ("dead", "draining", "retired") or stalled:
             return
         if not rep.url and rep.endpoint_path:
             url = read_endpoint(rep.endpoint_path)
@@ -881,7 +1090,7 @@ class FleetRouter:
             # means "T ticks after the fleet was ready", deterministic
             # relative to the drill's load instead of racing replica boot.
             if not self._chaos_armed and self._replicas and all(
-                    r.state == "admitted" or r.give_up
+                    r.state in ("admitted", "retired") or r.give_up
                     for r in self._replicas):
                 self._chaos_armed = True
             if self._chaos_armed:
@@ -898,6 +1107,119 @@ class FleetRouter:
             self._check_process(rep, now)
             self._probe_replica(rep, time.monotonic())
         self._restart_due(time.monotonic())
+        self._advance_retirements(time.monotonic())
+        self._autoscale_tick(time.monotonic())
+
+    # ------------------------------------------------------- autoscaling
+
+    def _advance_retirements(self, now: float) -> None:
+        """Finish scale-down drains: once a retiring replica's in-flight
+        hits zero (or its drain budget expires), SIGTERM it — the
+        replica's own graceful drain flushes telemetry — and mark it
+        retired so neither routing nor supervision ever touches it
+        again."""
+        with self._lock:
+            due = [r for r in self._replicas
+                   if r.retiring and r.state == "draining"
+                   and (r.inflight == 0 or now >= r.retire_deadline)]
+            for rep in due:
+                rep.state = "retired"
+        for rep in due:
+            if rep.proc is not None and rep.proc.poll() is None:
+                rep.proc.terminate()
+            log.info("fleet autoscale: %s retired (drained)", rep.label)
+
+    def _emit_scale(self, decision: "autoscale.ScaleDecision",
+                    replica_label: str | None) -> None:
+        log.warning("fleet autoscale: scale %s -> %d replicas (%s)",
+                    decision.action, decision.to_replicas, decision.reason)
+        if self._tw:
+            self._tw.emit(
+                telemetry.KIND_SCALE,
+                metrics={"pressure": decision.pressure},
+                action=decision.action, reason=decision.reason,
+                replica=replica_label,
+                from_replicas=decision.from_replicas,
+                to_replicas=decision.to_replicas)
+
+    def _autoscale_tick(self, now: float) -> None:
+        """One control-loop iteration: snapshot under the lock, let the
+        pure policy decide, actuate at most one action. Scale-up goes
+        through the SAME launcher path supervised restarts use;
+        scale-down marks the newest admitted replica retiring and lets
+        :meth:`_advance_retirements` finish the drain across ticks."""
+        asc = self._autoscaler
+        if asc is None:
+            return
+        with self._lock:
+            synthetic = self._stampede_load(now) + (
+                self._spike_load if now < self._spike_until else 0.0)
+            admitted = booting = draining = give_up = alive = 0
+            load = 0.0
+            for rep in self._replicas:
+                if rep.give_up:
+                    give_up += 1
+                    continue
+                if rep.state == "retired":
+                    continue
+                if rep.retiring:
+                    draining += 1
+                    continue
+                alive += 1
+                if rep.state == "admitted":
+                    admitted += 1
+                    engine = rep.last_health.get("engine") or {}
+                    try:
+                        depth = int(engine.get("queue_depth") or 0)
+                    except (TypeError, ValueError):
+                        depth = 0
+                    load += depth + rep.inflight + synthetic
+                else:
+                    # Spawned/restarting but not yet admitted: it fills
+                    # a hole already — judging pressure now would
+                    # double-spawn for the same gap.
+                    booting += 1
+            shed_delta = self._shed - self._shed_seen
+            self._shed_seen = self._shed
+            snap = autoscale.FleetSnapshot(
+                admitted=admitted, alive=alive, booting=booting,
+                draining=draining, give_up=give_up, load=load,
+                capacity=self.cfg.queue_capacity, shed_delta=shed_delta)
+        decision = asc.decide(snap, now)
+        if decision is None:
+            return
+        if decision.action == "up":
+            if self._launcher is None:
+                log.warning("fleet autoscale: scale-up wanted (%s) but no "
+                            "launcher is configured — skipped",
+                            decision.reason)
+                return
+            with self._lock:
+                index = len(self._replicas)
+            try:
+                proc, endpoint_path = self._launcher(index)
+            except Exception as e:  # noqa: BLE001 — keep the loop alive
+                log.error("fleet autoscale: spawn of r%d failed: %s",
+                          index, e)
+                return
+            rep = self.add_replica(proc=proc, endpoint_path=endpoint_path)
+            with self._lock:
+                self._scale_ups += 1
+            self._emit_scale(decision, rep.label)
+            return
+        # decision.action == "down": retire the newest admitted replica
+        # (LIFO keeps the original fixed fleet as the stable core).
+        with self._lock:
+            victims = [r for r in self._replicas
+                       if r.state == "admitted" and not r.retiring]
+            if not victims:
+                return
+            victim = max(victims, key=lambda r: r.index)
+            victim.state = "draining"
+            victim.retiring = True
+            victim.retire_deadline = now + self.cfg.drain_timeout_s
+            self._scale_downs += 1
+        self._emit_scale(decision, victim.label)
 
     def _probe_loop(self) -> None:
         try:

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import pathlib
 import random
@@ -53,7 +54,63 @@ from distributed_tensorflow_framework_tpu.core.metrics import (  # noqa: E402
 # "trace_ids" (one fresh trace id per request, dispatch order) is a
 # later additive field: join them against the server-side span events to
 # reconstruct any request's causal story (docs/OBSERVABILITY.md).
+# Still-additive later fields: per-run "shape" + "by_tenant" (per-tenant
+# request attribution: requests/ok/errors/by_status, present when
+# --tenants assigns X-DTF-Tenant classes) and the fleet section's
+# "tenants" ledger snapshot from the router's healthz.
 BENCH_SCHEMA = "dtf-serve-bench/2"
+
+# Open-loop traffic shapes (--shape): per-request due times against the
+# base --rate. "uniform" is the PR 14 fixed-rate schedule; the rest
+# replay realistic load for the autoscale drill and chip A/Bs:
+#   spike   — steady rate, then a middle-third burst at --spike-factor x,
+#             then steady again (the scale-up/scale-down round trip).
+#   ramp    — rate climbs linearly from 10% to 100% (slow-building rush).
+#   diurnal — one sinusoidal day: rate swings between 25% and 100%.
+SHAPES = ("uniform", "spike", "ramp", "diurnal")
+
+
+def shape_offsets(n: int, rate: float, shape: str,
+                  spike_factor: float = 4.0) -> list[float]:
+    """Dispatch-time offsets (seconds) for n requests at base ``rate``
+    under a traffic shape. Offsets are cumulative inter-arrival gaps of
+    the instantaneous rate, so the area under the shape is preserved."""
+    if rate <= 0:
+        raise ValueError(f"rate must be > 0, got {rate}")
+    if shape not in SHAPES:
+        raise ValueError(f"unknown shape {shape!r}; known: {SHAPES}")
+    offsets: list[float] = []
+    t = 0.0
+    for i in range(n):
+        frac = i / max(1, n - 1)
+        if shape == "spike":
+            r = rate * (spike_factor if 1 / 3 <= frac < 2 / 3 else 1.0)
+        elif shape == "ramp":
+            r = rate * (0.1 + 0.9 * frac)
+        elif shape == "diurnal":
+            r = rate * (0.625 + 0.375 * math.sin(2 * math.pi * frac))
+        else:
+            r = rate
+        offsets.append(t)
+        t += 1.0 / max(1e-6, r)
+    return offsets
+
+
+def parse_tenants(spec: str | None) -> list[tuple[str, float]]:
+    """``"high=1,batch=3"`` -> [("high", 1.0), ("batch", 3.0)] — the
+    weighted tenant mix each request's X-DTF-Tenant is drawn from."""
+    if not spec:
+        return []
+    mix: list[tuple[str, float]] = []
+    for part in spec.split(","):
+        name, _, weight = part.strip().partition("=")
+        if not name:
+            raise ValueError(f"empty tenant name in {spec!r}")
+        w = float(weight) if weight else 1.0
+        if w <= 0:
+            raise ValueError(f"tenant {name!r} needs weight > 0, got {w}")
+        mix.append((name, w))
+    return mix
 
 
 def resolve_endpoint(endpoint: str) -> str:
@@ -105,16 +162,20 @@ def make_payload(spec: dict, rows: int, *, vocab_size: int,
 
 
 def post_predict(url: str, payload: dict, timeout: float = 60.0,
-                 trace: tracing.SpanContext | None = None) -> tuple:
+                 trace: tracing.SpanContext | None = None,
+                 tenant: str | None = None) -> tuple:
     """(status, latency_ms, rows_returned, replica). Network errors count
     as status 0 — a closed connection mid-drain must not crash the bench.
     ``replica`` is the fleet router's X-DTF-Replica attribution header
     (None against a single server). ``trace`` rides the X-DTF-Trace
-    header so the router/server open spans under this client's trace."""
+    header so the router/server open spans under this client's trace;
+    ``tenant`` rides X-DTF-Tenant for the router's QoS admission."""
     body = json.dumps(payload).encode()
     headers = {"Content-Type": "application/json"}
     if trace is not None:
         headers[tracing.TRACE_HEADER] = trace.encode()
+    if tenant is not None:
+        headers["X-DTF-Tenant"] = tenant
     req = urllib.request.Request(
         url + "/predict", data=body, headers=headers)
     t0 = time.monotonic()
@@ -132,15 +193,19 @@ def post_predict(url: str, payload: dict, timeout: float = 60.0,
 
 
 def _drive(url: str, payloads: list[dict], *, concurrency: int,
-           rate: float | None) -> dict:
-    """Run one mode over pre-built payloads; rate=None → closed loop."""
+           rate: float | None, shape: str = "uniform",
+           spike_factor: float = 4.0,
+           tenants: list[str] | None = None) -> dict:
+    """Run one mode over pre-built payloads; rate=None → closed loop.
+    ``tenants`` is the per-request X-DTF-Tenant assignment (parallel to
+    ``payloads``); ``shape`` bends the open-loop dispatch schedule."""
     latency = PercentileReservoir()
     lock = threading.Lock()
     counts = {"ok": 0, "errors": 0, "rows": 0, "by_status": {},
-              "by_replica": {}}
+              "by_replica": {}, "by_tenant": {}}
     idx = {"next": 0}
 
-    def record(status, ms, rows, replica=None):
+    def record(status, ms, rows, replica=None, tenant=None):
         with lock:
             latency.add(ms)
             key = str(status)
@@ -148,11 +213,23 @@ def _drive(url: str, payloads: list[dict], *, concurrency: int,
             if replica is not None:
                 counts["by_replica"][replica] = \
                     counts["by_replica"].get(replica, 0) + 1
+            if tenant is not None:
+                led = counts["by_tenant"].setdefault(
+                    tenant, {"requests": 0, "ok": 0, "errors": 0,
+                             "by_status": {}})
+                led["requests"] += 1
+                led["by_status"][key] = led["by_status"].get(key, 0) + 1
+                led["ok" if status == 200 else "errors"] += 1
             if status == 200:
                 counts["ok"] += 1
                 counts["rows"] += rows
             else:
                 counts["errors"] += 1
+
+    def one(i: int):
+        tenant = tenants[i] if tenants else None
+        record(*post_predict(url, payloads[i], trace=ctxs[i],
+                             tenant=tenant), tenant=tenant)
 
     # One fresh trace per request: the client is the trace root, so a
     # request that fans out into router attempts / hedges / batches still
@@ -168,22 +245,19 @@ def _drive(url: str, payloads: list[dict], *, concurrency: int,
                     if i >= len(payloads):
                         return
                     idx["next"] = i + 1
-                record(*post_predict(url, payloads[i], trace=ctxs[i]))
+                one(i)
 
         threads = [threading.Thread(target=worker, daemon=True)
                    for _ in range(concurrency)]
     else:  # open loop: dispatch on schedule, completion be damned
-        def fire(payload, ctx):
-            record(*post_predict(url, payload, trace=ctx))
-
+        offsets = shape_offsets(len(payloads), rate, shape,
+                                spike_factor=spike_factor)
         threads = []
-        for i, payload in enumerate(payloads):
-            t_due = t_start + i / rate
-            delay = t_due - time.monotonic()
+        for i in range(len(payloads)):
+            delay = (t_start + offsets[i]) - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
-            t = threading.Thread(target=fire, args=(payload, ctxs[i]),
-                                 daemon=True)
+            t = threading.Thread(target=one, args=(i,), daemon=True)
             threads.append(t)
             t.start()
     if rate is None:
@@ -209,7 +283,11 @@ def _drive(url: str, payloads: list[dict], *, concurrency: int,
         # how evenly did the router actually spread THIS window's traffic.
         **({"by_replica": dict(sorted(counts["by_replica"].items()))}
            if counts["by_replica"] else {}),
-        **({"offered_rate": rate} if rate is not None else
+        # Per-tenant attribution (present when --tenants assigned a mix):
+        # which class absorbed the 429s/503s is the QoS story.
+        **({"by_tenant": dict(sorted(counts["by_tenant"].items()))}
+           if counts["by_tenant"] else {}),
+        **({"offered_rate": rate, "shape": shape} if rate is not None else
            {"concurrency": concurrency}),
         "trace_ids": [c.trace_id for c in ctxs],
     }
@@ -217,7 +295,9 @@ def _drive(url: str, payloads: list[dict], *, concurrency: int,
 
 def run_bench(endpoint: str, *, requests: int = 256, concurrency: int = 32,
               rows: int = 1, rate: float = 100.0, mode: str = "both",
-              seed: int = 0) -> dict:
+              seed: int = 0, shape: str = "uniform",
+              spike_factor: float = 4.0,
+              tenant_mix: str | None = None) -> dict:
     url = resolve_endpoint(endpoint)
     health = fetch_healthz(url)
     spec = health["input_spec"]
@@ -228,13 +308,20 @@ def run_bench(endpoint: str, *, requests: int = 256, concurrency: int = 32,
         make_payload(spec, rows, vocab_size=int(health.get("vocab_size", 2)),
                      rng=rng, seq_buckets=seq_buckets)
         for _ in range(requests)]
+    mix = parse_tenants(tenant_mix)
+    tenants = None
+    if mix:
+        names = [name for name, _ in mix]
+        weights = [w for _, w in mix]
+        tenants = rng.choices(names, weights=weights, k=requests)
     runs = []
     if mode in ("closed", "both"):
         runs.append(_drive(url, payloads, concurrency=concurrency,
-                           rate=None))
+                           rate=None, tenants=tenants))
     if mode in ("open", "both"):
         runs.append(_drive(url, payloads, concurrency=concurrency,
-                           rate=rate))
+                           rate=rate, shape=shape,
+                           spike_factor=spike_factor, tenants=tenants))
     health1 = fetch_healthz(url)
     engine1 = health1.get("engine", {})
     # Against a fleet router: the router-counter deltas over the bench
@@ -252,8 +339,13 @@ def run_bench(endpoint: str, *, requests: int = 256, concurrency: int = 32,
             "router_delta": {
                 key: router1.get(key, 0) - router0.get(key, 0)
                 for key in ("requests", "retries", "shed",
-                            "deadline_exceeded")},
+                            "deadline_exceeded", "scale_ups",
+                            "scale_downs")},
             "admitted": (health1.get("fleet") or {}).get("admitted"),
+            # Router-side per-tenant ledger + autoscaler view at bench
+            # end (additive; absent against pre-QoS routers).
+            "tenants": (health1.get("fleet") or {}).get("tenants"),
+            "autoscale": (health1.get("fleet") or {}).get("autoscale"),
         }
     # Server-side split over the bench window: where did a request's
     # life go — waiting for the admission window, or under compute?
@@ -310,6 +402,15 @@ def main(argv=None) -> int:
                     help="open-loop offered rate (req/s)")
     ap.add_argument("--mode", choices=("closed", "open", "both"),
                     default="both")
+    ap.add_argument("--shape", choices=SHAPES, default="uniform",
+                    help="open-loop traffic shape (spike/ramp/diurnal "
+                         "replay realistic load against the base --rate)")
+    ap.add_argument("--spike-factor", type=float, default=4.0,
+                    help="burst multiplier for --shape spike")
+    ap.add_argument("--tenants", default=None, metavar="NAME=W,...",
+                    help="weighted tenant mix, e.g. 'high=1,batch=3' — "
+                         "each request draws an X-DTF-Tenant class and "
+                         "the bench JSON gains per-tenant attribution")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default="SERVE_BENCH.json")
     args = ap.parse_args(argv)
@@ -317,7 +418,8 @@ def main(argv=None) -> int:
         bench = run_bench(
             args.endpoint, requests=args.requests,
             concurrency=args.concurrency, rows=args.rows, rate=args.rate,
-            mode=args.mode, seed=args.seed)
+            mode=args.mode, seed=args.seed, shape=args.shape,
+            spike_factor=args.spike_factor, tenant_mix=args.tenants)
     except (urllib.error.URLError, OSError, FileNotFoundError) as e:
         print(f"error: cannot reach {args.endpoint}: {e}", file=sys.stderr)
         return 1
@@ -329,6 +431,9 @@ def main(argv=None) -> int:
         print(f"{run['mode']:>6}: {run['ok']}/{run['requests']} ok, "
               f"{run['requests_per_sec']:.1f} req/s, "
               f"p50 {lat['p50']:.1f} ms, p99 {lat['p99']:.1f} ms")
+        for tenant, led in (run.get("by_tenant") or {}).items():
+            print(f"        tenant {tenant}: {led['ok']}/{led['requests']}"
+                  f" ok ({led['by_status']})")
     if bench.get("fleet"):
         delta = bench["fleet"]["router_delta"]
         dist = ", ".join(

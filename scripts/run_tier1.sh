@@ -44,6 +44,15 @@ py_rc=${PIPESTATUS[0]}
 if [ -f "$ART/SERVE_BENCH_FLEET.json" ]; then
   echo "=== serve bench archived: $ART/SERVE_BENCH_FLEET.json ==="
 fi
+# The autoscale drill (tests/test_autoscale_drill.py) archives its
+# shaped-load bench (per-tenant attribution) and the router's raw
+# scaling-event telemetry for the same slow runs.
+if [ -f "$ART/SERVE_BENCH_AUTOSCALE.json" ]; then
+  echo "=== autoscale bench archived: $ART/SERVE_BENCH_AUTOSCALE.json ==="
+fi
+if [ -f "$ART/AUTOSCALE_EVENTS.jsonl" ]; then
+  echo "=== autoscale events archived: $ART/AUTOSCALE_EVENTS.jsonl ==="
+fi
 if [ -f "$ART/GANG_DRILL_EVENTS.jsonl" ]; then
   echo "=== gang drill events archived: $ART/GANG_DRILL_EVENTS.jsonl ==="
 fi

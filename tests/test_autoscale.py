@@ -1,0 +1,612 @@
+"""Fleet autoscaling + multi-tenant QoS (serve/autoscale.py and the
+router's actuation of it) — tier-1 coverage with stub replicas.
+
+Three layers, mirroring how the feature is built:
+
+  * the PURE policy: Autoscaler hysteresis/cooldown/bounds and the
+    TenantQuotas token buckets, driven with explicit clocks so every
+    decision is deterministic (including the one-remaining-token race);
+  * the router's QoS front door over in-process stub replicas: 429 +
+    Retry-After on quota breach, priority-ordered 503 shedding at exact
+    capacity, X-DTF-Model pinned routing, scoped rolling reloads;
+  * the router's actuation of scale decisions through a fake launcher
+    (spawn one / drain one / never fight the restart supervisor) and
+    the KIND_SCALE / KIND_ADMISSION telemetry rollups.
+
+The real thing — subprocess replicas scaling under a shaped load spike
+with a mid-scale kill — is the slow drill in test_autoscale_drill.py.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributed_tensorflow_framework_tpu.core import faults, telemetry
+from distributed_tensorflow_framework_tpu.core.config import ServeConfig
+from distributed_tensorflow_framework_tpu.serve import autoscale
+from distributed_tensorflow_framework_tpu.serve.fleet import FleetRouter
+
+pytestmark = pytest.mark.serve
+
+
+# ------------------------------------------------------ policy: scaling
+
+
+def _snap(**kw):
+    base = dict(admitted=2, alive=2, booting=0, draining=0, give_up=0,
+                load=0.0, capacity=8, shed_delta=0)
+    base.update(kw)
+    return autoscale.FleetSnapshot(**base)
+
+
+def _asc(**kw):
+    base = dict(min_replicas=1, max_replicas=4, up_threshold=0.75,
+                down_threshold=0.25, cooldown_s=10.0, now=100.0)
+    base.update(kw)
+    return autoscale.Autoscaler(**base)
+
+
+def test_priority_classes_and_header_mapping():
+    assert autoscale.priority_of("high") == 0
+    assert autoscale.priority_of("default") == 1
+    assert autoscale.priority_of("batch") == 2
+    # The class is the prefix before ":" — the suffix names the tenant.
+    assert autoscale.priority_of("batch:nightly-eval") == 2
+    # Unknown classes degrade to the configured default, never to high.
+    assert autoscale.priority_of("gold-customer") == 1
+    assert autoscale.priority_of(None) == 1
+    assert autoscale.priority_of("typo", default_class="batch") == 2
+
+
+def test_autoscaler_rejects_degenerate_knobs():
+    with pytest.raises(ValueError, match="min_replicas"):
+        _asc(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        _asc(min_replicas=4, max_replicas=2)
+    with pytest.raises(ValueError, match="hysteresis"):
+        _asc(up_threshold=0.25, down_threshold=0.75)
+
+
+def test_scale_up_on_pressure_bounded_by_max():
+    asc = _asc()
+    # First decision is allowed immediately (no cold-start cooldown).
+    decision = asc.decide(_snap(load=14.0), now=100.0)  # 14/16 = 0.875
+    assert decision.action == "up"
+    assert (decision.from_replicas, decision.to_replicas) == (2, 3)
+    assert decision.pressure == pytest.approx(0.875)
+    # At the max bound the same pressure produces nothing.
+    asc2 = _asc(max_replicas=2)
+    assert asc2.decide(_snap(load=14.0), now=100.0) is None
+
+
+def test_shed_delta_is_saturation_whatever_the_queues_say():
+    asc = _asc()
+    decision = asc.decide(_snap(load=0.0, shed_delta=3), now=100.0)
+    assert decision is not None and decision.action == "up"
+    assert decision.pressure >= asc.up_threshold
+
+
+def test_cooldown_spaces_actions():
+    asc = _asc(cooldown_s=10.0)
+    assert asc.decide(_snap(load=14.0), now=100.0).action == "up"
+    # Inside the cooldown window: still saturated, still silent.
+    assert asc.decide(_snap(load=14.0, alive=3, admitted=3),
+                      now=104.0) is None
+    assert asc.decide(_snap(load=20.0, alive=3, admitted=3),
+                      now=110.0).action == "up"
+
+
+def test_hysteresis_band_holds_steady():
+    asc = _asc()
+    # Pressure between the thresholds: no action in either direction.
+    assert asc.decide(_snap(load=8.0), now=100.0) is None  # 0.5
+    assert asc.last_pressure == pytest.approx(0.5)
+
+
+def test_scale_down_bounded_by_min_and_paused_while_draining():
+    asc = _asc(cooldown_s=0.0)
+    decision = asc.decide(_snap(load=1.0), now=100.0)  # 1/16 = 0.0625
+    assert decision.action == "down"
+    assert (decision.from_replicas, decision.to_replicas) == (2, 1)
+    # At the min bound idleness produces nothing.
+    assert asc.decide(_snap(admitted=1, alive=1, load=0.0),
+                      now=101.0) is None
+    # A drain already in progress must finish before the next verdict.
+    assert asc.decide(_snap(load=0.0, draining=1), now=102.0) is None
+
+
+def test_booting_replica_pauses_decisions():
+    # The spawned-but-not-admitted replica already fills the gap the
+    # pressure shows — deciding again would double-spawn for one spike.
+    asc = _asc()
+    assert asc.decide(_snap(load=16.0, booting=1, alive=3),
+                      now=100.0) is None
+
+
+def test_crash_loop_verdict_blocks_scale_up():
+    asc = _asc()
+    assert asc.decide(_snap(load=16.0, give_up=1), now=100.0) is None
+    # ...but scale-DOWN still works: shrinking a broken fleet is fine.
+    asc2 = _asc(cooldown_s=0.0)
+    decision = asc2.decide(_snap(load=0.0, give_up=1), now=100.0)
+    assert decision is not None and decision.action == "down"
+
+
+def test_supervision_owns_the_nothing_admitted_phase():
+    asc = _asc()
+    assert asc.decide(_snap(admitted=0, alive=2, booting=2, load=0.0,
+                            shed_delta=5), now=100.0) is None
+
+
+# ------------------------------------------------------- policy: quotas
+
+
+def test_quota_refills_across_clock_ticks():
+    q = autoscale.TenantQuotas(2.0, burst=2)
+    t = 100.0
+    assert q.admit("batch", now=t).ok
+    assert q.admit("batch", now=t).ok
+    verdict = q.admit("batch", now=t)
+    assert not verdict.ok
+    # An honest Retry-After: one token refills in 1/rate seconds.
+    assert verdict.retry_after_s == pytest.approx(0.5)
+    # Partial refill is not enough for a whole token.
+    half = q.admit("batch", now=t + 0.25)
+    assert not half.ok and half.retry_after_s == pytest.approx(0.25)
+    # A full tick later the bucket admits again...
+    assert q.admit("batch", now=t + 0.75).ok
+    # ...and a stale (non-monotonic) clock never drains or refills:
+    # the 0.5 tokens left after the last admit are still exactly 0.5.
+    stale = q.admit("batch", now=t)
+    assert not stale.ok and stale.retry_after_s == pytest.approx(0.25)
+    # Buckets are per tenant: an unrelated tenant starts full.
+    assert q.admit("high", now=t).ok
+
+
+def test_quota_concurrent_race_for_one_remaining_token():
+    # burst=1 and a negligible rate: exactly one of N racing requests
+    # may win the single token, no matter the interleaving.
+    q = autoscale.TenantQuotas(1e-9, burst=1)
+    start = threading.Barrier(12)
+    verdicts = []
+    lock = threading.Lock()
+
+    def worker():
+        start.wait()
+        v = q.admit("batch", now=500.0)
+        with lock:
+            verdicts.append(v.ok)
+
+    threads = [threading.Thread(target=worker) for _ in range(12)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(10)
+    assert sum(verdicts) == 1 and len(verdicts) == 12
+
+
+def test_quota_disabled_at_rate_zero():
+    q = autoscale.TenantQuotas(0.0)
+    assert not q.enabled
+    for _ in range(100):
+        assert q.admit("anyone").ok
+    assert q.snapshot() == {}
+
+
+def test_quota_burst_defaults_to_ceil_of_rate():
+    assert autoscale.TenantQuotas(2.5).burst == 3
+    assert autoscale.TenantQuotas(0.2).burst == 1
+    assert autoscale.TenantQuotas(2.0, burst=7).burst == 7
+
+
+# ------------------------------------------------- traffic-shaped chaos
+
+
+def test_traffic_fault_specs_parse():
+    plan = faults.FaultPlan.parse("spike:6:8s,tenant_stampede:3:4s")
+    spike, stampede = plan.faults
+    assert spike.kind == "spike" and spike.point == "fleet_chaos"
+    assert spike.factor == 6.0 and spike.seconds == 8.0
+    assert spike.step == 1  # the spike starts at fleet readiness
+    assert stampede.kind == "tenant_stampede"
+    assert stampede.point == "fleet_chaos"
+    assert stampede.step == 3 and stampede.seconds == 4.0
+    # Duration is optional for the stampede (default 5s).
+    assert faults.FaultPlan.parse("tenant_stampede:2").faults[0].seconds \
+        == 5.0
+
+
+def test_traffic_fault_specs_validate():
+    with pytest.raises(ValueError, match="factor"):
+        faults.FaultPlan.parse("spike:0:8s")
+    with pytest.raises(ValueError, match="duration"):
+        faults.FaultPlan.parse("spike:6:0")
+    with pytest.raises(ValueError, match="factor:seconds"):
+        faults.FaultPlan.parse("spike:nope")
+    with pytest.raises(ValueError, match="tick"):
+        faults.FaultPlan.parse("tenant_stampede:0")
+
+
+# ----------------------------------------------- router QoS front door
+
+
+class StubReplica:
+    """Minimal scriptable replica for QoS tests: settable ``digest``
+    (the model it claims to serve) and ``queue_depth`` (the load it
+    self-reports), plus the /reload contract the rolling roll needs."""
+
+    def __init__(self, digest="digest-v1"):
+        outer = self
+        self.digest = digest
+        self.queue_depth = 0
+        self.predicts = 0
+        self.reloads = 0
+        self.lock = threading.Lock()
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, status, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                with outer.lock:
+                    digest, depth = outer.digest, outer.queue_depth
+                self._reply(200, {
+                    "status": "ok", "task": "classify", "model": "stub",
+                    "step": 1, "vocab_size": 10,
+                    "input_spec": {"image": {"shape": [4], "dtype": "f32"}},
+                    "artifact": {"step": 1, "content_digest": digest,
+                                 "param_spec_digest": "spec",
+                                 "reloads": outer.reloads},
+                    "engine": {"state": "running", "queue_depth": depth,
+                               "requests": outer.predicts},
+                })
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                if self.path == "/reload":
+                    payload = json.loads(body)
+                    with outer.lock:
+                        outer.reloads += 1
+                        outer.digest = "digest-" + payload["artifact_dir"]
+                        to_digest = outer.digest
+                    self._reply(200, {"reloaded": True,
+                                      "to_digest": to_digest})
+                    return
+                with outer.lock:
+                    outer.predicts += 1
+                self._reply(200, {"outputs": [[0.0]], "rows": 1, "step": 1})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+class FakeProc:
+    """Stands in for a launcher-spawned subprocess: alive until the
+    router terminates it (scale-down retirement or shutdown)."""
+
+    def __init__(self):
+        self.terminated = False
+
+    def poll(self):
+        return None
+
+    def terminate(self):
+        self.terminated = True
+
+    kill = terminate
+
+    def wait(self, timeout=None):
+        return 0
+
+
+def _router(stubs, *, writer=None, serve=False, launcher=None, **knobs):
+    base = {"port": 0, "fleet_probe_interval_s": 0.1, "fleet_retries": 2,
+            "fleet_retry_backoff_ms": 5.0, "fleet_eject_failures": 2,
+            "fleet_deadline_s": 10.0, "fleet_attempt_timeout_s": 5.0,
+            "fleet_healthz_stale_s": 2.0}
+    base.update(knobs)
+    router = FleetRouter(ServeConfig(**base), telemetry_writer=writer,
+                         launcher=launcher)
+    for stub in stubs:
+        rep = router.add_replica(url=stub.url, admitted=True)
+        # What the prober would have learned from /healthz, injected so
+        # claim decisions are deterministic without a polling thread.
+        with router._lock:
+            rep.last_health = {
+                "artifact": {"content_digest": stub.digest},
+                "engine": {"queue_depth": stub.queue_depth},
+            }
+    thread = None
+    if serve:
+        thread = threading.Thread(target=router.serve_forever, daemon=True)
+        thread.start()
+    return router, thread
+
+
+def _post(url, payload, headers=None, timeout=20.0):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+@pytest.fixture
+def teardown():
+    routers, stubs = [], []
+    yield routers, stubs
+    for router, thread in routers:
+        router.shutdown("test teardown")
+        if thread is not None:
+            thread.join(10)
+    for stub in stubs:
+        stub.close()
+
+
+def test_quota_breach_answers_429_with_retry_after(teardown):
+    routers, stubs = teardown
+    stubs.append(StubReplica())
+    router, thread = _router(stubs, serve=True, tenant_quota_rps=1.0,
+                             tenant_quota_burst=1)
+    routers.append((router, thread))
+    url = f"http://{router.host}:{router.port}"
+    body = {"inputs": {"image": [[1.0]]}}
+    status, _, _ = _post(url, body, headers={"X-DTF-Tenant": "high:team-a"})
+    assert status == 200
+    status, out, headers = _post(url, body,
+                                 headers={"X-DTF-Tenant": "high:team-a"})
+    assert status == 429
+    assert out["retryable"] is True and out["tenant"] == "high:team-a"
+    assert 0 < float(headers["Retry-After"]) <= 1.0
+    # Buckets are per tenant — another tenant still rides through.
+    status, _, _ = _post(url, body, headers={"X-DTF-Tenant": "batch:etl"})
+    assert status == 200
+    tenants = router.fleet_healthz()["fleet"]["tenants"]
+    assert tenants["high:team-a"] == {"routed": 1, "shed": 0,
+                                      "quota_rejected": 1}
+    assert tenants["batch:etl"]["routed"] == 1
+
+
+def test_shedding_is_priority_ordered_at_exact_capacity(teardown):
+    # One replica self-reporting queue_depth=2 with capacity 3 and a
+    # reserve of 1: batch may claim below 1, default below 2, high below
+    # 3 — so at this exact load batch and default shed while high rides.
+    routers, stubs = teardown
+    stub = StubReplica()
+    stub.queue_depth = 2
+    stubs.append(stub)
+    router, thread = _router(stubs, serve=True, queue_capacity=3,
+                             tenant_priority_reserve=1,
+                             fleet_shed_retry_after_s=1.5)
+    routers.append((router, thread))
+    url = f"http://{router.host}:{router.port}"
+    body = {"inputs": {"image": [[1.0]]}}
+    for tenant, expect in (("batch", 503), ("default", 503), ("high", 200)):
+        status, out, headers = _post(url, body,
+                                     headers={"X-DTF-Tenant": tenant})
+        assert status == expect, tenant
+        if expect == 503:
+            assert out["shed"] is True and out["tenant"] == tenant
+            assert headers["Retry-After"] == "1.5"
+    tenants = router.fleet_healthz()["fleet"]["tenants"]
+    assert tenants["batch"]["shed"] == 1
+    assert tenants["default"]["shed"] == 1
+    assert tenants["high"] == {"routed": 1, "shed": 0, "quota_rejected": 0}
+
+
+def test_tenant_stampede_window_spares_reserved_headroom(teardown):
+    # The chaos window injects synthetic load equal to every unreserved
+    # queue slot: batch/default shed, high's reserve keeps flowing, and
+    # when the window closes everyone routes again.
+    routers, stubs = teardown
+    stubs.append(StubReplica())
+    router, thread = _router(stubs, serve=True, queue_capacity=4,
+                             tenant_priority_reserve=1)
+    routers.append((router, thread))
+    fault = faults.FaultPlan.parse("tenant_stampede:1:30s").faults[0]
+    router._apply_chaos(fault)
+    url = f"http://{router.host}:{router.port}"
+    body = {"inputs": {"image": [[1.0]]}}
+    assert _post(url, body, headers={"X-DTF-Tenant": "batch"})[0] == 503
+    assert _post(url, body, headers={"X-DTF-Tenant": "default"})[0] == 503
+    assert _post(url, body, headers={"X-DTF-Tenant": "high"})[0] == 200
+    with router._lock:  # close the window: back to classless service
+        router._stampede_until = 0.0
+    assert _post(url, body, headers={"X-DTF-Tenant": "batch"})[0] == 200
+
+
+def test_model_header_pins_routing_and_models_rollup(teardown):
+    routers, stubs = teardown
+    stubs.extend([StubReplica(digest="modelA-1111"),
+                  StubReplica(digest="modelB-2222")])
+    router, thread = _router(stubs, serve=True)
+    routers.append((router, thread))
+    url = f"http://{router.host}:{router.port}"
+    body = {"inputs": {"image": [[1.0]]}}
+    for _ in range(3):
+        status, _, headers = _post(url, body,
+                                   headers={"X-DTF-Model": "modelA"})
+        assert status == 200 and headers["X-DTF-Replica"] == "r0"
+    status, _, headers = _post(url, body, headers={"X-DTF-Model": "modelB"})
+    assert status == 200 and headers["X-DTF-Replica"] == "r1"
+    # A digest prefix nothing serves is saturation FOR THAT MODEL: shed.
+    assert _post(url, body, headers={"X-DTF-Model": "modelC"})[0] == 503
+    models = router.fleet_healthz()["fleet"]["models"]
+    assert models["modelA-1111"] == {"replicas": 1, "routed": 3}
+    assert models["modelB-2222"] == {"replicas": 1, "routed": 1}
+
+
+def test_rolling_reload_scoped_by_digest_and_count(teardown):
+    routers, stubs = teardown
+    stubs.extend([StubReplica(digest="modelA-1111"),
+                  StubReplica(digest="modelB-2222")])
+    router, thread = _router(stubs)
+    routers.append((router, thread))
+    # Scope by digest: only the modelB replica rolls; modelA untouched.
+    results, ok = router.rolling_reload("v2", only_digest="modelB")
+    assert ok is True
+    assert [r["replica"] for r in results] == ["r1"]
+    assert stubs[0].reloads == 0 and stubs[1].reloads == 1
+    # Scope by count: exactly one replica rolls (the first in order).
+    results, ok = router.rolling_reload("v3", count=1)
+    assert ok is True
+    assert [r["replica"] for r in results] == ["r0"]
+    assert stubs[0].reloads == 1 and stubs[1].reloads == 1
+
+
+# --------------------------------------------------- router actuation
+
+
+def test_router_scales_up_then_drains_back_down(tmp_path, teardown):
+    routers, stubs = teardown
+    stubs.extend([StubReplica(), StubReplica()])
+    procs = {}
+
+    def launcher(index):
+        procs[index] = FakeProc()
+        endpoint = tmp_path / f"r{index}-endpoint.json"
+        endpoint.write_text(json.dumps({"url": stubs[index].url}))
+        return procs[index], str(endpoint)
+
+    events = str(tmp_path / "events.jsonl")
+    writer = telemetry.TelemetryWriter(events)
+    router, thread = _router(stubs[:1], writer=writer, launcher=launcher,
+                             queue_capacity=8, fleet_autoscale=True,
+                             fleet_min_replicas=1, fleet_max_replicas=2,
+                             fleet_scale_up_threshold=0.5,
+                             fleet_scale_down_threshold=0.2,
+                             fleet_scale_cooldown_s=0.0,
+                             drain_timeout_s=5.0)
+    routers.append((router, thread))
+    # Saturate the one admitted replica: 7/8 queue slots full.
+    with router._lock:
+        router._replicas[0].last_health["engine"]["queue_depth"] = 7
+    router._autoscale_tick(time.monotonic())
+    with router._lock:
+        states = [r.state for r in router._replicas]
+    assert states == ["admitted", "ejected"]  # spawned, not yet admitted
+    # A booting replica pauses the loop — no double-spawn for one gap.
+    router._autoscale_tick(time.monotonic())
+    with router._lock:
+        assert len(router._replicas) == 2
+    # The prober's probe admits the spawn once its /healthz answers.
+    router._probe_replica(router._replicas[1], time.monotonic())
+    with router._lock:
+        assert router._replicas[1].state == "admitted"
+    # Load gone: the loop drains the NEWEST replica back out...
+    with router._lock:
+        router._replicas[0].last_health["engine"]["queue_depth"] = 0
+    router._autoscale_tick(time.monotonic())
+    with router._lock:
+        victim = router._replicas[1]
+        assert victim.state == "draining" and victim.retiring
+    # ...and holds further verdicts until the drain completes.
+    router._autoscale_tick(time.monotonic())
+    router._advance_retirements(time.monotonic())
+    with router._lock:
+        assert victim.state == "retired"
+    assert procs[1].terminated  # retirement SIGTERMs the subprocess
+    health = router.fleet_healthz()["fleet"]
+    assert health["router"]["scale_ups"] == 1
+    assert health["router"]["scale_downs"] == 1
+    assert health["autoscale"] == {"enabled": True, "min_replicas": 1,
+                                   "max_replicas": 2,
+                                   "pressure": health["autoscale"]["pressure"]}
+    writer.close()
+    summary = telemetry.summarize_events(events)
+    scaling = summary["fleet"]["scaling"]
+    assert scaling["ups"] == 1 and scaling["downs"] == 1
+    assert [e["action"] for e in scaling["events"]] == ["up", "down"]
+
+
+def test_spike_window_raises_pressure_without_touching_traffic(teardown):
+    routers, stubs = teardown
+    stubs.append(StubReplica())
+    router, thread = _router(stubs, serve=True, queue_capacity=8,
+                             fleet_autoscale=True, fleet_min_replicas=1,
+                             fleet_max_replicas=2,
+                             fleet_scale_up_threshold=0.5,
+                             fleet_scale_down_threshold=0.2,
+                             fleet_scale_cooldown_s=0.0)
+    routers.append((router, thread))
+    router._apply_chaos(faults.FaultPlan.parse("spike:6:30s").faults[0])
+    # No launcher: the decision is logged and skipped, but the policy
+    # saw the synthetic pressure (6 fake queued requests over 8 slots).
+    router._autoscale_tick(time.monotonic())
+    assert router._autoscaler.last_pressure == pytest.approx(0.75)
+    with router._lock:
+        assert len(router._replicas) == 1  # nothing to actuate with
+    # The spike feeds ONLY the autoscaler: real requests route fine.
+    url = f"http://{router.host}:{router.port}"
+    status, _, _ = _post(url, {"inputs": {"image": [[1.0]]}},
+                         headers={"X-DTF-Tenant": "batch"})
+    assert status == 200
+
+
+# ------------------------------------------------------- telemetry
+
+
+def test_scale_and_admission_telemetry_rollup(tmp_path):
+    """KIND_SCALE / KIND_ADMISSION / tenant-tagged KIND_SERVE_ROUTE
+    aggregate into the summary's fleet section and the human rollup."""
+    events = str(tmp_path / "events.jsonl")
+    writer = telemetry.TelemetryWriter(events)
+    writer.emit(telemetry.KIND_SCALE, metrics={"pressure": 0.91},
+                action="up", reason="pressure 0.910 >= 0.75",
+                replica="r2", from_replicas=2, to_replicas=3)
+    writer.emit(telemetry.KIND_SCALE, metrics={"pressure": 0.12},
+                action="down", reason="pressure 0.120 <= 0.25",
+                replica="r2", from_replicas=3, to_replicas=2)
+    for lat in (4.0, 6.0, 8.0):
+        writer.emit(telemetry.KIND_SERVE_ROUTE,
+                    metrics={"latency_ms": lat, "retries": 0, "status": 200},
+                    replica="r0", shed=False, deadline_exceeded=False,
+                    tenant="high")
+    writer.emit(telemetry.KIND_ADMISSION, tenant="batch", priority=2,
+                verdict="shed", retry_after_s=1.0)
+    writer.emit(telemetry.KIND_ADMISSION, tenant="default", priority=1,
+                verdict="quota", retry_after_s=0.5)
+    writer.close()
+    summary = telemetry.summarize_events(events)
+    fleet = summary["fleet"]
+    assert fleet["scaling"]["ups"] == 1 and fleet["scaling"]["downs"] == 1
+    assert fleet["scaling"]["events"][0] == {
+        "action": "up", "reason": "pressure 0.910 >= 0.75",
+        "replica": "r2", "from_replicas": 2, "to_replicas": 3,
+        "pressure": 0.91}
+    high = fleet["tenants"]["high"]
+    assert high["routed"] == 3 and high["shed"] == 0
+    assert high["latency_ms"]["p50"] == pytest.approx(6.0)
+    assert fleet["tenants"]["batch"]["shed"] == 1
+    assert fleet["tenants"]["default"]["quota_rejected"] == 1
+    text = telemetry.format_run_summary(summary)
+    assert "scaling: 1 up / 1 down" in text
+    assert "up->3@0.91" in text
+    assert "tenant high: routed 3" in text
+    assert "tenant batch: routed 0, shed 1" in text

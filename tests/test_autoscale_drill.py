@@ -1,0 +1,291 @@
+"""Autoscaling + QoS chaos drill (tier-2): a self-regulating fleet
+under a traffic spike, a tenant stampede, and a mid-scale replica kill.
+
+The acceptance bar is the control-plane headline:
+
+  * a synthetic traffic spike (``spike:6:600s``) pushes fleet pressure
+    over the up-threshold and the autoscaler grows the fleet to
+    ``fleet_max_replicas`` — one supervised spawn at a time, pausing
+    while any replica boots;
+  * replica 0 is SIGKILLed MID-scale-event (``kill_replica:0:12``):
+    supervision restarts it, the autoscaler waits out the boot, and the
+    fleet still converges on exactly max replicas — the two loops never
+    fight over the same hole;
+  * a tenant stampede (``tenant_stampede:4:6s``) saturates every
+    unreserved queue slot: batch and default shed (503 + Retry-After)
+    while the priority reserve keeps high-class traffic flowing — a
+    steady high-tenant client runs the WHOLE drill with zero failures;
+  * the spike ends and the autoscaler drains back to
+    ``fleet_min_replicas`` through retirement (drain → SIGTERM), again
+    with zero failed in-flight requests.
+
+The router runs in-process (chaos via faults.install, deterministic
+relative to fleet readiness); every replica is a real ``cli/serve.py``
+subprocess via the cli/fleet launcher. Traffic is scripts/load_gen.py
+with a shaped open-loop schedule and a weighted tenant mix; its bench
+JSON (per-tenant attribution) and the router's events.jsonl are
+archived to ``DTF_SERVE_BENCH_DIR`` for the tier driver.
+"""
+
+import copy
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+from test_train_lenet import lenet_config
+
+from distributed_tensorflow_framework_tpu.cli.fleet import (
+    make_replica_launcher,
+)
+from distributed_tensorflow_framework_tpu.core import faults, telemetry
+from distributed_tensorflow_framework_tpu.serve import FleetRouter, export_checkpoint
+from distributed_tensorflow_framework_tpu.train import Trainer
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+pytestmark = [pytest.mark.slow, pytest.mark.serve]
+
+
+def _wait(predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.25)
+    raise AssertionError(f"timed out after {timeout}s waiting for {what}")
+
+
+def _post(url, tenant, timeout=60.0):
+    rng = np.random.default_rng(7)
+    image = rng.normal(size=(1, 28, 28, 1)).astype(np.float32).tolist()
+    body = json.dumps({"inputs": {"image": image}}).encode()
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json",
+                 "X-DTF-Tenant": tenant})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def test_autoscale_chaos_drill(devices, tmp_path):
+    # 1. Train + export the serving artifact.
+    cfg = lenet_config(**{
+        "checkpoint.directory": str(tmp_path / "ckpt"),
+        "checkpoint.async_save": False,
+        "checkpoint.save_interval_steps": 10,
+        "train.total_steps": 10,
+    })
+    trainer = Trainer(cfg)
+    trainer.build()
+    trainer.train()
+    cfg.serve.data = 1
+    cfg.serve.allow_reshard = True
+    art_dir = export_checkpoint(cfg, str(tmp_path / "artifact"))
+
+    # 2. Router in-process with the full control loop armed: autoscale
+    # 2..4 replicas over a small queue_capacity (so synthetic chaos
+    # load moves pressure meaningfully) and a 2-slot priority reserve.
+    serve_cfg = copy.deepcopy(cfg.serve)
+    serve_cfg.port = 0
+    serve_cfg.fleet_replicas = 2
+    serve_cfg.fleet_probe_interval_s = 0.25
+    serve_cfg.fleet_eject_failures = 2
+    serve_cfg.fleet_healthz_stale_s = 5.0
+    serve_cfg.fleet_attempt_timeout_s = 8.0
+    serve_cfg.fleet_deadline_s = 45.0
+    serve_cfg.fleet_retries = 3
+    serve_cfg.drain_timeout_s = 30.0
+    serve_cfg.queue_capacity = 8
+    serve_cfg.fleet_autoscale = True
+    serve_cfg.fleet_min_replicas = 2
+    serve_cfg.fleet_max_replicas = 4
+    serve_cfg.fleet_scale_up_threshold = 0.5
+    serve_cfg.fleet_scale_down_threshold = 0.2
+    serve_cfg.fleet_scale_cooldown_s = 1.0
+    serve_cfg.tenant_priority_reserve = 2
+    log_dir = tmp_path / "fleet_logs"
+    log_dir.mkdir()
+    events_path = str(log_dir / "events.jsonl")
+    writer = telemetry.TelemetryWriter(events_path)
+    launcher = make_replica_launcher(
+        art_dir, str(log_dir),
+        ["serve.max_batch_size=8", "serve.max_wait_ms=5"])
+    router = FleetRouter(serve_cfg, telemetry_writer=writer,
+                         launcher=launcher)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    serve_thread = threading.Thread(target=router.serve_forever,
+                                    daemon=True)
+    # The steady high-tenant client: posts for the WHOLE drill — through
+    # the stampede, the kill, every scale event — and must never fail.
+    high_stop = threading.Event()
+    high_failures: list = []
+    high_ok = [0]
+
+    def high_loop(url):
+        while not high_stop.is_set():
+            try:
+                status, out, _ = _post(url, "high:sla-team")
+                if status == 200:
+                    high_ok[0] += 1
+                else:
+                    high_failures.append((status, out))
+            except Exception as e:  # noqa: BLE001 — record, keep driving
+                high_failures.append(repr(e))
+            high_stop.wait(0.15)
+
+    try:
+        # Chaos BEFORE the prober starts: the clock arms at readiness.
+        # spike opens immediately and stays open until the drill closes
+        # it (pressure 6/8 = 0.75 per replica regardless of fleet size,
+        # so scale-up must run all the way to max); the stampede opens
+        # ~1s in for 6s; the kill lands ~3s in, mid-scale-event.
+        faults.install("spike:6:600s,tenant_stampede:4:6s,kill_replica:0:12")
+        router.spawn_replicas()
+        serve_thread.start()
+        router.start()
+        assert router.wait_ready(timeout=240.0), router.fleet_healthz()
+        url = f"http://{router.host}:{router.port}"
+        high_thread = threading.Thread(target=high_loop, args=(url,),
+                                       daemon=True)
+        high_thread.start()
+
+        def fleet():
+            return router.fleet_healthz()["fleet"]
+
+        # 3. QoS under the stampede: batch and default shed with an
+        # honest Retry-After while high's reserved headroom routes.
+        _wait(lambda: router._stampede_until > time.monotonic(), 30,
+              "the tenant_stampede window to open")
+        status, out, headers = _post(url, "batch:nightly-eval")
+        assert status == 503, (status, out)
+        assert out["shed"] is True and out["tenant"] == "batch:nightly-eval"
+        assert float(headers["Retry-After"]) > 0
+        status, _, _ = _post(url, "default")
+        assert status == 503
+        status, _, _ = _post(url, "high:sla-team")
+        assert status == 200
+
+        # 4. Scale-up to max under the spike, with r0 killed mid-event:
+        # supervision restarts it (the autoscaler pauses on the boot),
+        # and the fleet converges on EXACTLY max — 2 scale-ups, 4
+        # replica slots total, nobody double-filled the dead slot.
+        _wait(lambda: fleet()["admitted"] == 4, 240,
+              "scale-up to fleet_max_replicas")
+        _wait(lambda: fleet()["replicas"][0]["restarts"] >= 1, 60,
+              "supervised restart of the killed replica")
+        _wait(lambda: all(r["state"] == "admitted"
+                          for r in fleet()["replicas"]), 240,
+              "every replica (including the restarted one) admitted")
+        snap = fleet()
+        assert snap["router"]["scale_ups"] == 2, snap["router"]
+        assert len(snap["replicas"]) == 4
+        assert snap["autoscale"]["enabled"] is True
+        assert snap["autoscale"]["max_replicas"] == 4
+
+        # 5. Shaped open-loop load with a weighted tenant mix across the
+        # scaled-up fleet; per-tenant attribution lands in the bench.
+        bench_dir = os.environ.get("DTF_SERVE_BENCH_DIR")
+        if bench_dir:
+            os.makedirs(bench_dir, exist_ok=True)
+            bench_path = os.path.join(bench_dir,
+                                      "SERVE_BENCH_AUTOSCALE.json")
+        else:
+            bench_path = str(tmp_path / "SERVE_BENCH_AUTOSCALE.json")
+        gen = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "load_gen.py"),
+             "--endpoint", url, "--requests", "150", "--concurrency", "16",
+             "--mode", "open", "--rate", "30", "--shape", "spike",
+             "--spike-factor", "3",
+             "--tenants", "high=1,default=2,batch=1",
+             "--out", bench_path],
+            cwd=str(REPO), env=env, capture_output=True, text=True,
+            timeout=600)
+        assert gen.returncode == 0, gen.stdout + gen.stderr
+        bench = json.loads(pathlib.Path(bench_path).read_text())
+        assert bench["schema"] == "dtf-serve-bench/2"
+        run = bench["runs"][0]
+        assert run["shape"] == "spike"
+        assert set(run["by_tenant"]) == {"high", "default", "batch"}
+        # Zero high-priority sheds: every high-class request succeeded.
+        assert run["by_tenant"]["high"]["errors"] == 0, run["by_tenant"]
+        assert bench["fleet"]["tenants"]  # router ledger snapshot rode in
+
+        # 6. Spike over: the autoscaler drains back to min through
+        # retirement, zero failed in-flight (the high client is still
+        # running and never sees an error).
+        with router._lock:
+            router._spike_until = 0.0
+            router._stampede_until = 0.0
+        _wait(lambda: fleet()["router"]["scale_downs"] == 2, 120,
+              "two drain-based scale-downs")
+        _wait(lambda: fleet()["admitted"] == 2, 120,
+              "fleet back at fleet_min_replicas")
+        # admitted==2 can precede the second victim finishing its drain
+        # (draining -> retired happens on a later prober tick).
+        _wait(lambda: [r["state"] for r in fleet()["replicas"]]
+              .count("retired") == 2, 60,
+              "both drained replicas retired")
+        snap = fleet()
+        states = [r["state"] for r in snap["replicas"]]
+        assert states.count("retired") == 2, states
+        assert states.count("admitted") == 2, states
+
+        # 7. The steady high-tenant client saw ZERO failures across the
+        # stampede, the kill, and both scale directions.
+        high_stop.set()
+        high_thread.join(60)
+        assert not high_failures, high_failures[:5]
+        assert high_ok[0] > 0
+
+        # 8. Telemetry tells the whole story: the scaling timeline, the
+        # per-tenant admission ledger, and the kill's eject/restart —
+        # through analyze_trace --json, the drivers' surface.
+        writer.close()
+        summary = telemetry.summarize_events(events_path)
+        scaling = summary["fleet"]["scaling"]
+        assert scaling["ups"] == 2 and scaling["downs"] == 2
+        assert [e["action"] for e in scaling["events"]] == [
+            "up", "up", "down", "down"]
+        assert all(e["pressure"] is not None for e in scaling["events"])
+        tenants = summary["fleet"]["tenants"]
+        assert tenants["high:sla-team"]["routed"] > 0
+        assert tenants["high:sla-team"]["shed"] == 0
+        assert tenants["batch:nightly-eval"]["shed"] >= 1
+        assert summary["fleet"]["restarts"] >= 1
+        text = telemetry.format_run_summary(summary)
+        assert "scaling: 2 up / 2 down" in text
+        assert "tenant high:sla-team" in text
+        from scripts import analyze_trace
+        json_path = str(tmp_path / "RUN_SUMMARY.json")
+        assert analyze_trace.main([events_path, "--json", json_path]) == 0
+        obj = json.loads(pathlib.Path(json_path).read_text())
+        assert obj["fleet"]["scaling"]["ups"] == 2
+        assert "high:sla-team" in obj["fleet"]["tenants"]
+
+        # Archive the raw scaling-event stream for the tier driver.
+        if bench_dir:
+            shutil.copyfile(events_path,
+                            os.path.join(bench_dir,
+                                         "AUTOSCALE_EVENTS.jsonl"))
+    finally:
+        high_stop.set()
+        faults.install(None)
+        clean = router.shutdown("drill teardown")
+        serve_thread.join(30)
+        try:
+            writer.close()
+        except ValueError:
+            pass
+        assert clean, "fleet drain left a replica running"

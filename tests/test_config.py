@@ -108,3 +108,30 @@ def test_collective_dtype_validated():
         load_config(overrides=["parallel.collective_dtype=fp8"])
     with pytest.raises(ValueError, match="collective_block_size"):
         load_config(overrides=["parallel.collective_block_size=0"])
+
+
+def test_fleet_autoscale_and_tenant_knobs_validated():
+    with pytest.raises(ValueError, match="fleet_min_replicas"):
+        load_config(overrides=["serve.fleet_min_replicas=0"])
+    with pytest.raises(ValueError, match="fleet_max_replicas"):
+        load_config(overrides=["serve.fleet_min_replicas=4",
+                               "serve.fleet_max_replicas=2"])
+    # The hysteresis band must be a band: 0 < down < up.
+    with pytest.raises(ValueError, match="hysteresis"):
+        load_config(overrides=["serve.fleet_scale_down_threshold=0.9"])
+    with pytest.raises(ValueError, match="cooldown"):
+        load_config(overrides=["serve.fleet_scale_cooldown_s=-1"])
+    # A reserve so large the lowest class can never claim is a footgun.
+    with pytest.raises(ValueError, match="tenant_priority_reserve"):
+        load_config(overrides=["serve.queue_capacity=4",
+                               "serve.tenant_priority_reserve=2"])
+    with pytest.raises(ValueError, match="tenant_quota_rps"):
+        load_config(overrides=["serve.tenant_quota_rps=-1"])
+    with pytest.raises(ValueError, match="tenant_quota_burst"):
+        load_config(overrides=["serve.tenant_quota_burst=-1"])
+    cfg = load_config(overrides=["serve.fleet_autoscale=true",
+                                 "serve.fleet_max_replicas=4",
+                                 "serve.tenant_quota_rps=2.5"])
+    assert cfg.serve.fleet_autoscale is True
+    assert cfg.serve.fleet_max_replicas == 4
+    assert cfg.serve.tenant_quota_rps == 2.5

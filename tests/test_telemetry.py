@@ -514,6 +514,11 @@ def test_summarize_rolls_up_every_kind(tmp_path):
            reason="stale healthz")
     w.emit(telemetry.KIND_SERVE_RELOAD, metrics={"reload_ms": 120.0},
            replica="r0", ok=True, from_digest="aaaa", to_digest="bbbb")
+    w.emit(telemetry.KIND_SCALE, metrics={"pressure": 0.91},
+           action="up", reason="pressure 0.91 >= 0.75", replica="r3",
+           from_replicas=3, to_replicas=4)
+    w.emit(telemetry.KIND_ADMISSION, tenant="batch:nightly", priority=2,
+           verdict="shed", retry_after_s=1.0)
     w.emit(telemetry.KIND_SPAN, metrics={"dur_ms": 12.5},
            trace="t" * 16, span="s" * 16, parent=None,
            name="serve.request", service="replica0", status="ok",
@@ -550,6 +555,9 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert s["fleet"]["ejects"] == [{"replica": "r1",
                                      "reason": "stale healthz"}]
     assert s["fleet"]["reloads"][0]["to_digest"] == "bbbb"
+    assert s["fleet"]["scaling"]["ups"] == 1
+    assert s["fleet"]["scaling"]["events"][0]["to_replicas"] == 4
+    assert s["fleet"]["tenants"]["batch:nightly"]["shed"] == 1
     assert s["zero"]["shards"] == 8 and s["zero"]["buckets"] == 3
     assert s["goodput"]["attempts"] == 1
     assert s["goodput"]["goodput_frac"] == pytest.approx(0.8)
@@ -567,6 +575,8 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert "serving: 1 requests (2 rows) in 1 batches" in text
     assert "bucket recompiles: 1 (rows2)" in text
     assert "fleet: 1 proxied" in text and "ejections: 1" in text
+    assert "scaling: 1 up / 0 down (up->4@0.91)" in text
+    assert "tenant batch:nightly: routed 0, shed 1" in text
     assert "zero update sharding: 8 shards, 3 buckets" in text
     assert "goodput: 80.0% of 10.0 s wall over 1 attempt(s)" in text
     assert "spans: 1 across 1 trace(s) [replica0=1]" in text

@@ -11,6 +11,7 @@ bounded ring + dump format, and the ``--spans`` analyzer surface
 
 import json
 import os
+import tempfile
 
 import pytest
 
@@ -316,3 +317,35 @@ def test_flight_recorder_default_path_honors_trace_dir(tmp_path,
     # Explicit dump_dir wins over the env.
     rec2 = tracing.FlightRecorder(2, dump_dir=str(tmp_path / "sub"))
     assert rec2.default_path().startswith(str(tmp_path / "sub"))
+
+
+def test_flight_recorder_default_path_falls_back_to_writer_dir(
+        tmp_path, monkeypatch):
+    monkeypatch.delenv(tracing.TRACE_DIR_ENV, raising=False)
+    log_dir = tmp_path / "run_logs"
+    log_dir.mkdir()
+    writer = telemetry.TelemetryWriter(str(log_dir / "events.jsonl"))
+    rec = tracing.FlightRecorder(2).attach(writer)
+    # No dump_dir, no env var: the dump lands NEXT TO the run's own
+    # telemetry, never in the process cwd (= the repo root under pytest).
+    assert rec.default_path() == os.path.join(
+        str(log_dir), f"flightrec-{os.getpid()}.json")
+    writer.close()
+    # A stderr-only writer (path None — e.g. a supervisor run without
+    # checkpoint.directory) gives no directory clue: the last resort is
+    # the system temp dir, NEVER the process cwd.
+    bare = telemetry.TelemetryWriter(None)
+    rec2 = tracing.FlightRecorder(2).attach(bare)
+    assert rec2.default_path().startswith(tempfile.gettempdir())
+    bare.close()
+
+
+def test_repo_root_stays_clean_of_flightrec_dumps():
+    # The litter pin: a tier-1 run must leave the repo root free of
+    # flightrec-*.json. Every in-repo trigger sets dump_dir, attaches a
+    # file-backed writer, or falls back to the system temp dir — there
+    # is no cwd fallback left. If this fails, a dump site regressed.
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    litter = [f for f in os.listdir(repo_root)
+              if f.startswith("flightrec-") and f.endswith(".json")]
+    assert litter == []
