@@ -78,6 +78,27 @@ def main(argv=None) -> int:
         model=artifact.model_config.name, step=artifact.step)
     engine = InferenceEngine(artifact, srv, telemetry_writer=writer,
                              trace_enabled=config.trace.enabled)
+    decode_engine = None
+    if config.decode.enabled:
+        from distributed_tensorflow_framework_tpu.models import (
+            decode_support_reason,
+        )
+        from distributed_tensorflow_framework_tpu.serve.decode import (
+            DecodeEngine,
+        )
+
+        reason = (None if artifact.task == "mlm"
+                  else f"artifact task {artifact.task!r} has no vocabulary")
+        reason = reason or decode_support_reason(artifact.model_config)
+        if reason is not None:
+            # decode.enabled on an unsupported artifact is a config error,
+            # not a silent downgrade: fail before binding the port.
+            log.error("decode.enabled but artifact cannot decode: %s",
+                      reason)
+            return 2
+        decode_engine = DecodeEngine(
+            artifact, config.decode, srv,
+            mesh=engine.mesh, telemetry_writer=writer)
     # Flight recorder on the replica: ring of recent telemetry (spans
     # included), dumped on SIGUSR1 or by the fleet router observing this
     # process die (docs/OBSERVABILITY.md "Tracing and flight recorder").
@@ -86,7 +107,8 @@ def main(argv=None) -> int:
         dump_dir=config.trace.dump_dir or log_dir,
         tracer=engine.tracer).attach(writer)
     recorder.install_sigusr1()
-    server = ServingServer(engine, srv, telemetry_writer=writer)
+    server = ServingServer(engine, srv, decode_engine=decode_engine,
+                           telemetry_writer=writer)
     # The resolved endpoint record: with serve.port=0 the OS picked the
     # port, so tooling polls this file instead of guessing.
     endpoint = {

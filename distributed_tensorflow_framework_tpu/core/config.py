@@ -677,6 +677,64 @@ class ServeConfig:
 
 
 @config_dataclass
+class DecodeConfig:
+    """Autoregressive decode engine (serve/decode.py, docs/SERVING.md
+    "Autoregressive decode"): prefill/decode split with a paged KV cache
+    and continuous batching over the serving mesh."""
+
+    # Master switch: cli/serve.py stands a DecodeEngine next to the
+    # single-shot engine (POST /generate) only when enabled AND the
+    # artifact's task supports decode (mlm/bert family).
+    enabled: bool = False
+    # "continuous" admits/retires streams at EVERY token (freed slots
+    # refill from the queue mid-flight); "static" joins only at batch
+    # boundaries — the whole batch must finish before the next group is
+    # admitted. Static exists as the A/B control arm: mixed-length
+    # streams idle its slots, which is exactly what continuous fixes.
+    scheduler: str = "continuous"
+    # Tokens per KV page. Pages are the cache's allocation unit: a
+    # stream holds ceil(tokens / page_size) pages and grows one page at
+    # a time as decode crosses each boundary.
+    page_size: int = 16
+    # Physical pages in the pool (page 0 is a reserved scratch page, so
+    # num_pages - 1 are allocatable). Total resident-token capacity per
+    # replica = (num_pages - 1) * page_size.
+    num_pages: int = 64
+    # Concurrent streams in the in-flight decode batch. The row ladder
+    # is the power-of-two ladder over dp multiples up to this cap, the
+    # same discipline as serve.max_batch_size.
+    max_streams: int = 8
+    # Ceiling on prompt + generated tokens per stream. 0 = the model's
+    # max_seq_len (position-embedding capacity bounds it either way).
+    max_len: int = 0
+    # Server-side cap on requested new tokens per stream.
+    max_new_tokens: int = 64
+    # Page-table width buckets (pages per stream a table is padded up
+    # to, ascending). [] = power-of-two ladder up to ceil(max_len /
+    # page_size). Together with the row ladder this bounds decode-step
+    # recompiles to |page_buckets| x |row ladder|.
+    page_buckets: list = field(default_factory=list)
+    # Prompt-length padding buckets for the prefill forward (ascending).
+    # [] = one bucket at max_len. Prefill compiles are bounded to
+    # |prompt_buckets| x |page_buckets| (prefill always runs one row).
+    prompt_buckets: list = field(default_factory=list)
+    # KV page storage dtype: "float32" (exact) or "int8" (EQuARX-style
+    # block-scaled pages via parallel/quantization.py — ~4x more
+    # resident streams per replica, per-token logits pinned within a
+    # quantization bound of the f32 path rather than bitwise).
+    kv_dtype: str = "float32"
+    # Streaming granularity: a stream's tokens are buffered scheduler-
+    # side and delivered every this-many decode steps (the FIRST token
+    # and the finish summary always flush immediately, so TTFT is
+    # unaffected). 1 = deliver every token as it lands. Raising it
+    # trades up to (interval - 1) steps of in-stream latency for far
+    # fewer consumer wakeups — on hosts where clients, handlers and the
+    # scheduler share cores, per-token wakeups steal enough CPU from
+    # the step loop to show up in tokens/s.
+    stream_interval: int = 1
+
+
+@config_dataclass
 class TraceConfig:
     """Distributed tracing + flight recorder (core/tracing.py,
     docs/OBSERVABILITY.md "Tracing and flight recorder")."""
@@ -710,6 +768,7 @@ class ExperimentConfig:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     precision: PrecisionConfig = field(default_factory=PrecisionConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    decode: DecodeConfig = field(default_factory=DecodeConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
 
     def to_dict(self) -> dict[str, Any]:
@@ -970,6 +1029,59 @@ def load_config(
                 f"serve.seq_buckets max {srv.seq_buckets[-1]} exceeds "
                 f"model.max_seq_len={cfg.model.max_seq_len} — the model "
                 f"cannot embed positions past its trained length"
+            )
+    dec = cfg.decode
+    if dec.scheduler not in ("continuous", "static"):
+        raise ValueError(
+            f"decode.scheduler must be 'continuous' or 'static', got "
+            f"{dec.scheduler!r}"
+        )
+    if dec.kv_dtype not in ("float32", "int8"):
+        raise ValueError(
+            f"decode.kv_dtype must be 'float32' or 'int8', got "
+            f"{dec.kv_dtype!r}"
+        )
+    if dec.page_size < 1:
+        raise ValueError(
+            f"decode.page_size must be >= 1, got {dec.page_size}"
+        )
+    if dec.stream_interval < 1:
+        raise ValueError(
+            f"decode.stream_interval must be >= 1, got "
+            f"{dec.stream_interval}"
+        )
+    if dec.num_pages < 2:
+        raise ValueError(
+            f"decode.num_pages must be >= 2 (page 0 is the reserved "
+            f"scratch page), got {dec.num_pages}"
+        )
+    if dec.max_streams < 1:
+        raise ValueError(
+            f"decode.max_streams must be >= 1, got {dec.max_streams}"
+        )
+    if dec.max_new_tokens < 1:
+        raise ValueError(
+            f"decode.max_new_tokens must be >= 1, got {dec.max_new_tokens}"
+        )
+    if dec.max_len < 0:
+        raise ValueError(
+            f"decode.max_len must be >= 0 (0 = model.max_seq_len), got "
+            f"{dec.max_len}"
+        )
+    if dec.max_len > cfg.model.max_seq_len:
+        raise ValueError(
+            f"decode.max_len={dec.max_len} exceeds model.max_seq_len="
+            f"{cfg.model.max_seq_len} — the model cannot embed positions "
+            f"past its trained length"
+        )
+    for knob, buckets in (("decode.page_buckets", dec.page_buckets),
+                          ("decode.prompt_buckets", dec.prompt_buckets)):
+        if buckets and (
+                any(int(b) < 1 for b in buckets)
+                or list(buckets) != sorted(set(buckets))):
+            raise ValueError(
+                f"{knob} must be strictly ascending positive values, got "
+                f"{buckets}"
             )
     # Head-vs-labels cross-check for the built-in classification datasets:
     # a label outside the head's range turns the loss metric into NaN

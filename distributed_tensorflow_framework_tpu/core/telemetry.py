@@ -157,6 +157,15 @@ KIND_MEMORY = "memory"
 # process's estimated clock offset so scripts/analyze_trace.py --spans can
 # stitch per-process streams into one causally ordered trace tree.
 KIND_SPAN = "span"
+# Autoregressive decode (serve/decode.py, docs/SERVING.md "Autoregressive
+# decode"): one KIND_DECODE_STEP per jitted decode step (real vs padded
+# rows — batch occupancy — plus step and per-token ms), and periodic +
+# eviction-triggered KIND_KV_CACHE gauges of the paged pool (pages in
+# use/free, active/waiting streams, cumulative preemptions). Together
+# they answer the two continuous-batching questions: how full was the
+# in-flight batch, and was the KV pool the thing capping it.
+KIND_DECODE_STEP = "decode_step"
+KIND_KV_CACHE = "kv_cache"
 
 
 def make_run_id() -> str:
@@ -449,6 +458,11 @@ def summarize_events(path: str) -> dict:
         "compute_ms_total": 0.0, "queue_depth_max": 0,
         "recompiles": [], "latency": None,
     }
+    decode = {
+        "steps": 0, "tokens": 0, "padded_rows": 0, "step_ms_total": 0.0,
+        "occupancy_sum": 0.0, "evictions": 0, "pages_used_max": 0,
+        "streams_waiting_max": 0, "kv_samples": 0,
+    }
     fleet = {
         "requests": 0, "routed": {}, "retries": 0, "shed": 0,
         "deadline_exceeded": 0, "skew": None,
@@ -615,6 +629,25 @@ def summarize_events(path: str) -> dict:
                 "bucket": extra.get("bucket"),
                 "compile_ms": m.get("compile_ms"),
             })
+        elif kind == KIND_DECODE_STEP:
+            m = ev.get("metrics") or {}
+            decode["steps"] += 1
+            decode["tokens"] += int(m.get("rows", 0) or 0)
+            decode["padded_rows"] += int(m.get("padded_rows", 0) or 0)
+            decode["step_ms_total"] += float(m.get("step_ms", 0.0))
+            decode["occupancy_sum"] += float(m.get("occupancy", 0.0))
+        elif kind == KIND_KV_CACHE:
+            m = ev.get("metrics") or {}
+            decode["kv_samples"] += 1
+            # evictions is a cumulative counter on the emitting engine —
+            # the max across samples is the run total.
+            decode["evictions"] = max(
+                decode["evictions"], int(m.get("evictions", 0) or 0))
+            decode["pages_used_max"] = max(
+                decode["pages_used_max"], int(m.get("pages_used", 0) or 0))
+            decode["streams_waiting_max"] = max(
+                decode["streams_waiting_max"],
+                int(m.get("streams_waiting", 0) or 0))
         elif kind == KIND_SERVE_ROUTE:
             m = ev.get("metrics") or {}
             fleet["requests"] += 1
@@ -809,6 +842,8 @@ def summarize_events(path: str) -> dict:
         "zero": zero,
         "serve": (serve if (serve["requests"] or serve["batches"]
                             or serve["recompiles"]) else None),
+        "decode": (decode if (decode["steps"] or decode["kv_samples"])
+                   else None),
         "fleet": (fleet if (fleet["requests"] or fleet["ejects"]
                             or fleet["readmits"] or fleet["restarts"]
                             or fleet["reloads"] or fleet["tenants"]
@@ -983,6 +1018,27 @@ def format_run_summary(summary: dict) -> str:
             lines.append(
                 f"    bucket recompiles: {len(serve['recompiles'])}"
                 f" ({buckets})"
+            )
+    decode = summary.get("decode")
+    if decode:  # KIND_DECODE_STEP rollup
+        fill = (decode["tokens"] / decode["padded_rows"]
+                if decode.get("padded_rows") else None)
+        occ = (decode["occupancy_sum"] / decode["steps"]
+               if decode["steps"] else None)
+        per_tok = (decode["step_ms_total"] / decode["tokens"]
+                   if decode["tokens"] else None)
+        lines.append(
+            f"  decode: {decode['tokens']} tokens in {decode['steps']} steps"
+            + (f", fill {fill:.2f}" if fill is not None else "")
+            + (f", occupancy {occ:.2f}" if occ is not None else "")
+            + (f", {per_tok:.1f} ms/token" if per_tok is not None else "")
+        )
+        if decode["kv_samples"]:  # KIND_KV_CACHE rollup
+            lines.append(
+                f"    kv cache: peak {decode['pages_used_max']} pages in "
+                f"use, evictions {decode['evictions']}, waiting max "
+                f"{decode['streams_waiting_max']} "
+                f"({decode['kv_samples']} samples)"
             )
     fleet = summary.get("fleet")
     if fleet:  # KIND_SERVE_ROUTE / KIND_SERVE_EJECT / KIND_SERVE_RELOAD

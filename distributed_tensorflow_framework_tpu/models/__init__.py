@@ -77,6 +77,18 @@ def register_model(name: str, *, task: str = "classification"):
     return deco
 
 
+def decode_support_reason(model_config) -> str | None:
+    """Why ``model_config`` cannot take the autoregressive decode path
+    (None = supported) — re-exported from models/bert.py so the serving
+    layer (serve/decode.py) need not import a model file directly."""
+    from distributed_tensorflow_framework_tpu.models import bert
+
+    if model_config.name.lower() in _CUSTOM_MODELS:
+        return (f"custom model {model_config.name!r} has no causal decode "
+                f"head (decode supports the dense bert family)")
+    return bert.decode_support_reason(model_config)
+
+
 def custom_model_task(name: str) -> str | None:
     """Task family of a registered custom model, or None if not custom."""
     entry = _CUSTOM_MODELS.get(name.lower())

@@ -25,6 +25,7 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_tensorflow_framework_tpu.models.layers import dense_kernel_init
 
@@ -223,6 +224,164 @@ class MLMHead(nn.Module):
         bias = self.param("mlm_bias", nn.initializers.zeros,
                           (self.vocab_size,), jnp.float32)
         return logits.astype(jnp.float32) + bias
+
+
+# ---------------------------------------------------------------------------
+# Autoregressive decode path (serve/decode.py, docs/SERVING.md
+# "Autoregressive decode").
+#
+# The decode engine needs two forwards the training module cannot express:
+# a CAUSAL prefill over the prompt that also exports every layer's K/V, and
+# a per-token step whose keys/values come from a paged cache instead of the
+# layer input. Both are pure jnp functions over the trained BertForMLM
+# parameter tree (same names: embed_block/layer{i}/head), with the KV
+# residency abstracted behind an ``attend`` callback so the engine owns
+# paging while the model owns the math. Everything runs in f32: decode
+# parity is pinned BITWISE between batched and unbatched streams, and a
+# replicated f32 walk is the cheapest way to make that hold by
+# construction.
+# ---------------------------------------------------------------------------
+
+
+def decode_support_reason(model_config) -> str | None:
+    """Why this model config cannot take the autoregressive decode path
+    (None = supported). The pure-jnp decode forward walks the dense BERT
+    parameter tree by name; trees it does not know must be refused by
+    name rather than failing as a KeyError mid-stream."""
+    if model_config.name.lower() not in ("bert", "bert_base", "bert-base"):
+        return (f"model {model_config.name!r} has no causal decode head "
+                f"(decode supports the dense bert family)")
+    if getattr(model_config, "num_experts", 0):
+        return "MoE encoder layers are not supported by the decode path"
+    if getattr(model_config, "pipeline_stages", 1) > 1:
+        return "pipelined checkpoints are not servable (see serve/export.py)"
+    return None
+
+
+def _decode_ln(p, x):
+    """f32 LayerNorm matching nn.LayerNorm(epsilon=1e-6) semantics."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + 1e-6) * p["scale"] + p["bias"]
+
+
+def _decode_dense(p, x):
+    return x @ p["kernel"].astype(jnp.float32) + p["bias"]
+
+
+def _decode_qkv(attn_params, x):
+    """q/k/v projections for one layer, handling both parameter layouts
+    (separate query/key/value vs the fused (H, 3, H) qkv kernel)."""
+    if "qkv" in attn_params:
+        w = attn_params["qkv"]["kernel"].astype(jnp.float32)  # (H, 3, H)
+        b = attn_params["qkv"]["bias"].astype(jnp.float32)    # (3, H)
+        qkv = jnp.einsum("...h,hco->...co", x, w) + b
+        return qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    return (_decode_dense(attn_params["query"], x),
+            _decode_dense(attn_params["key"], x),
+            _decode_dense(attn_params["value"], x))
+
+
+def bert_decode_layers(params, ids, positions, attend):
+    """The shared causal walk: embed -> N x (attn -> add&norm -> MLP ->
+    add&norm), f32. ``ids``/``positions``: (B, T) int32. ``attend(layer,
+    q, k, v) -> context`` with q/k/v/context all (B, T, H) f32 — prefill
+    passes an in-register causal attention, the per-token decode step a
+    paged-pool write+gather. Returns the final hidden states (B, T, H)."""
+    emb = params["embed_block"]
+    table = emb["embed"]["embedding"].astype(jnp.float32)
+    x = jnp.take(table, ids, axis=0)
+    x = x + jnp.take(emb["pos_embedding"].astype(jnp.float32),
+                     positions, axis=0)
+    x = _decode_ln(emb["embed_ln"], x)
+    n_layers = sum(1 for k in params if str(k).startswith("layer"))
+    for i in range(n_layers):
+        lp = params[f"layer{i}"]
+        q, k, v = _decode_qkv(lp["attn"], x)
+        ctx = attend(i, q, k, v)
+        x = _decode_ln(lp["ln1"], x + _decode_dense(lp["attn"]["attn_out"],
+                                                    ctx))
+        y = nn.gelu(_decode_dense(lp["mlp_in"], x), approximate=True)
+        x = _decode_ln(lp["ln2"], x + _decode_dense(lp["mlp_out"], y))
+    return x
+
+
+def bert_decode_head_params(params):
+    """Derive serving-layout head params: adds ``mlm_projection``, the
+    tied embedding table pre-transposed to (H, V). Transposing inside
+    the jitted step makes XLA CPU materialize the 4-byte-per-vocab-entry
+    transpose on EVERY call — at serving batch sizes that one op dwarfs
+    the whole forward pass (B=1 prefill especially). Paying it once per
+    weight (re)load keeps the per-call matmul in the same (B,H)@(H,V)
+    kernel for every row bucket, which is also what keeps logits
+    bitwise-identical across batch sizes."""
+    table = params["embed_block"]["embed"]["embedding"]
+    head = dict(params["head"])
+    head["mlm_projection"] = jnp.asarray(
+        np.ascontiguousarray(np.asarray(table).T))
+    out = dict(params)
+    out["head"] = head
+    return out
+
+
+def bert_decode_logits(params, hidden):
+    """MLM head over decode hidden states: transform -> gelu -> LN ->
+    tied-embedding projection + bias, all f32. hidden: (..., H).
+    Prefers the pre-transposed ``mlm_projection`` planted by
+    :func:`bert_decode_head_params`; falls back to transposing the tied
+    table in-graph (slow on CPU, see above) so direct callers without
+    the derived leaf still work."""
+    head = params["head"]
+    t = nn.gelu(_decode_dense(head["mlm_transform"], hidden),
+                approximate=True)
+    t = _decode_ln(head["mlm_ln"], t)
+    proj = head.get("mlm_projection")
+    if proj is None:
+        proj = params["embed_block"]["embed"]["embedding"].T
+    logits = t @ proj.astype(jnp.float32)
+    return logits + head["mlm_bias"].astype(jnp.float32)
+
+
+def causal_prefill_attention(q, k, v, length, num_heads):
+    """In-register causal attention for the prefill pass. q/k/v:
+    (B, S, H) f32; ``length`` (B,) masks keys past each row's prompt.
+    Query row i attends keys j <= i (and j < length)."""
+    b, s, h = q.shape
+    d = h // num_heads
+    qh = q.reshape(b, s, num_heads, d)
+    kh = k.reshape(b, s, num_heads, d)
+    vh = v.reshape(b, s, num_heads, d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / jnp.sqrt(
+        jnp.float32(d))
+    idx = jnp.arange(s, dtype=jnp.int32)
+    causal = idx[None, :] <= idx[:, None]                      # (Sq, Sk)
+    valid = idx[None, None, None, :] < length[:, None, None, None]
+    mask = causal[None, None, :, :] & valid
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+    return out.reshape(b, s, h)
+
+
+def paged_decode_attention(q, k_keys, v_keys, positions, num_heads):
+    """One-token attention over gathered paged KV. q: (B, H) for the
+    current token; k_keys/v_keys: (B, S_kv, H) gathered from the page
+    pool (padding included); keys at j <= positions[b] are live, the
+    rest — page-table padding and not-yet-written slots — are masked."""
+    b, h = q.shape
+    s_kv = k_keys.shape[1]
+    d = h // num_heads
+    qh = q.reshape(b, num_heads, d)
+    kh = k_keys.reshape(b, s_kv, num_heads, d)
+    vh = v_keys.reshape(b, s_kv, num_heads, d)
+    scores = jnp.einsum("bhd,bkhd->bhk", qh, kh) / jnp.sqrt(jnp.float32(d))
+    live = (jnp.arange(s_kv, dtype=jnp.int32)[None, :]
+            <= positions[:, None])
+    scores = jnp.where(live[:, None, :], scores,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", probs, vh)
+    return out.reshape(b, h)
 
 
 class BertForMLM(nn.Module):

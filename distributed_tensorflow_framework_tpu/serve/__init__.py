@@ -11,9 +11,14 @@ layers, bottom-up:
     batching (max-batch-size / max-wait-ms admission), padding buckets
     bounding XLA recompiles, a jitted forward reusing
     parallel/sharding.py specs, and the KIND_SERVE_* SLO telemetry;
-  * serve/server.py — the stdlib-only HTTP front end (predict + healthz)
-    with graceful SIGTERM drain mirroring the supervisor's preemption
-    contract;
+  * serve/decode.py — the autoregressive decode engine for mlm-task
+    artifacts: paged KV cache (fixed page pool, bucketed page tables
+    bounding recompiles), continuous batching (streams join/leave the
+    in-flight batch every token), int8 KV pages, and live weight reload
+    that drains in-flight streams;
+  * serve/server.py — the stdlib-only HTTP front end (predict, generate
+    streaming, healthz) with graceful SIGTERM drain mirroring the
+    supervisor's preemption contract;
   * serve/fleet.py — the health-aware router over N replica engines:
     least-loaded routing, hedged retries, circuit-breaker eject/readmit,
     supervised restarts, load shedding, rolling live weight reloads,
@@ -40,6 +45,16 @@ from distributed_tensorflow_framework_tpu.serve.fleet import (  # noqa: F401
     ReplicaLaunchError,
 )
 
+from distributed_tensorflow_framework_tpu.serve.decode import (  # noqa: F401
+    CacheFullError,
+    DecodeClosedError,
+    DecodeEngine,
+    DecodeError,
+    DecodeStream,
+    StreamTooLongError,
+    page_table_buckets,
+    pages_for,
+)
 from distributed_tensorflow_framework_tpu.serve.engine import (  # noqa: F401
     EngineClosedError,
     InferenceEngine,

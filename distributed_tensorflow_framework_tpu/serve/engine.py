@@ -138,7 +138,11 @@ def make_forward(model, mesh):
 
 def pick_bucket(value: int, buckets: list[int]) -> int:
     """Smallest bucket >= value (buckets ascending). ValueError past the
-    last bucket — the caller owns the typed error."""
+    last bucket — the caller owns the typed error. An empty ladder is a
+    configuration error, not an IndexError."""
+    if not buckets:
+        raise ValueError(
+            f"empty bucket ladder — no bucket can hold {value}")
     for b in buckets:
         if value <= b:
             return int(b)

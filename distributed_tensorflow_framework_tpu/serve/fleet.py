@@ -100,6 +100,10 @@ _MAX_BODY = 64 * 1024 * 1024  # refuse absurd request bodies outright
 # Client-facing QoS / multiplexing headers (docs/SERVING.md).
 TENANT_HEADER = "X-DTF-Tenant"
 MODEL_HEADER = "X-DTF-Model"
+# Decode-session affinity: a generation session's KV pages live on ONE
+# replica, so every /generate carrying the same X-DTF-Session value must
+# land there (docs/SERVING.md "Autoregressive decode").
+SESSION_HEADER = "X-DTF-Session"
 
 
 class FleetError(RuntimeError):
@@ -250,6 +254,13 @@ class FleetRouter:
         self._shed = 0
         self._deadline_exceeded = 0
         self._reload_rolls = 0
+        # Decode-session affinity map (session id → replica index),
+        # written under the router lock. Entries are dropped when the
+        # pinned replica leaves the routable set for good (dead /
+        # retired / ejected) so a later request repins cleanly.
+        self._sessions: dict[str, int] = {}
+        self._generate_streams = 0
+        self._affinity_misses = 0
         # Multi-tenant QoS: per-tenant router ledger (routed / shed /
         # quota_rejected, exposed on /healthz) + the token buckets.
         self._tenants: dict[str, dict] = {}
@@ -302,6 +313,8 @@ class FleetRouter:
             def do_POST(self):
                 if self.path == "/predict":
                     outer.handle_predict(self)
+                elif self.path == "/generate":
+                    outer.handle_generate(self)
                 elif self.path == "/reload":
                     outer.handle_reload(self)
                 else:
@@ -676,6 +689,149 @@ class FleetRouter:
             log.exception("proxy predict failed")
             handler._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
+    def _claim_for_session(
+            self, session: str | None) -> tuple[Replica | None, float | None]:
+        """Claim the replica a decode session is pinned to.
+
+        Returns ``(replica, None)`` on success, ``(None, retry_after_s)``
+        when the pinned replica is mid-drain (rolling reload: its KV
+        pages survive the drain, so the honest answer is "come back in a
+        moment", not a silent repin that loses the session's cache), and
+        ``(None, None)`` when nothing is routable. A pinned replica that
+        is dead/ejected/retired has already lost the session's pages —
+        repin silently to a fresh claim."""
+        now = time.monotonic()
+        if session:
+            with self._lock:
+                pinned = self._sessions.get(session)
+                if pinned is not None and pinned < len(self._replicas):
+                    rep = self._replicas[pinned]
+                    if rep.state == "draining":
+                        self._affinity_misses += 1
+                        return None, self.cfg.fleet_shed_retry_after_s
+                    if (rep.state == "admitted" and not rep.give_up
+                            and rep.stalled_until <= now):
+                        rep.inflight += 1
+                        return rep, None
+                    self._sessions.pop(session, None)
+        rep = self._claim_replica(set())
+        if rep is not None and session:
+            with self._lock:
+                self._sessions[session] = rep.index
+        return rep, None
+
+    def handle_generate(self, handler) -> None:
+        """Proxy one streamed ``/generate`` to a session-pinned replica.
+
+        Unlike /predict this is NOT hedged or retried: a generation
+        stream is stateful (KV pages on one replica) and not idempotent
+        once tokens start flowing, so a mid-stream transport failure
+        surfaces to the client instead of silently restarting the
+        stream elsewhere. 409 + Retry-After = the session's replica is
+        draining for a rolling reload; retry the same session after the
+        pause and it lands back on the reloaded replica."""
+        if self._draining.is_set():
+            handler._reply(503, {"error": "draining", "retryable": True})
+            return
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+            if length <= 0 or length > _MAX_BODY:
+                handler._reply(400, {"error": f"bad Content-Length {length}"})
+                return
+            body = handler.rfile.read(length)
+            session = handler.headers.get(SESSION_HEADER) or None
+            rep, retry_after = self._claim_for_session(session)
+            if retry_after is not None:
+                handler._reply(
+                    409,
+                    {"error": f"session {session!r} is pinned to a "
+                              f"replica that is draining for a reload — "
+                              f"retry unchanged",
+                     "retryable": True, "session": session},
+                    headers={"Retry-After": f"{retry_after:g}"})
+                return
+            if rep is None:
+                handler._reply(
+                    503,
+                    {"error": "no admitted replica for generate",
+                     "retryable": True, "shed": True},
+                    headers={"Retry-After":
+                             f"{self.cfg.fleet_shed_retry_after_s:g}"})
+                return
+        except Exception as e:  # noqa: BLE001 — router must outlive a bad request
+            log.exception("generate claim failed")
+            handler._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        t0 = time.monotonic()
+        status = 0
+        try:
+            req = urllib.request.Request(
+                rep.url + "/generate", data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                resp = urllib.request.urlopen(
+                    req, timeout=self.cfg.fleet_deadline_s)
+            except urllib.error.HTTPError as e:
+                # Submit-time rejection (400/503/...) — relay verbatim;
+                # a 4xx is the request's fault, not the replica's.
+                status = e.code
+                try:
+                    payload = json.loads(e.read() or b"{}")
+                except (ValueError, OSError):
+                    payload = {"error": f"replica status {e.code}"}
+                if status >= 500:
+                    self._record_failure(
+                        rep, f"generate failed (status {status})")
+                handler._reply(status, payload,
+                               headers={"X-DTF-Replica": rep.label})
+                return
+            with resp:
+                status = resp.status
+                handler.send_response(status)
+                handler.send_header(
+                    "Content-Type",
+                    resp.headers.get("Content-Type",
+                                     "application/x-ndjson"))
+                handler.send_header("Transfer-Encoding", "chunked")
+                handler.send_header("X-DTF-Replica", rep.label)
+                handler.end_headers()
+                # http.client undoes the replica's chunking; readline
+                # re-streams each NDJSON event the moment it arrives.
+                for line in resp:
+                    handler.wfile.write(
+                        f"{len(line):X}\r\n".encode() + line + b"\r\n")
+                    handler.wfile.flush()
+                handler.wfile.write(b"0\r\n\r\n")
+                handler.wfile.flush()
+            self._record_success(rep)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            self._record_failure(rep, f"generate failed ({type(e).__name__})")
+            if status == 0:
+                # Nothing on the wire yet — a clean retryable error.
+                try:
+                    handler._reply(
+                        503, {"error": f"{type(e).__name__}: {e}",
+                              "retryable": True},
+                        headers={"Retry-After":
+                                 f"{self.cfg.fleet_shed_retry_after_s:g}"})
+                except OSError:
+                    pass
+            else:
+                log.warning("generate stream to %s aborted mid-flight: "
+                            "%s: %s", rep.label, type(e).__name__, e)
+        finally:
+            self._release_replica(rep)
+            with self._lock:
+                self._requests += 1
+                self._generate_streams += 1
+            if self._tw:
+                self._tw.emit(
+                    telemetry.KIND_SERVE_ROUTE,
+                    metrics={"latency_ms": (time.monotonic() - t0) * 1e3,
+                             "retries": 0, "status": status},
+                    replica=rep.label, shed=False,
+                    deadline_exceeded=False, tenant=None, trace=None)
+
     def handle_reload(self, handler) -> None:
         if self._draining.is_set():
             handler._reply(503, {"error": "draining", "retryable": True})
@@ -756,6 +912,9 @@ class FleetRouter:
             router = {
                 "requests": self._requests,
                 "retries": self._retries_total,
+                "generate_streams": self._generate_streams,
+                "sessions": len(self._sessions),
+                "affinity_misses": self._affinity_misses,
                 "shed": self._shed,
                 "deadline_exceeded": self._deadline_exceeded,
                 "reload_rolls": self._reload_rolls,

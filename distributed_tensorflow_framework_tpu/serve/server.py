@@ -35,6 +35,10 @@ import numpy as np
 
 from distributed_tensorflow_framework_tpu.core import telemetry, tracing
 from distributed_tensorflow_framework_tpu.core.config import ServeConfig
+from distributed_tensorflow_framework_tpu.serve.decode import (
+    CacheFullError,
+    StreamTooLongError,
+)
 from distributed_tensorflow_framework_tpu.serve.engine import (
     EngineClosedError,
     InferenceEngine,
@@ -67,8 +71,12 @@ class ServingServer:
     """Engine + ThreadingHTTPServer, owning the drain choreography."""
 
     def __init__(self, engine: InferenceEngine, serve_cfg: ServeConfig, *,
-                 telemetry_writer=None):
+                 decode_engine=None, telemetry_writer=None):
         self.engine = engine
+        # Optional serve/decode.DecodeEngine (decode.enabled + mlm task):
+        # adds the streaming POST /generate route; None keeps the server
+        # byte-identical to a single-shot deployment.
+        self.decode_engine = decode_engine
         self.cfg = serve_cfg
         self._tw = telemetry_writer
         self._draining = threading.Event()
@@ -99,6 +107,8 @@ class ServingServer:
             def do_POST(self):
                 if self.path == "/predict":
                     outer.handle_predict(self)
+                elif self.path == "/generate":
+                    outer.handle_generate(self)
                 elif self.path == "/reload":
                     outer.handle_reload(self)
                 else:
@@ -174,6 +184,108 @@ class ServingServer:
                 span.end(status="ok" if status < 400 else f"http_{status}",
                          http_status=status)
 
+    @staticmethod
+    def _write_chunk(handler, data: bytes, flush: bool = True) -> None:
+        """One HTTP/1.1 chunked-transfer frame — a token event must
+        reach the client the moment it exists (TTFT/TPOT are measured
+        from these frame arrivals, docs/SERVING.md). ``flush=False``
+        lets the generate loop coalesce a burst of already-queued
+        frames into one syscall; the burst's LAST frame always flushes."""
+        handler.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        if flush:
+            handler.wfile.flush()
+
+    @staticmethod
+    def _end_chunks(handler) -> None:
+        handler.wfile.write(b"0\r\n\r\n")
+        handler.wfile.flush()
+
+    def handle_generate(self, handler) -> None:
+        """``POST /generate {"prompt": [ids...], ...}`` — streamed
+        autoregressive decode. The reply is chunked NDJSON: one
+        ``{"token": ..., "index": ...}`` line per generated token as the
+        continuous batcher produces it, closed by one ``{"done": true,
+        ...summary}`` line. Submit-time errors map like /predict
+        (too-long/never-fits → 400, backpressure/draining → 503
+        retryable); a mid-stream failure becomes an ``{"error": ...}``
+        line because the 200 status is already on the wire."""
+        if self.decode_engine is None:
+            handler._reply(404, {
+                "error": "decode disabled — set decode.enabled=true and "
+                         "serve an mlm artifact"})
+            return
+        try:
+            if self._draining.is_set():
+                handler._reply(503, {"error": "draining", "retryable": True})
+                return
+            length = int(handler.headers.get("Content-Length", 0))
+            if length <= 0 or length > _MAX_BODY:
+                handler._reply(400, {"error": f"bad Content-Length {length}"})
+                return
+            payload = json.loads(handler.rfile.read(length))
+            prompt = payload.get("prompt")
+            if not isinstance(prompt, list) or not prompt:
+                handler._reply(400, {
+                    "error": "body must be {\"prompt\": [token ids...]}"})
+                return
+            stream = self.decode_engine.submit(
+                prompt,
+                max_new_tokens=payload.get("max_new_tokens"),
+                eos_id=payload.get("eos_id"),
+                return_logits=bool(payload.get("return_logits")))
+        except (StreamTooLongError, CacheFullError) as e:
+            # Neither gets better on retry: the stream as requested can
+            # never be admitted.
+            handler._reply(400, {"error": str(e)})
+            return
+        except (QueueFullError, EngineClosedError) as e:
+            handler._reply(503, {"error": str(e), "retryable": True})
+            return
+        except ServeError as e:
+            handler._reply(400, {"error": str(e)})
+            return
+        except json.JSONDecodeError as e:
+            handler._reply(400, {"error": f"invalid JSON: {e}"})
+            return
+        except Exception as e:  # noqa: BLE001 — server must outlive a bad request
+            log.exception("generate submit failed")
+            handler._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.send_header("X-DTF-Step",
+                            str(self.decode_engine.artifact.step))
+        handler.end_headers()
+        try:
+            for kind, ev in stream.events(timeout=self.cfg.drain_timeout_s):
+                if kind == "token":
+                    if "logits" in ev:
+                        line = json.dumps(
+                            dict(ev, logits=ev["logits"].tolist()))
+                    else:
+                        # Hand-rolled frame for the hot path: at one
+                        # frame per generated token, json.dumps is
+                        # measurable scheduler-thread GIL steal.
+                        line = ('{"token":%d,"index":%d}'
+                                % (ev["token"], ev["index"]))
+                else:
+                    line = json.dumps({"done": True, **ev})
+                self._write_chunk(handler, (line + "\n").encode(),
+                                  flush=stream.pending() == 0)
+            self._end_chunks(handler)
+        except Exception as e:  # noqa: BLE001 — status already on the wire
+            log.warning("generate stream aborted: %s: %s",
+                        type(e).__name__, e)
+            try:
+                self._write_chunk(handler, (json.dumps(
+                    {"error": f"{type(e).__name__}: {e}",
+                     "retryable": isinstance(e, EngineClosedError)})
+                    + "\n").encode())
+                self._end_chunks(handler)
+            except OSError:
+                pass  # client already gone
+
     def handle_reload(self, handler) -> None:
         """``POST /reload {"artifact_dir": ...}`` — live weight swap.
 
@@ -198,6 +310,21 @@ class ServingServer:
                 return
             result = self.engine.reload(
                 artifact_dir, timeout=self.cfg.drain_timeout_s)
+            if self.decode_engine is not None:
+                # Same artifact, second engine: the decode swap blocks
+                # until its in-flight streams finish on the old weights
+                # (decode.request_reload drain contract), so give it the
+                # full drain budget. A decode-side rejection is the same
+                # 409 contract — but the single-shot engine already
+                # swapped, so say so.
+                try:
+                    decode_result = self.decode_engine.reload(
+                        artifact_dir, timeout=self.cfg.drain_timeout_s)
+                except ReloadError as e:
+                    raise ReloadError(
+                        f"decode engine rejected the reload (single-shot "
+                        f"engine already swapped): {e}") from e
+                result = {**result, "decode": decode_result}
             handler._reply(200, {"reloaded": True, **result})
         except ReloadError as e:
             handler._reply(409, {"error": str(e), "reloaded": False})
@@ -228,6 +355,10 @@ class ServingServer:
             # compute fraction to its own traffic (docs/OBSERVABILITY.md).
             "memory": self.engine.memory_snapshot(),
             "goodput": self.engine.goodput_snapshot(),
+            # KV-cache occupancy + stream counters when the decode path
+            # is enabled (None otherwise, schema-additive).
+            "decode": (self.decode_engine.stats()
+                       if self.decode_engine is not None else None),
         })
 
     # ------------------------------------------------------------- drain
@@ -245,6 +376,11 @@ class ServingServer:
         log.info("drain started (%s): refusing new requests, %d queued",
                  reason, self.engine.stats()["queue_depth"])
         drained = self.engine.drain(self.cfg.drain_timeout_s)
+        if self.decode_engine is not None:
+            # Streams still get their remaining tokens during the drain
+            # window — a deploy must not truncate mid-generation.
+            drained = self.decode_engine.drain(
+                self.cfg.drain_timeout_s) and drained
         if self._tw:
             self._tw.emit(
                 telemetry.KIND_HEALTH,

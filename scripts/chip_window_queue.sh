@@ -279,4 +279,66 @@ else
   echo "--- [gang-probe] backend cannot run multi-process gangs — skipping §15"
 fi
 
+# 16. Autoregressive decode A/Bs (ISSUE 18, docs/SERVING.md
+#     "Autoregressive decode"): one down-scaled BERT mlm artifact, then
+#     two self-contained dials against standing decode servers:
+#     (a) continuous batching vs the static batch-synchronous arm on
+#         the mixed-length workload (every 8th stream runs the full
+#         token budget, the rest an eighth) — the win is the tokens/s +
+#         TTFT spread between DECODE_BENCH_{continuous,static}.json
+#         (CPU-verified >= 2x; the chip question is what the ratio does
+#         when a decode step stops being CPU-dispatch-bound);
+#     (b) f32 vs int8 KV pages on the continuous arm — ~4x resident
+#         streams per replica for a per-token logit drift inside the
+#         block-codec bound; the JSON's ttft/tpot + decode_delta
+#         sections carry the capacity-vs-latency story. Drained via
+#     SIGTERM like every serving arm (exit 0 = clean drain).
+decode_ab() {
+  local label="$1"; shift
+  python -m distributed_tensorflow_framework_tpu.cli.serve \
+      --artifact /tmp/chipq_decode/artifact \
+      --set serve.port=0 \
+      --set serve.log_dir=/tmp/chipq_decode/logs_"$label" \
+      --set decode.enabled=true --set decode.max_len=128 \
+      --set decode.page_size=16 --set decode.num_pages=256 \
+      --set decode.max_streams=8 --set decode.max_new_tokens=96 \
+      --set decode.stream_interval=8 "$@" \
+      > /tmp/chipq_decode_"$label".log 2>&1 &
+  local pid=$!
+  for _ in $(seq 120); do
+    [ -f /tmp/chipq_decode/logs_"$label"/endpoint.json ] && break
+    sleep 1
+  done
+  run decode-"$label" python scripts/load_gen.py \
+      --endpoint /tmp/chipq_decode/logs_"$label"/endpoint.json \
+      --mode decode --requests 64 --concurrency 8 \
+      --max-new-tokens 96 --out DECODE_BENCH_"$label".json
+  kill -TERM "$pid" 2>/dev/null
+  wait "$pid"
+  echo "--- [decode-$label] drain rc=$? (0 = clean SIGTERM drain)"
+}
+rm -rf /tmp/chipq_decode
+run decode-train python train.py --config configs/bert_base_mlm.yaml \
+    --set data.name=synthetic_mlm --set train.total_steps=30 \
+    --set model.hidden_size=256 --set model.num_layers=4 \
+    --set model.num_heads=4 --set model.mlp_dim=1024 \
+    --set model.max_seq_len=128 --set data.seq_len=128 \
+    --set data.global_batch_size=32 --set train.eval_steps=0 \
+    --set train.eval_interval=0 \
+    --set checkpoint.directory=/tmp/chipq_decode/ckpt \
+    --set checkpoint.save_interval_steps=30 \
+    --set checkpoint.async_save=false
+run decode-export python -m distributed_tensorflow_framework_tpu.cli.export \
+    --config configs/bert_base_mlm.yaml \
+    --set data.name=synthetic_mlm \
+    --set model.hidden_size=256 --set model.num_layers=4 \
+    --set model.num_heads=4 --set model.mlp_dim=1024 \
+    --set model.max_seq_len=128 --set data.seq_len=128 \
+    --set checkpoint.directory=/tmp/chipq_decode/ckpt \
+    --set serve.allow_reshard=true --output /tmp/chipq_decode/artifact
+decode_ab continuous --set decode.scheduler=continuous
+decode_ab static     --set decode.scheduler=static
+decode_ab int8       --set decode.scheduler=continuous \
+                     --set decode.kv_dtype=int8
+
 echo "=== chip queue done $(date -u +%FT%TZ) ==="

@@ -58,6 +58,10 @@ from distributed_tensorflow_framework_tpu.core.metrics import (  # noqa: E402
 # request attribution: requests/ok/errors/by_status, present when
 # --tenants assigns X-DTF-Tenant classes) and the fleet section's
 # "tenants" ledger snapshot from the router's healthz.
+# --mode decode adds a run entry with mode "decode" (streamed /generate):
+# per-stream TTFT + per-token TPOT percentiles, tokens/s, and a
+# "decode_delta" of the server's decode healthz counters over the
+# window. Every /1 and /2 field is unchanged — still schema-additive.
 BENCH_SCHEMA = "dtf-serve-bench/2"
 
 # Open-loop traffic shapes (--shape): per-request due times against the
@@ -192,6 +196,179 @@ def post_predict(url: str, payload: dict, timeout: float = 60.0,
         return 0, (time.monotonic() - t0) * 1e3, 0, None
 
 
+def stream_generate(url: str, prompt: list[int], *, max_new: int,
+                    session: str, timeout: float = 300.0) -> dict:
+    """One streamed /generate exchange, timed per token frame.
+
+    Returns {"status", "ttft_ms", "tpot_ms" (list), "tokens",
+    "latency_ms", "replica", "retried_409"}. TTFT is dispatch → first
+    token frame; each TPOT sample is the gap between consecutive token
+    frames. A 409 from the fleet router (session pinned to a draining
+    replica during a rolling reload) is retried after its Retry-After —
+    the contract says the stream succeeds on the reloaded replica, so a
+    bounded retry loop is part of the client protocol, not cheating."""
+    body = json.dumps({"prompt": prompt,
+                       "max_new_tokens": max_new}).encode()
+    headers = {"Content-Type": "application/json",
+               "X-DTF-Session": session}
+    retried_409 = 0
+    t0 = time.monotonic()
+    for _ in range(20):
+        req = urllib.request.Request(url + "/generate", data=body,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                replica = resp.headers.get("X-DTF-Replica")
+                ttft = None
+                tpot: list[float] = []
+                tokens = 0
+                t_prev = time.monotonic()
+                for line in resp:
+                    if not line.strip():
+                        continue
+                    event = json.loads(line)
+                    now = time.monotonic()
+                    if "token" in event:
+                        if ttft is None:
+                            ttft = (now - t0) * 1e3
+                        else:
+                            tpot.append((now - t_prev) * 1e3)
+                        t_prev = now
+                        tokens += 1
+                    elif "error" in event:
+                        return {"status": 0, "ttft_ms": ttft,
+                                "tpot_ms": tpot, "tokens": tokens,
+                                "latency_ms": (now - t0) * 1e3,
+                                "replica": replica,
+                                "retried_409": retried_409}
+                return {"status": resp.status, "ttft_ms": ttft,
+                        "tpot_ms": tpot, "tokens": tokens,
+                        "latency_ms": (time.monotonic() - t0) * 1e3,
+                        "replica": replica, "retried_409": retried_409}
+        except urllib.error.HTTPError as e:
+            e.read()
+            if e.code == 409:
+                retried_409 += 1
+                time.sleep(float(e.headers.get("Retry-After") or 0.5))
+                continue
+            return {"status": e.code, "ttft_ms": None, "tpot_ms": [],
+                    "tokens": 0,
+                    "latency_ms": (time.monotonic() - t0) * 1e3,
+                    "replica": e.headers.get("X-DTF-Replica"),
+                    "retried_409": retried_409}
+        except (urllib.error.URLError, OSError, TimeoutError,
+                ValueError):
+            return {"status": 0, "ttft_ms": None, "tpot_ms": [],
+                    "tokens": 0,
+                    "latency_ms": (time.monotonic() - t0) * 1e3,
+                    "replica": None, "retried_409": retried_409}
+    return {"status": 409, "ttft_ms": None, "tpot_ms": [], "tokens": 0,
+            "latency_ms": (time.monotonic() - t0) * 1e3, "replica": None,
+            "retried_409": retried_409}
+
+
+def _drive_decode(url: str, prompts: list[list[int]], *, concurrency: int,
+                  max_new: int, seed: int = 0) -> dict:
+    """Closed-loop decode run: ``concurrency`` workers each hold one
+    stream open — that concurrency IS the continuous batcher's offered
+    occupancy. TTFT and TPOT reservoirs are the streaming SLO story;
+    tokens/s is the aggregate the A/B drills compare."""
+    ttft_r = PercentileReservoir()
+    tpot_r = PercentileReservoir()
+    latency = PercentileReservoir()
+    lock = threading.Lock()
+    counts = {"ok": 0, "errors": 0, "tokens": 0, "by_status": {},
+              "by_replica": {}, "retried_409": 0}
+    idx = {"next": 0}
+
+    def worker():
+        while True:
+            with lock:
+                i = idx["next"]
+                if i >= len(prompts):
+                    return
+                idx["next"] = i + 1
+            # Mixed stream lengths: every 8th stream runs the full token
+            # budget, the rest an eighth. This is the churn continuous
+            # batching exists for — a static batcher idles 7 finished
+            # slots while the long stream runs out; uniform lengths
+            # would finish in lockstep and hide the difference.
+            mn = max_new if i % 8 == 0 else max(2, max_new // 8)
+            out = stream_generate(url, prompts[i], max_new=mn,
+                                  session=f"lg-{seed}-{i}")
+            with lock:
+                key = str(out["status"])
+                counts["by_status"][key] = \
+                    counts["by_status"].get(key, 0) + 1
+                if out["replica"] is not None:
+                    counts["by_replica"][out["replica"]] = \
+                        counts["by_replica"].get(out["replica"], 0) + 1
+                counts["retried_409"] += out["retried_409"]
+                counts["tokens"] += out["tokens"]
+                latency.add(out["latency_ms"])
+                if out["ttft_ms"] is not None:
+                    ttft_r.add(out["ttft_ms"])
+                for ms in out["tpot_ms"]:
+                    tpot_r.add(ms)
+                if out["status"] == 200 and out["tokens"] > 0:
+                    counts["ok"] += 1
+                else:
+                    counts["errors"] += 1
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = max(time.monotonic() - t_start, 1e-9)
+    lat, ttft, tpot = latency.summary(), ttft_r.summary(), tpot_r.summary()
+    return {
+        "mode": "decode",
+        "requests": len(prompts),
+        "ok": counts["ok"],
+        "errors": counts["errors"],
+        "by_status": counts["by_status"],
+        "rows": counts["tokens"],  # /1 uniformity: rows == tokens here
+        "tokens": counts["tokens"],
+        "retried_409": counts["retried_409"],
+        "elapsed_s": elapsed,
+        "requests_per_sec": counts["ok"] / elapsed,
+        "rows_per_sec": counts["tokens"] / elapsed,
+        "tokens_per_sec": counts["tokens"] / elapsed,
+        "latency_ms": {"p50": lat["p50"], "p90": lat["p90"],
+                       "p99": lat["p99"], "mean": lat["mean"],
+                       "count": lat["count"]},
+        "ttft_ms": {"p50": ttft["p50"], "p90": ttft["p90"],
+                    "p99": ttft["p99"], "mean": ttft["mean"],
+                    "count": ttft["count"]},
+        "tpot_ms": {"p50": tpot["p50"], "p90": tpot["p90"],
+                    "p99": tpot["p99"], "mean": tpot["mean"],
+                    "count": tpot["count"]},
+        **({"by_replica": dict(sorted(counts["by_replica"].items()))}
+           if counts["by_replica"] else {}),
+        "concurrency": concurrency,
+    }
+
+
+def make_prompts(n: int, *, vocab_size: int, max_len: int, max_new: int,
+                 rng: random.Random) -> list[list[int]]:
+    """Variable-length decode prompts: mostly short with a heavy tail,
+    so the continuous batcher's join/leave churn actually exercises
+    (uniform lengths would finish in lockstep like a static batch)."""
+    cap = max(1, max_len - max_new)
+    prompts = []
+    for _ in range(n):
+        if rng.random() < 0.25:  # heavy tail: near-cap prompts
+            length = rng.randint(max(1, cap * 3 // 4), cap)
+        else:
+            length = rng.randint(1, max(1, cap // 4))
+        prompts.append(
+            [rng.randrange(1, max(2, vocab_size)) for _ in range(length)])
+    return prompts
+
+
 def _drive(url: str, payloads: list[dict], *, concurrency: int,
            rate: float | None, shape: str = "uniform",
            spike_factor: float = 4.0,
@@ -297,31 +474,44 @@ def run_bench(endpoint: str, *, requests: int = 256, concurrency: int = 32,
               rows: int = 1, rate: float = 100.0, mode: str = "both",
               seed: int = 0, shape: str = "uniform",
               spike_factor: float = 4.0,
-              tenant_mix: str | None = None) -> dict:
+              tenant_mix: str | None = None,
+              max_new_tokens: int = 32) -> dict:
     url = resolve_endpoint(endpoint)
     health = fetch_healthz(url)
     spec = health["input_spec"]
     engine0 = health.get("engine", {})
     rng = random.Random(seed)
-    seq_buckets = [int(b) for b in engine0.get("seq_buckets", [])]
-    payloads = [
-        make_payload(spec, rows, vocab_size=int(health.get("vocab_size", 2)),
-                     rng=rng, seq_buckets=seq_buckets)
-        for _ in range(requests)]
-    mix = parse_tenants(tenant_mix)
-    tenants = None
-    if mix:
-        names = [name for name, _ in mix]
-        weights = [w for _, w in mix]
-        tenants = rng.choices(names, weights=weights, k=requests)
     runs = []
-    if mode in ("closed", "both"):
-        runs.append(_drive(url, payloads, concurrency=concurrency,
-                           rate=None, tenants=tenants))
-    if mode in ("open", "both"):
-        runs.append(_drive(url, payloads, concurrency=concurrency,
-                           rate=rate, shape=shape,
-                           spike_factor=spike_factor, tenants=tenants))
+    if mode == "decode":
+        decode0 = health.get("decode") or {}
+        max_len = int(decode0.get("max_len")
+                      or (spec.get("input_ids") or {"shape": [128]}
+                          )["shape"][0])
+        prompts = make_prompts(
+            requests, vocab_size=int(health.get("vocab_size", 2)),
+            max_len=max_len, max_new=max_new_tokens, rng=rng)
+        runs.append(_drive_decode(url, prompts, concurrency=concurrency,
+                                  max_new=max_new_tokens, seed=seed))
+    else:
+        seq_buckets = [int(b) for b in engine0.get("seq_buckets", [])]
+        payloads = [
+            make_payload(spec, rows,
+                         vocab_size=int(health.get("vocab_size", 2)),
+                         rng=rng, seq_buckets=seq_buckets)
+            for _ in range(requests)]
+        mix = parse_tenants(tenant_mix)
+        tenants = None
+        if mix:
+            names = [name for name, _ in mix]
+            weights = [w for _, w in mix]
+            tenants = rng.choices(names, weights=weights, k=requests)
+        if mode in ("closed", "both"):
+            runs.append(_drive(url, payloads, concurrency=concurrency,
+                               rate=None, tenants=tenants))
+        if mode in ("open", "both"):
+            runs.append(_drive(url, payloads, concurrency=concurrency,
+                               rate=rate, shape=shape,
+                               spike_factor=spike_factor, tenants=tenants))
     health1 = fetch_healthz(url)
     engine1 = health1.get("engine", {})
     # Against a fleet router: the router-counter deltas over the bench
@@ -362,6 +552,19 @@ def run_bench(endpoint: str, *, requests: int = 256, concurrency: int = 32,
     }
     if split["padded_rows"]:
         split["fill"] = split["batch_rows"] / split["padded_rows"]
+    # Decode healthz deltas over the window (single server with
+    # decode.enabled; absent against routers/pre-decode servers): the
+    # server-side view of tokens/steps/evictions this traffic caused.
+    decode_delta = None
+    if (health1.get("decode") or {}) and mode == "decode":
+        d0, d1 = health.get("decode") or {}, health1.get("decode") or {}
+        decode_delta = {
+            key: d1.get(key, 0) - d0.get(key, 0)
+            for key in ("tokens", "steps", "streams_done", "evictions")}
+        decode_delta["compiled_buckets"] = d1.get("compiled_buckets")
+        decode_delta["avg_occupancy"] = d1.get("avg_occupancy")
+        decode_delta["scheduler"] = d1.get("scheduler")
+        decode_delta["kv_dtype"] = d1.get("kv_dtype")
     return {
         "schema": BENCH_SCHEMA,
         "endpoint": url,
@@ -371,6 +574,7 @@ def run_bench(endpoint: str, *, requests: int = 256, concurrency: int = 32,
         "rows_per_request": rows,
         "runs": runs,
         "fleet": fleet,
+        "decode_delta": decode_delta,
         "server_split": split,
         "server_latency": engine1.get("latency"),
         # Healthz deltas across the window: serve-side HBM pressure (peak
@@ -400,8 +604,15 @@ def main(argv=None) -> int:
                     help="rows per request")
     ap.add_argument("--rate", type=float, default=100.0,
                     help="open-loop offered rate (req/s)")
-    ap.add_argument("--mode", choices=("closed", "open", "both"),
-                    default="both")
+    ap.add_argument("--mode", choices=("closed", "open", "both", "decode"),
+                    default="both",
+                    help="decode = streamed /generate against a "
+                         "decode-enabled endpoint (TTFT/TPOT/tokens-per-"
+                         "sec instead of request latency)")
+    ap.add_argument("--max-new-tokens", type=int, default=32,
+                    help="token budget in --mode decode: every 8th "
+                         "stream decodes the full budget, the rest a "
+                         "quarter (mixed-length churn)")
     ap.add_argument("--shape", choices=SHAPES, default="uniform",
                     help="open-loop traffic shape (spike/ramp/diurnal "
                          "replay realistic load against the base --rate)")
@@ -419,7 +630,8 @@ def main(argv=None) -> int:
             args.endpoint, requests=args.requests,
             concurrency=args.concurrency, rows=args.rows, rate=args.rate,
             mode=args.mode, seed=args.seed, shape=args.shape,
-            spike_factor=args.spike_factor, tenant_mix=args.tenants)
+            spike_factor=args.spike_factor, tenant_mix=args.tenants,
+            max_new_tokens=args.max_new_tokens)
     except (urllib.error.URLError, OSError, FileNotFoundError) as e:
         print(f"error: cannot reach {args.endpoint}: {e}", file=sys.stderr)
         return 1
@@ -431,6 +643,13 @@ def main(argv=None) -> int:
         print(f"{run['mode']:>6}: {run['ok']}/{run['requests']} ok, "
               f"{run['requests_per_sec']:.1f} req/s, "
               f"p50 {lat['p50']:.1f} ms, p99 {lat['p99']:.1f} ms")
+        if run["mode"] == "decode":
+            ttft, tpot = run["ttft_ms"], run["tpot_ms"]
+            print(f"        {run['tokens']} tokens, "
+                  f"{run['tokens_per_sec']:.1f} tok/s, "
+                  f"ttft p50 {ttft['p50']:.1f}/p99 {ttft['p99']:.1f} ms, "
+                  f"tpot p50 {tpot['p50']:.1f}/p99 {tpot['p99']:.1f} ms, "
+                  f"{run['retried_409']} retried 409s")
         for tenant, led in (run.get("by_tenant") or {}).items():
             print(f"        tenant {tenant}: {led['ok']}/{led['requests']}"
                   f" ok ({led['by_status']})")

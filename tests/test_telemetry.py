@@ -507,6 +507,13 @@ def test_summarize_rolls_up_every_kind(tmp_path):
            throughput={"requests_per_sec": 10.0, "rows_per_sec": 20.0})
     w.emit(telemetry.KIND_SERVE_RECOMPILE, bucket="rows2",
            metrics={"compile_ms": 50.0})
+    w.emit(telemetry.KIND_DECODE_STEP,
+           metrics={"rows": 3, "padded_rows": 4, "step_ms": 6.0,
+                    "per_token_ms": 2.0, "occupancy": 0.75})
+    w.emit(telemetry.KIND_KV_CACHE,
+           metrics={"pages_used": 5, "pages_free": 3, "streams_active": 2,
+                    "streams_waiting": 1, "evictions": 1},
+           event="periodic")
     w.emit(telemetry.KIND_SERVE_ROUTE,
            metrics={"latency_ms": 5.0, "retries": 1, "status": 200},
            replica="r0", shed=False, deadline_exceeded=False)
@@ -558,6 +565,10 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert s["fleet"]["scaling"]["ups"] == 1
     assert s["fleet"]["scaling"]["events"][0]["to_replicas"] == 4
     assert s["fleet"]["tenants"]["batch:nightly"]["shed"] == 1
+    assert s["decode"]["tokens"] == 3 and s["decode"]["steps"] == 1
+    assert s["decode"]["pages_used_max"] == 5
+    assert s["decode"]["evictions"] == 1
+    assert s["decode"]["streams_waiting_max"] == 1
     assert s["zero"]["shards"] == 8 and s["zero"]["buckets"] == 3
     assert s["goodput"]["attempts"] == 1
     assert s["goodput"]["goodput_frac"] == pytest.approx(0.8)
@@ -573,6 +584,8 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert "trace summaries: 1" in text
     assert "health events: moe_collapse=1" in text
     assert "serving: 1 requests (2 rows) in 1 batches" in text
+    assert "decode: 3 tokens in 1 steps" in text
+    assert "kv cache: peak 5 pages in use" in text
     assert "bucket recompiles: 1 (rows2)" in text
     assert "fleet: 1 proxied" in text and "ejections: 1" in text
     assert "scaling: 1 up / 0 down (up->4@0.91)" in text
