@@ -60,6 +60,7 @@ from distributed_tensorflow_framework_tpu.ckpt.async_saver import AsyncSaver
 from distributed_tensorflow_framework_tpu.core import faults, telemetry
 from distributed_tensorflow_framework_tpu.core.config import CheckpointConfig
 from distributed_tensorflow_framework_tpu.parallel import zero
+from distributed_tensorflow_framework_tpu.data import shard as data_shard
 from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
 from distributed_tensorflow_framework_tpu.train.state import TrainState
 
@@ -130,6 +131,34 @@ class CheckpointManager:
             ),
         )
         self._saver = AsyncSaver() if config.async_save else None
+        # Exactly-once data plumbing (data/shard.py): the Trainer wires in
+        # the live infeed's watermark() and the dataset's repartition
+        # capability so every manifest commit record can describe the
+        # saved iterator state, and the restore gate knows whether an
+        # N→M host refit may repartition it.
+        self._watermark_source = None
+        self._data_repartition = data_shard.REPARTITION_NONE
+        self._data_resume_strict = True
+
+    def set_data_sources(self, *, watermark_source=None,
+                         repartition: str | None = None,
+                         resume_strict: bool | None = None) -> None:
+        """Wire the data plane into save/restore commit records.
+
+        ``watermark_source`` is the live infeed's ``watermark()`` (batches
+        prefetched ahead at save time — telemetry only); ``repartition``
+        the dataset's capability tag; ``resume_strict`` the
+        ``data.resume_strict`` knob gating the restore-time digest /
+        host-count checks. The Trainer calls this before restore (tag +
+        strictness) and again at train start (watermark), clearing the
+        watermark source in its shutdown path — a dead infeed's queue
+        must not be polled by a final save.
+        """
+        self._watermark_source = watermark_source
+        if repartition is not None:
+            self._data_repartition = repartition
+        if resume_strict is not None:
+            self._data_resume_strict = bool(resume_strict)
 
     def _emit(self, kind: str, **fields: Any) -> None:
         if self._telemetry is not None:
@@ -147,7 +176,8 @@ class CheckpointManager:
     def _write_and_commit(self, step: int, packed_state: Any,
                           dataset_state: dict | None, *, force: bool,
                           t_begin: float, blocked_s: float | None,
-                          topology: dict | None = None) -> bool:
+                          topology: dict | None = None,
+                          watermark: int = 0) -> bool:
         """The full durable commit sequence — orbax write, fault points,
         manifest hash + fsync + atomic rename, telemetry. Runs on the
         saver thread (async) or inline (sync fallback); identical either
@@ -169,9 +199,23 @@ class CheckpointManager:
             # it fires on the saver thread (SIGKILL still takes the whole
             # process — core/faults.py).
             faults.fire("ckpt_in_save", step=step)
-            mf.write_manifest(
-                step_dir, step,
-                extra={reshard.MESH_RECORD_KEY: topology} if topology else None)
+            extra: dict = {}
+            if topology:
+                extra[reshard.MESH_RECORD_KEY] = topology
+            if dataset_state is not None:
+                # Data-state commit record (data/shard.py): sha256 of the
+                # saved iterator state + repartition capability + prefetch
+                # watermark, living in the SAME manifest as the weight
+                # hashes — "where was the data stream?" shares the
+                # integrity contract with "which bytes are the weights?".
+                extra[data_shard.DATA_RECORD_KEY] = data_shard.data_state_record(
+                    dataset_state,
+                    process_count=(self._process_count
+                                   if self._process_count is not None
+                                   else jax.process_count()),
+                    repartition=self._data_repartition,
+                    watermark=watermark)
+            mf.write_manifest(step_dir, step, extra=extra or None)
             for fault in faults.fire("ckpt_committed", step=step):
                 if fault.kind == "corrupt_ckpt":
                     faults.corrupt_checkpoint_dir(step_dir)
@@ -208,10 +252,20 @@ class CheckpointManager:
         # copy no longer carries NamedShardings).
         topology = reshard.state_topology(
             state, mesh=self._mesh, process_count=self._process_count)
+        # Prefetch watermark at the moment of save (the training thread —
+        # the same instant the snapshot pairs with), not at commit time on
+        # the saver thread, when the producer has run further ahead.
+        watermark = 0
+        if self._watermark_source is not None and dataset_state is not None:
+            try:
+                watermark = int(self._watermark_source())
+            except Exception:
+                log.warning("infeed watermark probe failed", exc_info=True)
         if self._saver is None:
             return self._write_and_commit(
                 step, _pack(state), dataset_state, force=force,
-                t_begin=t0, blocked_s=None, topology=topology)
+                t_begin=t0, blocked_s=None, topology=topology,
+                watermark=watermark)
         # Async: the training thread pays only the device→host snapshot.
         # device_get also syncs on the step that produced `state`, so the
         # snapshot is taken at a well-defined step boundary; the loop may
@@ -226,7 +280,8 @@ class CheckpointManager:
         self._saver.submit(
             lambda: self._write_and_commit(
                 step, host_state, ds_state, force=force,
-                t_begin=t0, blocked_s=blocked_s, topology=topology),
+                t_begin=t0, blocked_s=blocked_s, topology=topology,
+                watermark=watermark),
             step=step)
         return True
 
@@ -289,8 +344,9 @@ class CheckpointManager:
         # template's (new-mesh) shardings and the plan is validated +
         # telemetered below. Runs AFTER integrity verification — a torn
         # step must quarantine, not "reshard".
-        saved_topo = (mf.read_manifest(os.path.join(self._path, str(step)))
-                      or {}).get(reshard.MESH_RECORD_KEY)
+        saved_manifest = mf.read_manifest(
+            os.path.join(self._path, str(step))) or {}
+        saved_topo = saved_manifest.get(reshard.MESH_RECORD_KEY)
         reshard_plan = reshard.check_restore_topology(
             saved_topo, template, allow_reshard=self.config.allow_reshard,
             directory=self._path, step=step)
@@ -536,6 +592,27 @@ class CheckpointManager:
         elif stored_ema and not want_ema:
             state = state.replace(ema_params={})
         if dataset is not None and restored.get("data_iter") is not None:
+            # Data-state restore gate (data/shard.py): digest-check the
+            # restored iterator state against its manifest commit record
+            # and decide whether a host-count change may repartition it.
+            # Runs BEFORE the state reaches the dataset, so a failed gate
+            # leaves the dataset untouched at its initial state.
+            data_plan = data_shard.check_restore_data(
+                saved_manifest.get(data_shard.DATA_RECORD_KEY),
+                restored["data_iter"],
+                process_count=(self._process_count
+                               if self._process_count is not None
+                               else jax.process_count()),
+                resume_strict=self._data_resume_strict)
+            if data_plan is not None:
+                self._emit(telemetry.KIND_DATA_STATE, step=step,
+                           plan=data_plan)
+                if data_plan["action"] != "resume":
+                    log.warning(
+                        "data state restored at step %d: %s (%s -> %s "
+                        "hosts)", step, data_plan["action"],
+                        data_plan.get("from_processes"),
+                        data_plan.get("to_processes"))
             dataset.restore(restored["data_iter"])
         return state
 

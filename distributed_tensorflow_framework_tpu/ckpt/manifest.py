@@ -83,8 +83,9 @@ def iter_payload_files(step_dir: str):
 def build_manifest(step_dir: str, step: int,
                    extra: dict | None = None) -> dict:
     """``extra`` merges additional commit-record fields (e.g. the saver's
-    mesh topology, ckpt/reshard.py) without touching the reserved keys —
-    readers of legacy manifests simply see them absent."""
+    mesh topology, ckpt/reshard.py, and the data-state record,
+    data/shard.py) without touching the reserved keys — readers of legacy
+    manifests simply see them absent."""
     files = {}
     for rel in iter_payload_files(step_dir):
         path = os.path.join(step_dir, rel)

@@ -32,6 +32,12 @@ Nothing here touches the PR-2 integrity contract (verify/quarantine/
 fallback run before any topology check sees the step) or the PR-3 async
 save path (the topology record is computed from the live sharded state
 BEFORE the device→host snapshot, then rides the ordinary manifest commit).
+
+The DATA plane has a parallel gate: data/shard.py writes a data-state
+record (``DATA_RECORD_KEY``) into the same manifest commit record, and
+its ``check_restore_data`` plays for the sample stream the role
+``check_restore_topology`` plays for the parameter state — same-count →
+resume, refit → repartition plan or a typed refusal.
 """
 
 from __future__ import annotations

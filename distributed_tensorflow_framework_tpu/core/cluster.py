@@ -90,8 +90,15 @@ def heartbeat_path(ckpt_dir: str, process_index: int,
 # Worker environment (the jax.distributed discovery path)
 # ---------------------------------------------------------------------------
 
-_DISCOVERY_VARS = ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
-                   "JAX_PROCESS_ID")
+# The jax.distributed discovery triple, as PUBLIC names: data/shard.py
+# derives each host's deterministic shard assignment from the same env
+# the gang supervisor writes (worker_env below), so data-shard identity
+# and gang identity cannot drift apart.
+ENV_COORDINATOR = "JAX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_PROCESS_ID = "JAX_PROCESS_ID"
+
+_DISCOVERY_VARS = (ENV_COORDINATOR, ENV_NUM_PROCESSES, ENV_PROCESS_ID)
 
 
 def worker_env(
@@ -124,10 +131,9 @@ def worker_env(
             f"process_id {process_id} outside gang of {num_processes}")
     env = dict(base_env)
     if num_processes > 1:
-        env["JAX_COORDINATOR_ADDRESS"] = (
-            f"{coordinator_host}:{coordinator_port}")
-        env["JAX_NUM_PROCESSES"] = str(num_processes)
-        env["JAX_PROCESS_ID"] = str(process_id)
+        env[ENV_COORDINATOR] = f"{coordinator_host}:{coordinator_port}"
+        env[ENV_NUM_PROCESSES] = str(num_processes)
+        env[ENV_PROCESS_ID] = str(process_id)
     else:
         for key in _DISCOVERY_VARS:
             env.pop(key, None)

@@ -288,12 +288,27 @@ class DataConfig:
     vocab_size: int = 30522  # must match ModelConfig.vocab_size
     # Sequence packing (MLM train path): each batch consumes pack_factor
     # raw record batches and lays the documents end-to-end with per-row
-    # segment ids (block-diagonal attention, data/text_mlm.pack_documents)
+    # segment ids (block-diagonal attention, data/packing.pack_documents)
     # — more useful tokens per step when documents are shorter than
     # seq_len. 1 = off. Train-only; eval streams stay unpacked.
     pack_factor: int = 1
     # native C++ record reader (ops/native) when available
     use_native_reader: bool = False
+    # How each host slices the shared epoch permutation (data/shard.py).
+    # "block": host h takes the h-th contiguous host-batch rows of every
+    # global batch — the consumed prefix is host-count-INVARIANT, so a
+    # resumed data state survives an N→M elastic refit with no sample
+    # replayed or dropped (docs/RESILIENCE.md "Exactly-once data").
+    # "stride": the legacy perm[h::P] layout — kept for bit-exact
+    # continuation of old runs; NOT repartitionable across a host-count
+    # change. Single-process runs are identical under both.
+    shard_mode: str = "block"
+    # Restore-time data-state gate (data/shard.check_restore_data): when
+    # True, a restored iterator state that fails its manifest sha256 or
+    # hits a host-count change it cannot repartition raises DataShardError.
+    # False downgrades both to warnings and resumes anyway (samples may
+    # replay or drop) — the escape hatch for salvaging a run.
+    resume_strict: bool = True
 
 
 @config_dataclass
@@ -1092,6 +1107,11 @@ def load_config(
     for role, dc in (("data", cfg.data), ("eval_data", cfg.eval_data)):
         if dc is None:
             continue
+        if dc.shard_mode not in ("block", "stride"):
+            raise ValueError(
+                f"{role}.shard_mode must be 'block' or 'stride', got "
+                f"{dc.shard_mode!r}"
+            )
         if (dc.name in ("mnist", "cifar10", "imagenet", "synthetic_images")
                 and dc.num_classes > cfg.model.num_classes):
             raise ValueError(

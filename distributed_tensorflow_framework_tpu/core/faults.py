@@ -112,6 +112,23 @@ Spec syntax (``DTF_FAULTS=crash_at_step:120,stall_infeed:30s``):
                      mesh to the surviving process count (gang-level
                      rc-84) and relaunch smaller without consuming an
                      attempt.
+  corrupt_shard:K:P  poison host K's Pth dataset pull (1-based; default
+                     1) to NaN — the bad-shard drill: ONE host's infeed
+                     yields garbage, the global batch assembled from it
+                     goes non-finite, and the NaN guard's provenance
+                     path must name the step. Fired at the data
+                     pipeline's ``data_chaos`` point; only the process
+                     whose shard index is K applies it (the ``worker=``
+                     filter below keeps other hosts from consuming the
+                     one-shot fault). Like ``stall_infeed``, pull 1 is
+                     the build-time sample-batch peek.
+  skew_shard:K:S     host K's next dataset pull sleeps S seconds
+                     (suffix ``s`` optional; ``0`` = forever) — the
+                     straggler-shard drill: one host's infeed falls
+                     behind, every peer blocks at the collective, and
+                     the infeed watchdog / heartbeat ladder must catch
+                     it. Same ``data_chaos`` point and worker filter
+                     as corrupt_shard.
 
 Faults fire at most once per process. When ``DTF_FAULTS_STATE`` names a
 file, firings are also recorded there (before executing — a crash fault
@@ -167,6 +184,10 @@ STATE_ENV_VAR = "DTF_FAULTS_STATE"
 #                   whole gang has heartbeated (`step` carries the 1-based
 #                   tick ordinal); the supervisor applies the returned
 #                   faults to its worker subprocesses
+#   data_chaos      data/pipeline.py, each HostDataset pull (`step` carries
+#                   the 1-based pull ordinal, `worker` the host's shard
+#                   index) — per-host data faults (corrupt_shard,
+#                   skew_shard) applied by the pulling host itself
 KIND_POINTS = {
     "crash_at_step": "step_begin",
     "nan_grads": "step_begin",
@@ -184,6 +205,8 @@ KIND_POINTS = {
     "kill_worker": "gang_chaos",
     "stall_worker": "gang_chaos",
     "drop_worker": "gang_chaos",
+    "corrupt_shard": "data_chaos",
+    "skew_shard": "data_chaos",
 }
 _STEP_KINDS = ("crash_at_step", "crash_in_save", "nan_grads", "loss_spike")
 _STALL_FOREVER_S = 6 * 3600.0
@@ -199,7 +222,10 @@ class Fault:
     devices: int | None = None
     # kill_replica / stall_replica: the 0-based replica index targeted.
     replica: int | None = None
-    # kill_worker / stall_worker / drop_worker: the 0-based gang process id.
+    # kill_worker / stall_worker / drop_worker: the 0-based gang process
+    # id. corrupt_shard / skew_shard: the 0-based host shard index the
+    # fault targets (matched against the `worker=` the data pipeline
+    # passes to `fire`, so only that host consumes the fault).
     worker: int | None = None
     # spike: synthetic queued requests per admitted replica added to the
     # autoscaler's pressure signal while the window is open.
@@ -218,13 +244,23 @@ class Fault:
     def fault_id(self) -> str:
         return f"{self.kind}:{self.arg}" if self.arg else self.kind
 
-    def matches(self, point: str, step: int | None) -> bool:
+    def matches(self, point: str, step: int | None,
+                worker: int | None = None) -> bool:
         if self.fired or point != self.point:
             return False
         if self.step is not None:
             if step is None or not (
                     self.step <= step < self.step + self.count):
                 return False
+        # Worker filtering only applies when the CALL SITE identifies
+        # itself (data_chaos passes the pulling host's shard index): a
+        # non-matching host must not match — and so not consume — another
+        # host's one-shot fault. Points that don't pass `worker` (e.g.
+        # gang_chaos, where the supervisor applies the fault TO a worker)
+        # keep the old match-any behaviour.
+        if (self.worker is not None and worker is not None
+                and self.worker != worker):
+            return False
         return True
 
 
@@ -380,6 +416,40 @@ def _parse_one(entry: str) -> Fault:
         if fault.seconds == 0.0:
             fault.seconds = _STALL_FOREVER_S
         fault.step = 1  # first supervisor tick, like kill_worker's default
+    elif kind == "corrupt_shard":
+        head, _, tail = arg.partition(":")
+        try:
+            fault.worker = int(head)
+            fault.step = int(tail) if tail else 1
+        except ValueError:
+            raise ValueError(
+                f"fault corrupt_shard needs host[:pull] (e.g. "
+                f"corrupt_shard:1:3), got {arg!r}"
+            ) from None
+        if fault.worker < 0 or fault.step < 1:
+            raise ValueError(
+                f"fault corrupt_shard needs host >= 0 and pull >= 1, "
+                f"got {arg!r}"
+            )
+    elif kind == "skew_shard":
+        head, _, tail = arg.partition(":")
+        raw = tail[:-1] if tail.endswith("s") else tail
+        try:
+            fault.worker = int(head)
+            fault.seconds = float(raw) if raw else 0.0
+        except ValueError:
+            raise ValueError(
+                f"fault skew_shard needs host:seconds (e.g. "
+                f"skew_shard:1:10s), got {arg!r}"
+            ) from None
+        if fault.worker < 0:
+            raise ValueError(
+                f"fault skew_shard host must be >= 0, got {arg!r}"
+            )
+        if fault.seconds == 0.0:
+            fault.seconds = _STALL_FOREVER_S
+        # No pull ordinal: the skew starts at host K's next pull (the
+        # fault is one-shot, so "next" means "first after arming").
     elif kind == "stall_infeed":
         dur, _, ordinal = arg.partition(":")
         raw = dur[:-1] if dur.endswith("s") else dur
@@ -469,17 +539,20 @@ class FaultPlan:
         os.replace(tmp, self.state_path)
 
     # -- firing ----------------------------------------------------------
-    def fire(self, point: str, *, step: int | None = None) -> list[Fault]:
+    def fire(self, point: str, *, step: int | None = None,
+             worker: int | None = None) -> list[Fault]:
         """Execute self-contained faults matching this point (crash, stall)
         and return the caller-handled ones (nan_grads, corrupt_ckpt) so the
-        call site applies them with its own context. Thread-safe: the
-        match→record→execute sequence runs under the plan lock, so the
+        call site applies them with its own context. ``worker`` lets a
+        call site that IS a specific worker (the data pipeline's
+        data_chaos point) claim only faults targeted at it. Thread-safe:
+        the match→record→execute sequence runs under the plan lock, so the
         background saver thread and the training thread can never both
         claim the same fault."""
         matched: list[Fault] = []
         with _FIRE_LOCK:  # match + record atomically; execute after release
             for fault in self.faults:
-                if not fault.matches(point, step):
+                if not fault.matches(point, step, worker):
                     continue
                 self._record_fired(fault)
                 matched.append(fault)
@@ -534,12 +607,13 @@ def install(plan: FaultPlan | str | None) -> FaultPlan:
     return active_plan()
 
 
-def fire(point: str, *, step: int | None = None) -> list[Fault]:
+def fire(point: str, *, step: int | None = None,
+         worker: int | None = None) -> list[Fault]:
     """Fire the process plan at a fault point; cheap no-op when inactive."""
     plan = active_plan()
     if not plan.active:
         return []
-    return plan.fire(point, step=step)
+    return plan.fire(point, step=step, worker=worker)
 
 
 def corrupt_checkpoint_dir(step_dir: str) -> str | None:

@@ -161,6 +161,15 @@ class GoodputLedger:
             m = ev.get("metrics") or {}
             self.add("recompile", float(m.get("compile_ms", 0.0)) / 1e3)
             self.count("recompiles")
+        elif kind == telemetry.KIND_DATA_STATE:
+            # Restore-gate verdicts (data/shard.py): how many times this
+            # attempt resumed a saved data stream, and how many of those
+            # were N→M repartitions — the restart classification the
+            # stitched cross-attempt ledger rolls up.
+            self.count("data_restores")
+            plan = (ev.get("extra") or {}).get("plan") or {}
+            if plan.get("action") == "repartition":
+                self.count("data_repartitions")
 
     # -- snapshots & emission --------------------------------------------
 

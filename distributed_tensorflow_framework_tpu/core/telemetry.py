@@ -166,6 +166,18 @@ KIND_SPAN = "span"
 # in-flight batch, and was the KV pool the thing capping it.
 KIND_DECODE_STEP = "decode_step"
 KIND_KV_CACHE = "kv_cache"
+# Exactly-once data plane (data/shard.py, docs/RESILIENCE.md "Exactly-once
+# data"): one KIND_DATA_SHARD per attempt describing this host's slice of
+# every global batch (``extra.shard`` = the shard_plan dict: process
+# index/count, host/global batch, shard_mode); periodic KIND_DATA_PACKING
+# with the sequence-packing census (``metrics``: real/padded tokens and
+# packing_efficiency — goodput per padded token, the number packing exists
+# to raise); and one KIND_DATA_STATE per checkpoint restore carrying the
+# restore-gate verdict (``extra.plan``: action resume|repartition|forced,
+# from/to process counts, prefetch watermark at save).
+KIND_DATA_SHARD = "data_shard"
+KIND_DATA_PACKING = "data_packing"
+KIND_DATA_STATE = "data_state"
 
 
 def make_run_id() -> str:
@@ -403,7 +415,7 @@ RECOVERY_KINDS = (
     KIND_CKPT_QUARANTINED, KIND_RESTORE_FALLBACK,
     KIND_SUPERVISOR_ATTEMPT, KIND_CRASH_LOOP, KIND_FAILURE,
     KIND_ANOMALY, KIND_ROLLBACK, KIND_BATCH_SKIPPED, KIND_INFEED_STALL,
-    KIND_MESH_RESIZED, KIND_CKPT_RESHARDED,
+    KIND_MESH_RESIZED, KIND_CKPT_RESHARDED, KIND_DATA_STATE,
 )
 
 
@@ -485,6 +497,13 @@ def summarize_events(path: str) -> dict:
             fleet["tenants"][name] = led
         return led
 
+    # Exactly-once data plane: the attempt's shard layout (last KIND_DATA_SHARD
+    # wins — a refit re-emits it), the cumulative packing census (last
+    # KIND_DATA_PACKING wins, counters are cumulative), and every restore-gate
+    # verdict in order (KIND_DATA_STATE — part of the recovery story).
+    data_shard: dict | None = None
+    data_packing: dict | None = None
+    data_restores: list[dict] = []
     last_collectives: dict | None = None
     # Per-attempt goodput rollups: one ledger per run_id (process); the
     # final rollup wins over periodic snapshots, else the last seen (a
@@ -716,6 +735,24 @@ def summarize_events(path: str) -> dict:
                 "to_digest": extra.get("to_digest"),
                 "reload_ms": m.get("reload_ms"),
             })
+        elif kind == KIND_DATA_SHARD:
+            data_shard = dict(extra.get("shard") or {})
+        elif kind == KIND_DATA_PACKING:
+            m = ev.get("metrics") or {}
+            data_packing = {
+                "real_tokens": m.get("real_tokens"),
+                "padded_tokens": m.get("padded_tokens"),
+                "packing_efficiency": m.get("packing_efficiency"),
+            }
+        elif kind == KIND_DATA_STATE:
+            plan = extra.get("plan") or {}
+            data_restores.append({
+                "step": step,
+                "action": plan.get("action"),
+                "from_processes": plan.get("from_processes"),
+                "to_processes": plan.get("to_processes"),
+                "watermark": plan.get("watermark"),
+            })
         elif kind == KIND_GOODPUT:
             m = ev.get("metrics") or {}
             snap = {
@@ -849,6 +886,8 @@ def summarize_events(path: str) -> dict:
                             or fleet["reloads"] or fleet["tenants"]
                             or fleet["scaling"]["events"]) else None),
         "goodput": goodput,
+        "data": ({"shard": data_shard, "packing": data_packing}
+                 if (data_shard or data_packing) else None),
         "memory": (memory if memory["samples"] else None),
         "spans": ({
             "count": spans["count"],
@@ -871,6 +910,7 @@ def summarize_events(path: str) -> dict:
             "infeed_stalls": infeed_stalls,
             "mesh_resizes": mesh_resizes,
             "ckpt_reshards": ckpt_reshards,
+            "data_restores": data_restores,
         },
     }
 
@@ -1094,6 +1134,24 @@ def format_run_summary(summary: dict) -> str:
                 + (f", p50/p90/p99 {lat['p50']}/{lat['p90']}/{lat['p99']} ms"
                    if lat else "")
             )
+    data = summary.get("data")
+    if data:  # KIND_DATA_SHARD rollup (data/shard.py shard_plan)
+        sh = data.get("shard")
+        if sh:
+            lines.append(
+                f"  data shard: host {sh.get('process_index')}/"
+                f"{sh.get('process_count')} reads "
+                f"{sh.get('host_batch')} of {sh.get('global_batch')} "
+                f"rows/batch ({sh.get('shard_mode', '?')} mode)"
+            )
+        pk = data.get("packing")
+        if pk and pk.get("real_tokens") is not None:  # KIND_DATA_PACKING rollup
+            eff = pk.get("packing_efficiency")
+            lines.append(
+                f"  packing: {int(pk['real_tokens']):,} real / "
+                f"{int(pk.get('padded_tokens') or 0):,} padded tokens"
+                + (f", efficiency {float(eff):.3f}" if eff is not None else "")
+            )
     gp = summary.get("goodput")
     if gp:  # KIND_GOODPUT rollup (per-attempt ledgers summed)
         frac = gp.get("goodput_frac")
@@ -1154,6 +1212,7 @@ def format_run_summary(summary: dict) -> str:
         or rec.get("anomalies") or rec.get("rollbacks")
         or rec.get("batches_skipped") or rec.get("infeed_stalls")
         or rec.get("mesh_resizes") or rec.get("ckpt_reshards")
+        or rec.get("data_restores")
     )
     if not activity:
         lines.append("  recovery activity: none")
@@ -1183,6 +1242,16 @@ def format_run_summary(summary: dict) -> str:
             f"    checkpoint resharded at step {r.get('step')}: "
             f"{_fmt_axes(r.get('from_axes'))} -> {_fmt_axes(r.get('to_axes'))}"
             f" ({r.get('leaf_count', '?')} leaves)"
+        )
+    for d in rec.get("data_restores") or []:  # KIND_DATA_STATE rollup
+        action = d.get("action") or "resume"
+        refit = (f" across {d['from_processes']} -> {d['to_processes']} hosts"
+                 if d.get("from_processes") != d.get("to_processes") else "")
+        lines.append(
+            f"    data state restored at step {d.get('step')}: "
+            f"{action}{refit}"
+            + (f" (watermark {d['watermark']})"
+               if d.get("watermark") else "")
         )
     for q in rec["quarantined"]:  # KIND_CKPT_QUARANTINED rollup
         lines.append(

@@ -21,7 +21,7 @@ from distributed_tensorflow_framework_tpu.data.pipeline import (
     host_batch_size,
     image_np_dtype,
 )
-from distributed_tensorflow_framework_tpu.data import synthetic
+from distributed_tensorflow_framework_tpu.data import shard, synthetic
 
 log = logging.getLogger(__name__)
 
@@ -68,6 +68,8 @@ def make_cifar10(config: DataConfig, process_index: int, process_count: int,
             process_count=process_count, out_dtype=out_dtype,
         )
 
+    block = config.shard_mode == "block"
+
     def make_iter(state):
         state.setdefault("epoch", 0)
         state.setdefault("batch_in_epoch", 0)
@@ -76,10 +78,20 @@ def make_cifar10(config: DataConfig, process_index: int, process_count: int,
             # core/prng.py host-side rules).
             rng = prng.host_rng(config.seed, prng.ROLE_DATA, state["epoch"])
             perm = rng.permutation(n)
-            shard = perm[process_index::process_count]
-            batches = len(shard) // b
+            batches = shard.epoch_batches(n, b, process_count)
             for i in range(state["batch_in_epoch"], batches):
-                idx = shard[i * b:(i + 1) * b]
+                if block:
+                    # Block sharding (data/shard.py): host-count-invariant
+                    # consumed prefix, so (epoch, batch_in_epoch) resumes
+                    # exactly across an N→M refit. Sample IDENTITY
+                    # survives the refit; the augmentation draw below is
+                    # host-local by design and does not.
+                    lo, hi = shard.block_bounds(
+                        i, b, process_index, process_count)
+                    idx = perm[lo:hi]
+                else:
+                    # Legacy stride sharding — not repartitionable.
+                    idx = perm[process_index::process_count][i * b:(i + 1) * b]
                 x = images[idx]
                 if train:
                     # pad-4 + random crop + random flip (host-local
@@ -112,5 +124,7 @@ def make_cifar10(config: DataConfig, process_index: int, process_count: int,
             "label": ((b,), np.int32),
         },
         initial_state={"epoch": 0, "batch_in_epoch": 0},
-        cardinality=n // (b * process_count),
+        cardinality=shard.epoch_batches(n, b, process_count),
+        repartition=(shard.REPARTITION_INVARIANT if block
+                     else shard.REPARTITION_NONE),
     )

@@ -25,6 +25,7 @@ from distributed_tensorflow_framework_tpu.data.pipeline import (
     host_batch_size,
     image_np_dtype,
 )
+from distributed_tensorflow_framework_tpu.data import shard as data_shard
 from distributed_tensorflow_framework_tpu.data import synthetic
 from distributed_tensorflow_framework_tpu.data.tfdata import (
     count_records,
@@ -333,6 +334,10 @@ def _make_imagenet_native(config: DataConfig, files: list[str],
             "label": ((b,), np.int32),
         },
         initial_state={"epoch": 0, "batch_in_epoch": 0},
+        # batch_in_epoch counts over THIS host's file shard — the state is
+        # meaningless at another process count (data/shard.py), so the
+        # restore gate blocks N→M refit unless data.resume_strict is off.
+        repartition=data_shard.REPARTITION_NONE,
     )
 
 
@@ -432,4 +437,7 @@ def _make_imagenet_native_eval(config: DataConfig, files: list[str],
         },
         initial_state={"batches": 0},
         cardinality=num_batches,
+        # Eval skip-count is per-host-shard too; eval streams are rebuilt
+        # from scratch on refit anyway, but tag honestly.
+        repartition=data_shard.REPARTITION_NONE,
     )

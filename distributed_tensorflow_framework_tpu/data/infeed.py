@@ -124,6 +124,14 @@ class _BackgroundInfeed:
             raise item
         return item
 
+    def watermark(self) -> int:
+        """Batches pulled ahead of the consumer right now (approximate —
+        the producer may be mid-pull). Recorded into the checkpoint's
+        data-state commit record (data/shard.py) as the prefetch-queue
+        watermark at save time; telemetry only, never folded into the
+        restore position."""
+        return self._q.qsize()
+
     def close(self) -> None:
         # Consumer done (total_steps reached, early break, error): release
         # the producer — it must NOT keep pulling from the dataset, which
@@ -210,6 +218,12 @@ class _SyncInfeed:
         if not self._buf:
             raise StopIteration
         return self._buf.popleft()
+
+    def watermark(self) -> int:
+        """Batches pulled ahead of the consumer (buffered + the pending
+        stall-guarded pull, if any) — the _BackgroundInfeed.watermark
+        contract for the same-thread prefetcher."""
+        return len(self._buf) + (1 if self._pending is not None else 0)
 
     def close(self) -> None:
         if self._pool is not None:

@@ -19,7 +19,7 @@ from distributed_tensorflow_framework_tpu.data.pipeline import (
     host_batch_size,
     image_np_dtype,
 )
-from distributed_tensorflow_framework_tpu.data import synthetic
+from distributed_tensorflow_framework_tpu.data import shard, synthetic
 
 log = logging.getLogger(__name__)
 
@@ -60,6 +60,8 @@ def make_mnist(config: DataConfig, process_index: int, process_count: int,
             out_dtype=out_dtype,
         )
 
+    block = config.shard_mode == "block"
+
     def make_iter(state):
         state.setdefault("epoch", 0)
         state.setdefault("batch_in_epoch", 0)
@@ -68,12 +70,23 @@ def make_mnist(config: DataConfig, process_index: int, process_count: int,
             # permutation, so no process_index (core/prng.py rules).
             rng = prng.host_rng(config.seed, prng.ROLE_DATA, state["epoch"])
             perm = rng.permutation(n)
-            # Each host reads a disjoint shard of the shuffled epoch.
-            shard = perm[process_index::process_count]
-            batches = len(shard) // b
+            batches = shard.epoch_batches(n, b, process_count)
             start = state["batch_in_epoch"]
             for i in range(start, batches):
-                idx = shard[i * b:(i + 1) * b]
+                if block:
+                    # Block sharding (data/shard.py): host h takes the
+                    # h-th contiguous b rows of global batch i, so the
+                    # consumed prefix after k batches is perm[:k*B] at
+                    # ANY host count — the state (epoch, batch_in_epoch)
+                    # survives an N→M elastic refit bit-exactly.
+                    lo, hi = shard.block_bounds(
+                        i, b, process_index, process_count)
+                    idx = perm[lo:hi]
+                else:
+                    # Legacy stride sharding (data.shard_mode="stride"):
+                    # each host reads a strided shard of the epoch. NOT
+                    # repartitionable across a host-count change.
+                    idx = perm[process_index::process_count][i * b:(i + 1) * b]
                 state["batch_in_epoch"] = i + 1
                 yield {"image": images[idx].astype(out_dtype, copy=False),
                        "label": labels[idx]}
@@ -87,5 +100,7 @@ def make_mnist(config: DataConfig, process_index: int, process_count: int,
             "label": ((b,), np.int32),
         },
         initial_state={"epoch": 0, "batch_in_epoch": 0},
-        cardinality=n // (b * process_count),
+        cardinality=shard.epoch_batches(n, b, process_count),
+        repartition=(shard.REPARTITION_INVARIANT if block
+                     else shard.REPARTITION_NONE),
     )

@@ -10,11 +10,15 @@ part 3 demands we do better).
 
 from __future__ import annotations
 
+import logging
+import time
 from typing import Any, Callable, Iterator, Mapping
 
 import numpy as np
 
 from distributed_tensorflow_framework_tpu.core import faults
+
+log = logging.getLogger(__name__)
 
 Batch = Mapping[str, np.ndarray]
 
@@ -107,30 +111,75 @@ class HostDataset:
         element_spec: Mapping[str, tuple[tuple[int, ...], Any]],
         initial_state: dict[str, Any] | None = None,
         cardinality: int | None = None,
+        repartition: str = "none",
     ):
         """
         Args:
           make_iter: state-dict → iterator of batches; the iterator must
             mutate the SAME state dict in place as it advances so that
-            ``state()`` is always current.
+            ``state()`` is always current. Nested state values must be
+            REBOUND, never mutated in place: ``state()`` hands out
+            shallow copies, so an in-place list/dict mutation would
+            retroactively edit every snapshot already queued for a save.
           element_spec: name → (per-host batch shape, dtype).
           initial_state: starting iterator state.
           cardinality: batches per epoch per host, if known (None = infinite).
+          repartition: data/shard.py capability tag — "invariant" when the
+            state is host-count-invariant (an N→M gang refit may restore
+            it directly), "none" when the per-host stream depends on the
+            host count (skip-count/file-shard resume) and a refit must
+            raise DataShardError instead of silently replaying/dropping.
         """
         self._make_iter = make_iter
         self.element_spec = dict(element_spec)
         self._state: dict[str, Any] = dict(initial_state or {})
         self._iter: Iterator[Batch] | None = None
         self.cardinality = cardinality
+        self.repartition = repartition
         # Process-lifetime pull ordinal (1-based, NOT reset by restore):
         # lets stall_infeed:S:N target a specific pull — e.g. one past the
         # Trainer's build-time sample peek, inside the step loop.
         self._pulls = 0
+        # Lazy shard identity for per-worker data_chaos faults — resolved
+        # from the gang discovery env on first use so reader factories
+        # need no extra plumbing.
+        self._chaos_worker: int | None = None
 
     def __iter__(self):
         return self
 
-    def __next__(self) -> Batch:
+    def _chaos_worker_index(self) -> int:
+        if self._chaos_worker is None:
+            from distributed_tensorflow_framework_tpu.data import shard
+
+            self._chaos_worker = shard.ShardAssignment.from_env().process_index
+        return self._chaos_worker
+
+    def _apply_chaos(self, fault, batch: Batch) -> None:
+        """Execute one matched data_chaos fault against a pulled batch.
+
+        ``corrupt_shard`` poisons every floating field to NaN (the
+        anomaly ladder's detectable signature — integer token fields are
+        left alone, so image workloads are the drill surface);
+        ``skew_shard`` sleeps, making this one host's reader slower than
+        the gang (the straggler the infeed watchdog must surface).
+        """
+        if fault.kind == "corrupt_shard":
+            poisoned = []
+            for k, v in batch.items():
+                if np.issubdtype(np.asarray(v).dtype, np.floating):
+                    np.asarray(v)[...] = np.nan
+                    poisoned.append(k)
+            log.warning(
+                "data_chaos: corrupt_shard poisoned fields %s of pull %d",
+                poisoned or "<none — no floating fields>", self._pulls)
+        elif fault.kind == "skew_shard":
+            log.warning(
+                "data_chaos: skew_shard sleeping %.1fs at pull %d",
+                fault.seconds, self._pulls)
+            time.sleep(fault.seconds)
+
+    def _pull(self) -> Batch:
         # stall_infeed fault point (core/faults.py): a hung input pipeline
         # — the failure the heartbeat watchdog must catch — is one sleep
         # here; a no-op set lookup when no plan is installed.
@@ -138,12 +187,69 @@ class HostDataset:
         faults.fire("infeed", step=self._pulls)
         if self._iter is None:
             self._iter = self._make_iter(self._state)
-        return next(self._iter)
+        batch = next(self._iter)
+        # Consumed-batch ordinal (1-based, part of the checkpointable
+        # state): the coordinate the skip-batch record and the manifest's
+        # data-state commit record are expressed in.
+        self._state["consumed"] = int(self._state.get("consumed", 0)) + 1
+        # data_chaos fault point: per-worker reader corruption/skew
+        # (docs/RESILIENCE.md fault table). Matched faults are filtered to
+        # THIS host's shard index so `corrupt_shard:K` hits exactly one
+        # member of the gang.
+        for fault in faults.fire("data_chaos", step=self._pulls,
+                                 worker=self._chaos_worker_index()):
+            self._apply_chaos(fault, batch)
+        return batch
+
+    def __next__(self) -> Batch:
+        batch = self._pull()
+        skipped = self._state.get("batches_skipped")
+        if skipped:
+            # Skip-batch replay (docs/RESILIENCE.md): ordinals recorded by
+            # a rollback are batches the recovered run decided NOT to
+            # train on. When a restore rebuilds the iterator from a state
+            # positioned before the skip region, discard them again so
+            # the effective stream is reconstructed instead of
+            # double-counted.
+            skip = {int(o) for o in skipped}
+            while int(self._state["consumed"]) in skip:
+                log.info("discarding batch ordinal %d (recorded as "
+                         "skipped by a rollback)", self._state["consumed"])
+                batch = self._pull()
+        return batch
 
     # -- checkpointable iterator state ------------------------------------
     def state(self) -> dict[str, Any]:
-        return dict(self._state)
+        snap = dict(self._state)
+        skipped = snap.get("batches_skipped")
+        if skipped:
+            # Prune skip ordinals the stream is already past: a restore of
+            # this snapshot resumes AFTER them (its position keys pair
+            # with ``consumed``), so they are dead weight in checkpoints.
+            consumed = int(snap.get("consumed", 0))
+            live = [int(o) for o in skipped if int(o) > consumed]
+            if live:
+                snap["batches_skipped"] = live
+            else:
+                snap.pop("batches_skipped", None)
+        return snap
 
     def restore(self, state: dict[str, Any]) -> None:
         self._state = dict(state)
         self._iter = None  # rebuild lazily from restored state
+
+    def record_skipped(self, ordinals) -> None:
+        """Record consumed-batch ordinals a rollback skipped.
+
+        REBINDS ``batches_skipped`` (never appends in place — ``state()``
+        snapshots share nested lists by reference), so snapshots taken
+        before this call are unaffected and every later one carries the
+        union. Called from the consumer thread while the prefetch
+        producer reads the dict: the single rebind is atomic under the
+        GIL and the producer's ``make_iter`` never touches this key.
+        """
+        merged = sorted(
+            {int(o) for o in self._state.get("batches_skipped", ())}
+            | {int(o) for o in ordinals})
+        if merged:
+            self._state["batches_skipped"] = merged

@@ -1,0 +1,285 @@
+"""Deterministic per-host shard assignment + resumable data-state records.
+
+Two halves of the exactly-once data story (docs/RESILIENCE.md "Exactly-once
+data"):
+
+  * **Shard assignment** — which slice of every global batch THIS process
+    reads. Identity comes from the same ``jax.distributed`` discovery env
+    the gang supervisor writes (core/cluster.py ``worker_env``), so data
+    sharding can never disagree with gang membership, and the assignment
+    is validated against the mesh's data-parallel extent before the first
+    batch moves (``shard_plan`` — the Trainer emits it as KIND_DATA_SHARD).
+
+    Block sharding (the default, ``data.shard_mode="block"``) gives host
+    ``h`` the ``h``-th contiguous ``host_batch`` rows of global batch
+    ``i`` inside the epoch permutation: after ``k`` global batches the
+    consumed prefix is exactly ``perm[:k * global_batch]`` REGARDLESS of
+    how many hosts read it. That host-count invariance is what makes an
+    N→M elastic refit resume from the same global offset with no sample
+    replayed and none dropped. (With one process, block and stride
+    sharding are bit-identical.)
+
+  * **Data-state commit records** — a sha256'd summary of the iterator
+    state written into the checkpoint manifest next to the mesh-topology
+    record (ckpt/reshard.py), so "where was the data stream?" is part of
+    the same integrity contract as "which bytes are the weights?".
+    ``check_restore_data`` is the restore-time gate: digest-checks the
+    restored state against the commit record and decides whether an N→M
+    host refit may repartition it (position-keyed, host-count-invariant
+    states) or must refuse with a typed error (skip-count / file-sharded
+    states, where the per-host stream itself depends on the host count).
+
+Stdlib + numpy-free on purpose: the supervisor and tests reason about
+shard assignment without touching JAX.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+from distributed_tensorflow_framework_tpu.core import cluster
+
+log = logging.getLogger(__name__)
+
+# Manifest commit-record field for the chief's iterator state (rides
+# ``write_manifest(extra=...)`` next to ckpt/reshard.py's MESH_RECORD_KEY).
+DATA_RECORD_KEY = "data_state"
+DATA_STATE_SCHEMA = "dtf-data-state/1"
+
+# HostDataset.repartition capability values (data/pipeline.py):
+#   invariant — the state is host-count-invariant (block-sharded or
+#               positionless streams): restoring the chief's state at ANY
+#               process count resumes the same global offset.
+#   none      — the per-host stream depends on the host count (stride/file
+#               sharding, skip-count resume): an N→M refit cannot
+#               repartition it and must raise DataShardError.
+REPARTITION_INVARIANT = "invariant"
+REPARTITION_NONE = "none"
+
+
+class DataShardError(ValueError):
+    """A shard-assignment or data-state contract violation.
+
+    Raised when a host's shard assignment is inconsistent with the gang
+    (bad index, indivisible batch), when a restored iterator state fails
+    its manifest digest, or when an N→M host refit asks a non-
+    repartitionable state to move. Carries an optional ``hint`` with the
+    unblocking knob, mirroring ckpt/reshard.MeshTopologyError.
+    """
+
+    def __init__(self, message: str, *, hint: str | None = None):
+        if hint:
+            message = f"{message}\n  hint: {hint}"
+        super().__init__(message)
+        self.hint = hint
+
+
+@dataclass(frozen=True)
+class ShardAssignment:
+    """This process's slot in the data-reading gang."""
+
+    process_index: int
+    process_count: int
+
+    def __post_init__(self):
+        if self.process_count < 1:
+            raise DataShardError(
+                f"process_count {self.process_count} < 1")
+        if not 0 <= self.process_index < self.process_count:
+            raise DataShardError(
+                f"process_index {self.process_index} outside gang of "
+                f"{self.process_count}")
+
+    @classmethod
+    def from_env(cls, environ: Mapping[str, str] | None = None
+                 ) -> "ShardAssignment":
+        """Assignment from the gang's discovery env (cluster.worker_env).
+
+        Single-process runs (no discovery vars) read shard 0 of 1 — the
+        same default ``get_dataset`` uses — so shard identity is ALWAYS
+        derived from the env the supervisor controls, never guessed.
+        """
+        env = os.environ if environ is None else environ
+        try:
+            count = int(env.get(cluster.ENV_NUM_PROCESSES) or 1)
+            index = int(env.get(cluster.ENV_PROCESS_ID) or 0)
+        except ValueError as e:
+            raise DataShardError(
+                f"malformed gang discovery env: {e} "
+                f"({cluster.ENV_NUM_PROCESSES}="
+                f"{env.get(cluster.ENV_NUM_PROCESSES)!r}, "
+                f"{cluster.ENV_PROCESS_ID}="
+                f"{env.get(cluster.ENV_PROCESS_ID)!r})") from e
+        return cls(process_index=index, process_count=count)
+
+
+def shard_plan(assignment: ShardAssignment, *, global_batch: int,
+               data_parallel: int | None = None,
+               shard_mode: str = "block") -> dict:
+    """Validate and describe this host's slice of every global batch.
+
+    The Trainer runs this once at build time and emits the result as a
+    KIND_DATA_SHARD event, so the shard layout of every attempt is in
+    the telemetry record. ``data_parallel`` is the mesh's data-parallel
+    extent (data*fsdp axis sizes): each host's rows must map to a whole
+    number of data-parallel rows or ``to_global`` would split a host's
+    shard across process boundaries.
+    """
+    p, n = assignment.process_index, assignment.process_count
+    if global_batch % n:
+        raise DataShardError(
+            f"global_batch_size {global_batch} not divisible by "
+            f"process_count {n}",
+            hint="pick a global batch that is a multiple of the gang size")
+    if data_parallel is not None and data_parallel > 0:
+        if data_parallel % n:
+            raise DataShardError(
+                f"mesh data-parallel extent {data_parallel} not divisible "
+                f"by process_count {n} — hosts would feed unequal numbers "
+                f"of data-parallel rows",
+                hint="size the mesh's data/fsdp axes as a multiple of the "
+                     "gang size")
+    return {
+        "process_index": p,
+        "process_count": n,
+        "host_batch": global_batch // n,
+        "global_batch": int(global_batch),
+        "shard_mode": shard_mode,
+        "data_parallel": data_parallel,
+    }
+
+
+def block_bounds(batch_index: int, host_batch: int, process_index: int,
+                 process_count: int) -> tuple[int, int]:
+    """``[lo, hi)`` into the epoch permutation for this host's block of
+    global batch ``batch_index``: global batch ``i`` is
+    ``perm[i*B : (i+1)*B]`` and host ``h`` takes rows
+    ``[h*b, (h+1)*b)`` of it — so the consumed prefix after ``k``
+    batches is ``perm[:k*B]`` at any host count."""
+    lo = (batch_index * process_count + process_index) * host_batch
+    return lo, lo + host_batch
+
+
+def epoch_batches(n_examples: int, host_batch: int,
+                  process_count: int) -> int:
+    """Full global batches per epoch (identical on every host — the
+    ragged tail past ``n // global_batch`` batches is dropped)."""
+    return n_examples // (host_batch * process_count)
+
+
+# --------------------------------------------------------------- records
+
+def state_digest(state: Mapping[str, Any]) -> str:
+    """sha256 over the canonical-JSON form of an iterator state — the
+    same JSON round-trip Orbax's JsonSave applies, so the digest computed
+    at save time matches a digest of the restored object bit-for-bit."""
+    canonical = json.dumps(state, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canonical.encode("utf-8")).hexdigest()
+
+
+def _position(state: Mapping[str, Any]) -> dict:
+    """Human-readable position extract for the commit record."""
+    return {k: state[k] for k in
+            ("epoch", "batch_in_epoch", "step", "batches", "consumed",
+             "emitted")
+            if k in state and isinstance(state[k], int)}
+
+
+def data_state_record(state: Mapping[str, Any], *, process_count: int,
+                      repartition: str = REPARTITION_NONE,
+                      watermark: int = 0) -> dict:
+    """The manifest commit record for one saved iterator state.
+
+    ``watermark`` is the prefetch depth at save time (batches pulled
+    ahead of the consumer, infeed ``watermark()``) — recorded for the
+    post-mortem story ("how far ahead was the producer when we died?"),
+    NOT folded into the restore position: the saved state is the
+    snapshot paired with the last CONSUMED batch, so prefetched-ahead
+    batches are re-produced after restore, never lost.
+    """
+    return {
+        "schema": DATA_STATE_SCHEMA,
+        "sha256": state_digest(state),
+        "process_count": int(process_count),
+        "repartition": repartition,
+        "watermark": int(watermark),
+        "position": _position(state),
+    }
+
+
+def check_restore_data(record: Mapping[str, Any] | None,
+                       state: Mapping[str, Any], *,
+                       process_count: int,
+                       resume_strict: bool = True) -> dict | None:
+    """Restore-time gate for a saved iterator state.
+
+    ``record`` is the manifest's DATA_RECORD_KEY entry (None for legacy
+    checkpoints — restored with a warning, no integrity claim).
+    ``state`` is the restored ``data_iter`` object. Returns a plan dict
+    (``action`` resume|repartition|forced) the caller emits as
+    KIND_DATA_STATE, or None for legacy records; raises
+    :class:`DataShardError` when the digest fails or an N→M host change
+    meets a non-repartitionable state (``data.resume_strict=false``
+    downgrades both to warnings, action "forced").
+    """
+    if record is None:
+        log.warning(
+            "checkpoint has no data-state commit record (pre-exactly-once "
+            "save) — restoring the iterator state without an integrity "
+            "check")
+        return None
+    if record.get("schema") != DATA_STATE_SCHEMA:
+        raise DataShardError(
+            f"unknown data-state record schema {record.get('schema')!r} "
+            f"(this build reads {DATA_STATE_SCHEMA!r})")
+    digest = state_digest(state)
+    saved_digest = record.get("sha256")
+    if digest != saved_digest:
+        msg = (f"restored iterator state does not match its manifest "
+               f"commit record: sha256 {digest[:12]}… vs recorded "
+               f"{str(saved_digest)[:12]}…")
+        if resume_strict:
+            raise DataShardError(
+                msg, hint="the data_iter payload was mutated after commit; "
+                          "restore an older step, or set "
+                          "data.resume_strict=false to proceed anyway")
+        log.warning("%s — proceeding (data.resume_strict=false)", msg)
+        return {"action": "forced", "reason": "digest_mismatch",
+                "from_processes": record.get("process_count"),
+                "to_processes": process_count}
+    saved_count = int(record.get("process_count") or process_count)
+    if saved_count == process_count:
+        return {"action": "resume", "from_processes": saved_count,
+                "to_processes": process_count,
+                "watermark": record.get("watermark", 0)}
+    if record.get("repartition") == REPARTITION_INVARIANT:
+        # Host-count-invariant state: the same state restored on every
+        # host of the new gang resumes at the same global offset — the
+        # unconsumed remainder of the epoch repartitions over M hosts by
+        # construction (block sharding), nothing to transform.
+        log.info(
+            "repartitioning data state across host-count change "
+            "%d -> %d (host-count-invariant position %s)",
+            saved_count, process_count, record.get("position"))
+        return {"action": "repartition", "from_processes": saved_count,
+                "to_processes": process_count,
+                "watermark": record.get("watermark", 0)}
+    msg = (f"data state saved by {saved_count} process(es) cannot be "
+           f"repartitioned onto {process_count}: this reader resumes by "
+           f"per-host skip-count or file shard, which does not survive a "
+           f"host-count change (position {record.get('position')})")
+    if resume_strict:
+        raise DataShardError(
+            msg, hint="use a block-shardable reader (data.shard_mode="
+                      "\"block\" readers repartition freely), or set "
+                      "data.resume_strict=false to resume the stream "
+                      "from this state anyway (samples may replay or "
+                      "drop across the refit)")
+    log.warning("%s — proceeding (data.resume_strict=false)", msg)
+    return {"action": "forced", "reason": "host_count_change",
+            "from_processes": saved_count, "to_processes": process_count}

@@ -18,6 +18,7 @@ from distributed_tensorflow_framework_tpu.data.pipeline import (
     HostDataset,
     image_np_dtype,
 )
+from distributed_tensorflow_framework_tpu.data import shard
 
 
 def _host_batch(config: DataConfig, process_count: int) -> int:
@@ -62,6 +63,10 @@ def synthetic_images(
             "label": ((b,), np.int32),
         },
         initial_state={"step": 0},
+        # Generated data has no sample identity to replay or drop: the
+        # {"step": N} state restores at any host count (each host simply
+        # draws its own stream), so an N→M refit is trivially exact.
+        repartition=shard.REPARTITION_INVARIANT,
     )
 
 
@@ -103,4 +108,6 @@ def synthetic_mlm(
             "attention_mask": ((b, s), np.int32),
         },
         initial_state={"step": 0},
+        # Same refit-safety as synthetic_images: no sample identity.
+        repartition=shard.REPARTITION_INVARIANT,
     )

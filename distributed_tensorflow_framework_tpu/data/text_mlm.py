@@ -16,9 +16,13 @@ import numpy as np
 
 from distributed_tensorflow_framework_tpu.core.config import DataConfig
 from distributed_tensorflow_framework_tpu.core import prng
+from distributed_tensorflow_framework_tpu.data import packing
 from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset, host_batch_size
 from distributed_tensorflow_framework_tpu.data import synthetic
 from distributed_tensorflow_framework_tpu.data.tfdata import tfdata_to_hostdataset
+
+# Back-compat: pack_documents lived here before data/packing.py (ISSUE 19).
+pack_documents = packing.pack_documents
 
 log = logging.getLogger(__name__)
 
@@ -47,43 +51,6 @@ def apply_mlm_mask(tokens: np.ndarray, rng: np.random.Generator,
     return inputs, targets
 
 
-def pack_documents(tokens: np.ndarray, out_rows: int, seq_len: int
-                   ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Greedy in-order first-fit packing of zero-padded token rows.
-
-    ``tokens`` (n, s): one document per row, trailing-zero padded (token 0
-    is [PAD], never interior). Documents are laid end-to-end into
-    ``out_rows`` rows of ``seq_len``; per-row ``segment_ids`` number the
-    documents 1..k (0 = padding) for block-diagonal attention. In-order
-    packing keeps the stream deterministic (resume replays identically);
-    documents that do not fit the row budget are RETURNED as the leftover
-    suffix — the caller carries them into the next packed batch so
-    pack_factor overflow defers data instead of discarding it (ADVICE r3).
-
-    Returns (packed (out_rows, seq_len), segment_ids,
-    leftover (m, s) — the non-empty rows that did not fit, in order).
-    """
-    packed = np.zeros((out_rows, seq_len), np.int32)
-    segs = np.zeros((out_rows, seq_len), np.int32)
-    row, col, seg = 0, 0, 0
-    leftover = tokens[:0]
-    for i, doc in enumerate(tokens):
-        length = int(np.count_nonzero(doc))
-        if length == 0:
-            continue
-        if col + length > seq_len:
-            row += 1
-            col = 0
-            seg = 0
-            if row >= out_rows:
-                rest = tokens[i:]
-                leftover = rest[np.count_nonzero(rest, axis=1) > 0]
-                break
-        packed[row, col:col + length] = doc[:length]
-        seg += 1
-        segs[row, col:col + length] = seg
-        col += length
-    return packed, segs, leftover
 
 
 def make_mlm(config: DataConfig, process_index: int, process_count: int,
@@ -267,6 +234,13 @@ def make_mlm(config: DataConfig, process_index: int, process_count: int,
                     return
                 seg_ids = None
             state["inner"] = base.state()
+            if train:
+                # Real/padded-token census (data/packing.py counters):
+                # rides the state so every snapshot pairs a batch with
+                # the cumulative census — the Trainer reads it off its
+                # data snapshot to emit KIND_DATA_PACKING (goodput per
+                # padded token, the number packing exists to raise).
+                packing.accumulate_counters(state, tokens)
             # Mask key from the EMITTED-batch counter, not the consumed
             # raw-batch count: a packed batch that drains the carry alone
             # consumes zero raw batches, and keying off the inner counter

@@ -11,6 +11,14 @@ the adapter records ``batches`` consumed and, on restore, rebuilds the
 IO-bound only and amortized over a restart. This is strictly stronger than
 the reference's contract (MonitoredTrainingSession restarts re-read the
 stream from wherever the input threads happen to be).
+
+The skip-count is measured over THIS host's file shard, so it is only
+meaningful at the process count it was taken at: resuming on a different
+host count would re-deal the files and the count would index a different
+stream. The adapter therefore tags its datasets
+``repartition="none"`` (data/shard.py) — the restore gate in
+ckpt/checkpoint.py refuses an N→M refit unless ``data.resume_strict``
+is off.
 """
 
 from __future__ import annotations
@@ -19,6 +27,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from distributed_tensorflow_framework_tpu.data import shard
 from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
 
 # files-tuple → record count. Restores rebuild the pipeline (skip-count
@@ -106,4 +115,7 @@ def tfdata_to_hostdataset(
         element_spec=element_spec,
         initial_state={"batches": 0, "seed": 0},
         cardinality=cardinality,
+        # Skip-count over a per-host file shard: only valid at the process
+        # count it was taken at (module docstring).
+        repartition=shard.REPARTITION_NONE,
     )

@@ -30,7 +30,8 @@ from distributed_tensorflow_framework_tpu.core import (
     tracing)
 from distributed_tensorflow_framework_tpu.core.mesh import MeshRuntime, initialize_runtime
 from distributed_tensorflow_framework_tpu.core.metrics import MetricWriter, setup_logging
-from distributed_tensorflow_framework_tpu.data import get_dataset
+from distributed_tensorflow_framework_tpu.data import get_dataset, packing
+from distributed_tensorflow_framework_tpu.data import shard as data_shard
 from distributed_tensorflow_framework_tpu.data.infeed import (
     InfeedStallError, prefetch_to_device, to_global)
 from distributed_tensorflow_framework_tpu.parallel import collectives as coll
@@ -170,6 +171,24 @@ class Trainer:
             process_count=self.runtime.process_count,
             process_index=self.runtime.process_index,
         )
+        # Shard-assignment record (data/shard.py): validate this host's
+        # slice of every global batch against the gang AND the mesh's
+        # data-parallel extent before the first batch moves, and put the
+        # layout in the telemetry record (KIND_DATA_SHARD) — the exactly-
+        # once drill reads it back per attempt.
+        mesh_shape = {k: int(v) for k, v in self.mesh.shape.items()}
+        data_parallel = (mesh_shape.get("data", 1)
+                         * mesh_shape.get("fsdp", 1)) or None
+        shard_layout = data_shard.shard_plan(
+            data_shard.ShardAssignment(
+                process_index=self.runtime.process_index,
+                process_count=self.runtime.process_count),
+            global_batch=self.config.data.global_batch_size,
+            data_parallel=data_parallel,
+            shard_mode=self.config.data.shard_mode)
+        self.writer.telemetry.emit(
+            telemetry.KIND_DATA_SHARD, step=self.host_step,
+            shard=shard_layout)
         stages = int(getattr(self.config.model, "pipeline_stages", 0) or 0)
         if stages > 0:
             # One record of the resolved schedule so step-time rollups
@@ -273,6 +292,13 @@ class Trainer:
                 mesh=self.mesh,
                 process_count=self.runtime.process_count,
             )
+            # Data-plane plumbing for the manifest commit record + restore
+            # gate (data/shard.py): the dataset's repartition capability
+            # decides whether an N→M refit may reuse its state, and
+            # data.resume_strict gates the digest/host-count checks.
+            self._ckpt_manager.set_data_sources(
+                repartition=self.dataset.repartition,
+                resume_strict=self.config.data.resume_strict)
             if self.config.checkpoint.restore:
                 want = self.config.checkpoint.restore_step
                 if want >= 0 and want not in self._ckpt_manager.all_steps():
@@ -291,6 +317,11 @@ class Trainer:
                     self.state = restored
                     self.host_step = int(jax.device_get(self.state.step))
                     self._restored_step = self.host_step
+                    # Re-align the checkpointable snapshot with the
+                    # RESTORED stream position: the __init__ snapshot is
+                    # the initial state, and a rollback baseline built
+                    # from it would mis-compute skip ordinals.
+                    self.data_ckpt_state = self.dataset.state()
                     log.info("Restored checkpoint at step %d", self.host_step)
 
     def default_hooks(self) -> list:
@@ -388,6 +419,12 @@ class Trainer:
             background=self.config.data.async_infeed,
             deadline_s=self.config.resilience.infeed_deadline_s,
         )
+        if self._ckpt_manager is not None:
+            # Every save records the prefetch watermark (batches the
+            # producer ran ahead) in its data-state commit record — the
+            # post-mortem "how far ahead was the infeed?" number.
+            self._ckpt_manager.set_data_sources(
+                watermark_source=infeed.watermark)
         if self.recovery is not None:
             # Baseline snapshot: the ladder must be able to roll back even
             # if the first anomaly lands before the first clean fetch.
@@ -522,6 +559,18 @@ class Trainer:
                     host_metrics = self._maybe_recover(host_metrics)
                     self.goodput.maybe_emit(step=self.host_step)
                     self.memstats.maybe_sample(step=self.host_step)
+                    # Packing census (data/packing.py counters riding the
+                    # iterator state): goodput per padded token, emitted
+                    # at the same cadence as the metrics fetch. Cumulative
+                    # counters — the last event of an attempt is its total.
+                    real = self.data_ckpt_state.get(packing.REAL_TOKENS_KEY)
+                    if real is not None:
+                        self.writer.telemetry.emit(
+                            telemetry.KIND_DATA_PACKING, step=self.host_step,
+                            metrics=packing.packing_stats(
+                                int(real),
+                                int(self.data_ckpt_state.get(
+                                    packing.PADDED_TOKENS_KEY, 0))))
                     # One span per log-interval window of steps — coarse
                     # enough to stay cheap, fine enough that a gang
                     # restart's dead time shows as a gap between the last
@@ -558,6 +607,10 @@ class Trainer:
             # Stop the background producer (async_infeed): it must not
             # keep pulling from the dataset the caller may reuse/restore.
             infeed.close()
+            if self._ckpt_manager is not None:
+                # The final force-save (CheckpointHook.on_end) must not
+                # poll a closed infeed's queue for its watermark.
+                self._ckpt_manager.set_data_sources(watermark_source=None)
             # Absorb the tail phases accumulated since the last fetch even
             # on the escalation path (the final rollup below only runs on
             # clean exit; an escalating or SIGKILLed attempt is covered by
@@ -660,7 +713,25 @@ class Trainer:
             self.state, snap = rec.rollback(self.state, from_step=self.host_step)
             # Skip-batch semantics: host_step rewinds, the data iterator
             # does NOT — the replayed step range consumes fresh batches and
-            # the poisoned region is never re-fed.
+            # the poisoned region is never re-fed. Record WHICH consumed
+            # ordinals were skipped into the iterator state, so a restart
+            # that restores a pre-rollback data state replays the stream
+            # with those ordinals discarded instead of double-counting
+            # them (docs/RESILIENCE.md "Exactly-once data").
+            snap_consumed = int((snap.data_state or {}).get("consumed", 0))
+            live_consumed = int(self.data_ckpt_state.get("consumed", 0))
+            if live_consumed > snap_consumed:
+                skipped = range(snap_consumed + 1, live_consumed + 1)
+                self.dataset.record_skipped(skipped)
+                # REBIND into the step-aligned snapshot too (never mutate:
+                # queued save snapshots share nested lists by reference) —
+                # the next checkpoint's data state must carry the record.
+                merged = sorted(
+                    {int(o) for o in
+                     self.data_ckpt_state.get("batches_skipped", ())}
+                    | set(skipped))
+                self.data_ckpt_state = {
+                    **self.data_ckpt_state, "batches_skipped": merged}
             self.host_step = snap.step
             if self.config.resilience.lr_rewarmup_steps > 0:
                 self._rebuild_with_rewarmup(snap.step)

@@ -341,4 +341,39 @@ decode_ab static     --set decode.scheduler=static
 decode_ab int8       --set decode.scheduler=continuous \
                      --set decode.kv_dtype=int8
 
+# 17. Infeed A/B (ISSUE 19, docs/RESILIENCE.md "Exactly-once data"):
+#     the sharded/packed input path's two dials on the BERT mlm
+#     workload, behind the same §0b preflight (a wedged tunnel already
+#     aborted the queue above; nothing here re-probes).
+#     (a) sequence packing OFF vs ON (data.pack_factor 1 vs 4): the win
+#         is goodput per PADDED token — the packing rollup
+#         (KIND_DATA_PACKING: real/padded tokens, efficiency) in each
+#         run's summary says how much of the step budget stopped being
+#         spent on pad rows;
+#     (b) shard_mode block vs stride at the same shapes: the refit-safe
+#         block layout must price at parity — its per-batch host work is
+#         the same permutation slice, just a different window — so any
+#         step-time delta here is a regression, not a trade.
+#     Telemetry (data_shard / data_packing / goodput rollups) read back
+#     through analyze_trace per arm.
+infeed_ab() {
+  local label="$1"; shift
+  rm -rf /tmp/chipq_infeed/"$label"
+  run infeed-"$label" python train.py --config configs/bert_base_mlm.yaml \
+      --set data.name=synthetic_mlm --set train.total_steps=100 \
+      --set train.log_interval=25 --set train.eval_steps=0 \
+      --set train.eval_interval=0 \
+      --set model.hidden_size=256 --set model.num_layers=4 \
+      --set model.num_heads=4 --set model.mlp_dim=1024 \
+      --set model.max_seq_len=512 --set data.seq_len=512 \
+      --set data.global_batch_size=32 \
+      --set checkpoint.directory=/tmp/chipq_infeed/"$label" "$@"
+  run infeed-"$label"-summary python scripts/analyze_trace.py \
+      /tmp/chipq_infeed/"$label"
+}
+infeed_ab unpacked --set data.pack_factor=1
+infeed_ab packed   --set data.pack_factor=4
+infeed_ab block    --set data.pack_factor=4 --set data.shard_mode=block
+infeed_ab stride   --set data.pack_factor=4 --set data.shard_mode=stride
+
 echo "=== chip queue done $(date -u +%FT%TZ) ==="

@@ -36,9 +36,13 @@ echo "=== tier-1 pytest (log → $ART/pytest.log) ==="
 # DTF_DECODE_BENCH_DIR: the decode acceptance drill
 # (tests/test_decode_drill.py) archives its continuous-vs-static A/B
 # bench JSON (dtf-serve-bench/2 schema, mode "decode") the same way.
+# DTF_DATA_DRILL_DIR: the exactly-once data drill
+# (tests/test_data_drill.py) archives its per-attempt telemetry —
+# supervisor events plus the worker event streams whose data_state /
+# data_shard records prove the multiset claim.
 timeout -k 10 870 env JAX_PLATFORMS=cpu DTF_SERVE_BENCH_DIR="$ART" \
     DTF_GANG_DRILL_DIR="$ART" DTF_TRACE_DIR="$ART" \
-    DTF_DECODE_BENCH_DIR="$ART" \
+    DTF_DECODE_BENCH_DIR="$ART" DTF_DATA_DRILL_DIR="$ART" \
     python -m pytest tests/ -q \
     -m "$MARKERS" --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
@@ -70,6 +74,11 @@ for trace in "$ART"/*TRACE*.json; do
 done
 for dump in "$ART"/flightrec-*.json; do
   [ -f "$dump" ] && echo "=== flight-recorder dump archived: $dump ==="
+done
+# The exactly-once data drill (tests/test_data_drill.py) archives the
+# telemetry that backs its consumed-sample multiset comparison.
+for ev in "$ART"/DATA_DRILL_*.jsonl; do
+  [ -f "$ev" ] && echo "=== data drill events archived: $ev ==="
 done
 
 echo "=== tier-1 summary: graftcheck rc=$gc_rc pytest rc=$py_rc ==="

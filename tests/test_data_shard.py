@@ -1,0 +1,294 @@
+"""Per-host shard assignment + data-state records (data/shard.py).
+
+Fast tier-1 mechanics for the exactly-once data layer (ISSUE 19,
+docs/RESILIENCE.md "Exactly-once data"): block-sharding geometry (disjoint,
+complete, host-count-invariant consumed prefix), the manifest commit
+record and its restore-time gate (resume / repartition / typed refusal /
+forced), the KIND_DATA_SHARD plan the Trainer emits, and the per-worker
+``data_chaos`` fault specs. The end-to-end multiset drill lives in
+tests/test_data_drill.py.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_framework_tpu.core import cluster, faults
+from distributed_tensorflow_framework_tpu.core.config import DataConfig
+from distributed_tensorflow_framework_tpu.data import shard
+from distributed_tensorflow_framework_tpu.data.mnist import make_mnist
+from distributed_tensorflow_framework_tpu.data.pipeline import HostDataset
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    yield
+    faults.install(faults.FaultPlan())
+
+
+# ------------------------------------------------------------ assignment
+
+def test_assignment_from_env_defaults_to_single_process():
+    a = shard.ShardAssignment.from_env({})
+    assert (a.process_index, a.process_count) == (0, 1)
+
+
+def test_assignment_from_env_reads_gang_discovery_vars():
+    a = shard.ShardAssignment.from_env({
+        cluster.ENV_NUM_PROCESSES: "4", cluster.ENV_PROCESS_ID: "2"})
+    assert (a.process_index, a.process_count) == (2, 4)
+
+
+def test_assignment_rejects_malformed_env_and_bad_index():
+    with pytest.raises(shard.DataShardError):
+        shard.ShardAssignment.from_env({cluster.ENV_NUM_PROCESSES: "four"})
+    with pytest.raises(shard.DataShardError):
+        shard.ShardAssignment(process_index=4, process_count=4)
+    with pytest.raises(shard.DataShardError):
+        shard.ShardAssignment(process_index=0, process_count=0)
+
+
+def test_shard_plan_layout_and_validation():
+    plan = shard.shard_plan(
+        shard.ShardAssignment(process_index=1, process_count=4),
+        global_batch=32, data_parallel=8, shard_mode="block")
+    assert plan["host_batch"] == 8
+    assert plan["process_index"] == 1 and plan["process_count"] == 4
+    assert plan["shard_mode"] == "block"
+    with pytest.raises(shard.DataShardError):
+        shard.shard_plan(shard.ShardAssignment(0, 3), global_batch=32)
+    with pytest.raises(shard.DataShardError):
+        shard.shard_plan(shard.ShardAssignment(0, 4), global_batch=32,
+                         data_parallel=6)
+
+
+# -------------------------------------------------------- block geometry
+
+def test_block_bounds_disjoint_and_complete():
+    """Global batch i at P hosts: the per-host blocks tile [i*B, (i+1)*B)
+    exactly — no overlap, no gap."""
+    b, P = 4, 4
+    B = b * P
+    for i in range(3):
+        rows = []
+        for h in range(P):
+            lo, hi = shard.block_bounds(i, b, h, P)
+            assert hi - lo == b
+            rows.extend(range(lo, hi))
+        assert sorted(rows) == list(range(i * B, (i + 1) * B))
+
+
+def test_block_consumed_prefix_is_host_count_invariant():
+    """After k global batches the union of all hosts' rows is perm[:k*B]
+    at ANY host count — the property an N→M refit resume relies on."""
+    B, k = 16, 3
+
+    def consumed(P):
+        b = B // P
+        rows = set()
+        for i in range(k):
+            for h in range(P):
+                lo, hi = shard.block_bounds(i, b, h, P)
+                rows.update(range(lo, hi))
+        return rows
+
+    assert consumed(1) == consumed(2) == consumed(4) == set(range(k * B))
+
+
+def test_epoch_batches_identical_across_modes_and_hosts():
+    # 100 examples, host batch 8, 2 hosts → 6 full global batches; every
+    # host (and both shard modes) must agree on the cardinality.
+    assert shard.epoch_batches(100, 8, 2) == 6
+    assert shard.epoch_batches(100, 16, 1) == 6
+
+
+@pytest.fixture(scope="module")
+def mnist_dir(tmp_path_factory):
+    import os
+
+    root = str(tmp_path_factory.mktemp("mnist_shard"))
+    rng = np.random.default_rng(7)
+    np.savez(os.path.join(root, "mnist.npz"),
+             x_train=rng.integers(0, 255, (64, 28, 28), dtype=np.uint8),
+             y_train=rng.integers(0, 10, 64).astype(np.int64),
+             x_test=rng.integers(0, 255, (16, 28, 28), dtype=np.uint8),
+             y_test=rng.integers(0, 10, 16).astype(np.int64))
+    return root
+
+
+def _batches(ds, k):
+    return [next(ds) for _ in range(k)]
+
+
+def test_block_and_stride_identical_at_one_process(mnist_dir):
+    """P=1 is the compatibility anchor: the default shard_mode flip must
+    be bit-invisible to every existing single-process run."""
+    def cfg(mode):
+        return DataConfig(name="mnist", data_dir=mnist_dir,
+                          global_batch_size=8, seed=3, shard_mode=mode)
+
+    for a, b in zip(_batches(make_mnist(cfg("block"), 0, 1), 10),
+                    _batches(make_mnist(cfg("stride"), 0, 1), 10)):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+
+
+def test_block_mode_multiset_invariant_across_host_counts(mnist_dir):
+    """k global batches at P=2 and P=4 consume the SAME sample multiset
+    (and so does the P=1 control) — real reader, not just index math."""
+    def consumed(P, k):
+        cfg = DataConfig(name="mnist", data_dir=mnist_dir,
+                         global_batch_size=16, seed=3, shard_mode="block")
+        rows = []
+        for h in range(P):
+            for batch in _batches(make_mnist(cfg, h, P), k):
+                rows.extend(batch["image"][j].tobytes()
+                            for j in range(len(batch["image"])))
+        return sorted(rows)
+
+    assert consumed(1, 3) == consumed(2, 3) == consumed(4, 3)
+
+
+def test_stride_mode_tagged_non_repartitionable(mnist_dir):
+    cfg = DataConfig(name="mnist", data_dir=mnist_dir, global_batch_size=8,
+                     shard_mode="stride")
+    assert make_mnist(cfg, 0, 1).repartition == shard.REPARTITION_NONE
+    cfg = DataConfig(name="mnist", data_dir=mnist_dir, global_batch_size=8)
+    assert make_mnist(cfg, 0, 1).repartition == shard.REPARTITION_INVARIANT
+
+
+# ------------------------------------------------------- commit records
+
+def test_data_state_record_shape_and_digest():
+    state = {"epoch": 1, "batch_in_epoch": 5, "consumed": 11}
+    rec = shard.data_state_record(state, process_count=2,
+                                  repartition=shard.REPARTITION_INVARIANT,
+                                  watermark=3)
+    assert rec["schema"] == shard.DATA_STATE_SCHEMA
+    assert rec["sha256"] == shard.state_digest(state)
+    assert rec["process_count"] == 2 and rec["watermark"] == 3
+    assert rec["position"] == {"epoch": 1, "batch_in_epoch": 5,
+                               "consumed": 11}
+    # Digest is over canonical JSON: key order must not matter.
+    assert shard.state_digest({"b": 1, "a": 2}) == \
+        shard.state_digest({"a": 2, "b": 1})
+
+
+def test_check_restore_same_count_resumes():
+    state = {"epoch": 0, "consumed": 4}
+    rec = shard.data_state_record(state, process_count=2, watermark=1)
+    plan = shard.check_restore_data(rec, state, process_count=2)
+    assert plan["action"] == "resume"
+    assert plan["from_processes"] == 2 and plan["to_processes"] == 2
+    assert plan["watermark"] == 1
+
+
+def test_check_restore_legacy_record_is_none():
+    assert shard.check_restore_data(None, {"consumed": 1},
+                                    process_count=1) is None
+
+
+def test_check_restore_digest_mismatch_raises_typed_error():
+    state = {"consumed": 4}
+    rec = shard.data_state_record(state, process_count=1)
+    with pytest.raises(shard.DataShardError):
+        shard.check_restore_data(rec, {"consumed": 5}, process_count=1)
+    plan = shard.check_restore_data(rec, {"consumed": 5}, process_count=1,
+                                    resume_strict=False)
+    assert plan["action"] == "forced" and plan["reason"] == "digest_mismatch"
+
+
+def test_check_restore_refit_repartitions_invariant_state():
+    state = {"epoch": 2, "batch_in_epoch": 7, "consumed": 31}
+    rec = shard.data_state_record(state, process_count=4,
+                                  repartition=shard.REPARTITION_INVARIANT)
+    plan = shard.check_restore_data(rec, state, process_count=2)
+    assert plan["action"] == "repartition"
+    assert plan["from_processes"] == 4 and plan["to_processes"] == 2
+
+
+def test_check_restore_refit_refuses_non_repartitionable_state():
+    state = {"batches": 9}
+    rec = shard.data_state_record(state, process_count=4,
+                                  repartition=shard.REPARTITION_NONE)
+    with pytest.raises(shard.DataShardError) as ei:
+        shard.check_restore_data(rec, state, process_count=2)
+    assert "resume_strict" in str(ei.value)  # names the unblocking knob
+    plan = shard.check_restore_data(rec, state, process_count=2,
+                                    resume_strict=False)
+    assert plan["action"] == "forced"
+    assert plan["reason"] == "host_count_change"
+
+
+def test_check_restore_unknown_schema_raises():
+    with pytest.raises(shard.DataShardError):
+        shard.check_restore_data({"schema": "dtf-data-state/99"},
+                                 {}, process_count=1)
+
+
+# ------------------------------------------------- data_chaos fault specs
+
+def test_corrupt_shard_parse_and_worker_filter():
+    plan = faults.FaultPlan.parse("corrupt_shard:1")
+    f = plan.faults[0]
+    assert (f.kind, f.worker, f.step) == ("corrupt_shard", 1, 1)
+    # A different host's pull must NOT consume the one-shot fault...
+    assert plan.fire("data_chaos", step=1, worker=0) == []
+    # ...so the targeted host still gets it.
+    assert [x.kind for x in plan.fire("data_chaos", step=1, worker=1)] == \
+        ["corrupt_shard"]
+    assert plan.fire("data_chaos", step=1, worker=1) == []  # once only
+
+    plan = faults.FaultPlan.parse("corrupt_shard:0:3")
+    assert plan.faults[0].step == 3
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("corrupt_shard:-1")
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("corrupt_shard:0:0")
+
+
+def test_skew_shard_parse():
+    plan = faults.FaultPlan.parse("skew_shard:2:1.5s")
+    f = plan.faults[0]
+    assert (f.kind, f.worker, f.seconds, f.step) == ("skew_shard", 2, 1.5,
+                                                     None)
+    # step=None: fires at host 2's FIRST pull, whatever its ordinal.
+    assert plan.fire("data_chaos", step=7, worker=0) == []
+    assert [x.kind for x in plan.fire("data_chaos", step=7, worker=2)] == \
+        ["skew_shard"]
+    # 0 (or omitted) seconds = the stall-forever sentinel.
+    assert faults.FaultPlan.parse("skew_shard:0:0").faults[0].seconds > 3600
+    assert faults.FaultPlan.parse("skew_shard:1").faults[0].seconds > 3600
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("skew_shard:-1:5s")
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("skew_shard:one:5s")
+
+
+def test_corrupt_shard_poisons_only_float_fields_end_to_end():
+    def make_iter(state):
+        while True:
+            yield {"image": np.ones((2, 4), np.float32),
+                   "label": np.arange(2, dtype=np.int32)}
+
+    ds = HostDataset(make_iter, element_spec={
+        "image": ((2, 4), np.float32), "label": ((2,), np.int32)})
+    faults.install("corrupt_shard:0:2")
+    first = next(ds)
+    assert np.isfinite(first["image"]).all()  # pull 1 untouched
+    second = next(ds)
+    assert np.isnan(second["image"]).all()
+    np.testing.assert_array_equal(second["label"], np.arange(2))
+    assert np.isfinite(next(ds)["image"]).all()  # once only
+
+
+def test_trainer_shard_plan_event_reference():
+    # KIND_DATA_SHARD rides the telemetry contract: the Trainer emits the
+    # shard_plan dict under extra["shard"] at build time.
+    from distributed_tensorflow_framework_tpu.core import telemetry
+
+    assert telemetry.KIND_DATA_SHARD == "data_shard"
+    ev = telemetry.make_event(telemetry.KIND_DATA_SHARD, run_id="t", step=0,
+                              shard=shard.shard_plan(
+                                  shard.ShardAssignment(0, 1),
+                                  global_batch=8))
+    assert ev["extra"]["shard"]["host_batch"] == 8

@@ -1,0 +1,83 @@
+"""Sequence packing primitives + goodput-per-padded-token telemetry.
+
+data/packing.py (moved out of text_mlm so any tokenized reader can pack):
+deterministic first-fit document packing, the real/padded token census
+that rides the iterator state, and the KIND_DATA_PACKING rollup the
+Trainer emits from it (packing_efficiency — the number packing exists to
+raise). The end-to-end packed-stream resume lives in
+tests/test_mlm_pipeline.py and tests/test_data_state.py.
+"""
+
+import numpy as np
+
+from distributed_tensorflow_framework_tpu.core import telemetry
+from distributed_tensorflow_framework_tpu.data import packing
+
+
+def _doc(*lens, s=12):
+    rows = np.zeros((len(lens), s), np.int32)
+    for i, n in enumerate(lens):
+        rows[i, :n] = np.arange(1, n + 1) + 100 * i
+    return rows
+
+
+def test_pack_documents_lays_docs_end_to_end_with_segment_ids():
+    packed, segs, leftover = packing.pack_documents(_doc(5, 4, 3), 1, 12)
+    assert leftover.size == 0
+    assert np.count_nonzero(packed[0]) == 12
+    # Three documents, numbered 1..3 in order; no padding positions left.
+    assert segs[0].tolist() == [1] * 5 + [2] * 4 + [3] * 3
+
+
+def test_pack_documents_returns_overflow_in_order():
+    packed, segs, leftover = packing.pack_documents(_doc(7, 7, 7), 1, 12)
+    # Doc 1 fills row 0 to 7; doc 2 doesn't fit the remaining 5 columns,
+    # the row budget is exhausted → docs 2 and 3 come back, in order.
+    assert np.count_nonzero(packed[0]) == 7
+    assert len(leftover) == 2
+    np.testing.assert_array_equal(leftover, _doc(7, 7, 7)[1:])
+
+
+def test_pack_documents_skips_empty_rows():
+    rows = _doc(4, 0, 3)
+    packed, segs, leftover = packing.pack_documents(rows, 1, 12)
+    assert leftover.size == 0
+    assert segs[0, :7].tolist() == [1] * 4 + [2] * 3
+
+
+def test_token_census_counters_accumulate_in_state():
+    state = {}
+    batch = _doc(5, 3)          # 8 real, 16 padded positions over (2, 12)
+    packing.accumulate_counters(state, batch)
+    assert state[packing.REAL_TOKENS_KEY] == 8
+    assert state[packing.PADDED_TOKENS_KEY] == 16
+    packing.accumulate_counters(state, batch)
+    assert state[packing.REAL_TOKENS_KEY] == 16  # cumulative census
+
+
+def test_packing_stats_rollup():
+    stats = packing.packing_stats(75, 25)
+    assert stats == {"real_tokens": 75, "padded_tokens": 25,
+                     "total_tokens": 100, "packing_efficiency": 0.75}
+    assert packing.packing_stats(0, 0)["packing_efficiency"] is None
+
+
+def test_kind_data_packing_event_and_summary_rollup(tmp_path):
+    """KIND_DATA_PACKING end to end: emitted metrics survive the event
+    log and surface in both summarize_events and format_run_summary."""
+    path = str(tmp_path / "events.jsonl")
+    w = telemetry.TelemetryWriter(path, run_id="pack-test")
+    w.emit(telemetry.KIND_DATA_PACKING, step=4,
+           metrics=packing.packing_stats(600, 200))
+    w.emit(telemetry.KIND_DATA_PACKING, step=8,
+           metrics=packing.packing_stats(1500, 500))  # cumulative: last wins
+    w.close()
+
+    summary = telemetry.summarize_events(path)
+    pack = summary["data"]["packing"]
+    assert pack["real_tokens"] == 1500 and pack["padded_tokens"] == 500
+    assert pack["packing_efficiency"] == 0.75
+
+    text = telemetry.format_run_summary(summary)
+    assert "packing: 1,500 real / 500 padded tokens" in text, text
+    assert "efficiency 0.750" in text

@@ -540,6 +540,16 @@ def test_summarize_rolls_up_every_kind(tmp_path):
            source="train", source_kind="device_memory_stats",
            analysis={"argument_bytes": 50, "temp_bytes": 25,
                      "output_bytes": 25, "peak_bytes_est": 100})
+    w.emit(telemetry.KIND_DATA_SHARD, step=0,
+           shard={"process_index": 0, "process_count": 2, "host_batch": 8,
+                  "global_batch": 16, "shard_mode": "block",
+                  "data_parallel": 2})
+    w.emit(telemetry.KIND_DATA_PACKING, step=5,
+           metrics={"real_tokens": 90, "padded_tokens": 10,
+                    "total_tokens": 100, "packing_efficiency": 0.9})
+    w.emit(telemetry.KIND_DATA_STATE, step=4,
+           plan={"action": "repartition", "from_processes": 4,
+                 "to_processes": 2, "watermark": 2})
     w.close()
 
     s = telemetry.summarize_events(path)
@@ -576,6 +586,9 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert s["memory"]["peak_bytes_in_use"] == 200
     assert s["spans"]["count"] == 1 and s["spans"]["traces"] == 1
     assert s["spans"]["services"] == {"replica0": 1}
+    assert s["data"]["shard"]["shard_mode"] == "block"
+    assert s["data"]["packing"]["packing_efficiency"] == 0.9
+    assert s["recovery"]["data_restores"][0]["action"] == "repartition"
     text = telemetry.format_run_summary(s)
     assert "run: config_name=lenet" in text
     assert "evals: 1 (last at step 2)" in text
@@ -594,3 +607,8 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert "goodput: 80.0% of 10.0 s wall over 1 attempt(s)" in text
     assert "spans: 1 across 1 trace(s) [replica0=1]" in text
     assert "memory: 1 sample(s)" in text
+    assert "data shard: host 0/2 reads 8 of 16 rows/batch (block mode)" \
+        in text
+    assert "packing: 90 real / 10 padded tokens, efficiency 0.900" in text
+    assert "data state restored at step 4: repartition across 4 -> 2 " \
+        "hosts (watermark 2)" in text
