@@ -1,379 +1,47 @@
 #!/bin/bash
-# Round-5 chip-window measurement queue (PERF_NOTES.md round-4 closeout).
-# Run DETACHED the moment a tunnel probe succeeds:
+# Round-5 chip-window measurement queue — now a thin wrapper over the
+# autotuner's compiled plan (scripts/autotune.py --plan chip_window,
+# tools/autotune/plan.py). The queue recipes themselves live in the plan
+# compiler; this script only preserves the operator entry point:
 #
 #   setsid nohup bash scripts/chip_window_queue.sh > /tmp/chipq.log 2>&1 &
 #
-# Rules baked in (verify skill): serial runs, nothing else on the host,
-# never killed mid-run; each run's JSON line + stderr tail go to the log.
-# Priority order = VERDICT r4 "Next round" items 1-2, 5.
+# Contract carried over from the shell queue (verify skill): serial runs,
+# nothing else on the host, never killed mid-run. §0 (graftcheck) and
+# §0b (chip probe) still run FIRST and still refuse the window — exec
+# passes the autotuner's exit codes straight through: 0 done, 1 a
+# preflight failed (window refused), 3 probe hang (chip access down,
+# window aborted; the dtf-autotune-journal/1 journal keeps every settled
+# trial, so re-landing this same command resumes where it stopped
+# instead of re-spending the budget).
+#
+# The plan-manifest lines below are the machine-readable section→label
+# map; tests/test_autotune.py asserts every label appears in
+# `autotune.py --plan chip_window --dry-run`, so the wrapper and the
+# compiler cannot drift apart silently.
+#
+# plan-manifest §0: graftcheck
+# plan-manifest §0b: probe
+# plan-manifest §1: resnet
+# plan-manifest §13: prec-f32 prec-bf16 prec-bf16-fused prec-bf16-int8
+# plan-manifest §7: wk-verify-2048 wk2048-fused wk2048-two wk-verify-4096 wk4096-fused wk4096-two
+# plan-manifest §8: pp-sanity pp-gpipe pp-1f1b pp-interleaved
+# plan-manifest §9: coll-f32 coll-bf16 coll-int8
+# plan-manifest §10: serve-clean serve-train serve-export serve-batched serve-unbatched
+# plan-manifest §11: zero-off zero-shard_map
+# plan-manifest §12: mem-headline mem-summary
+# plan-manifest §14: serve-fleet
+# plan-manifest §15: gang-probe gang-clean gang-1p gang-2p gang-ab gang-ab-2p
+# plan-manifest §16: decode-clean decode-train decode-export decode-continuous decode-static decode-int8
+# plan-manifest §17: infeed-unpacked infeed-packed infeed-block infeed-stride
+# plan-manifest §2: bert-base bert-fqkv
+# plan-manifest §3: tile-512-1024 tile-1024-1024
+# plan-manifest §4: crossover
+# plan-manifest §4b: fused-bwd-verify fused-bwd
+# plan-manifest §4c: bert-accum4
+# plan-manifest §5: trace
+# plan-manifest §6: inception
 set -u
 cd "$(dirname "$0")/.."
-echo "=== chip queue start $(date -u +%FT%TZ) ==="
-
-run() {
-  local label="$1"; shift
-  echo "--- [$label] $* $(date -u +%H:%M:%S)"
-  "$@" 2>/tmp/chipq_err.log
-  local rc=$?
-  echo "--- [$label] rc=$rc $(date -u +%H:%M:%S)"
-  [ $rc -ne 0 ] && tail -5 /tmp/chipq_err.log
-  return $rc
-}
-
-# 0. Preflight: graftcheck static analysis (docs/STATIC_ANALYSIS.md). A
-#    finding here means the tree has an untallied collective / broken
-#    telemetry contract — measuring it would waste the chip window on
-#    numbers the ledger can't explain. Runs on CPU, never touches the chip.
-run graftcheck env JAX_PLATFORMS=cpu python scripts/graftcheck.py || exit 1
-
-# 0b. Chip preflight: ONE bounded backend probe before any workload
-#     burns its BENCH_WAIT budget (rounds r03–r05: a dead tunnel cost
-#     BENCH_WAIT *per dial* before anything failed). Exit 3 here is the
-#     probe-hang class — chip access is down, abort the whole queue and
-#     re-land it later; nothing to revert.
-run probe env BENCH_PROBE_ONLY=1 python bench.py
-rc=$?
-if [ $rc -eq 3 ]; then
-  echo "chipq: preflight probe HANG — chip access down, aborting queue (exit 3)"
-  exit 3
-elif [ $rc -ne 0 ]; then
-  echo "chipq: preflight probe failed rc=$rc — aborting queue"
-  exit $rc
-fi
-
-# 1. The headline number: driver-format ResNet-50 bench (expect ~2512).
-run resnet python bench.py || exit 1   # if the probe fails, stop — tunnel is down
-
-# 2. Dense-BERT MFU lever: fused-qkv A/B at the production shape.
-run bert-base    env BENCH_WORKLOAD=bert python bench.py
-run bert-fqkv    env BENCH_WORKLOAD=bert BENCH_FUSED_QKV=1 python bench.py
-
-# 3. Post-dtype tile confirms at seq 8192 (streaming regime).
-#    FLASH_FUSED_BWD=0 pins the TWO-PASS backward: since the round-5
-#    default flip (ops/flash_attention.py) an env-less run takes the
-#    fused backward, which would turn 4b below into fused-vs-fused.
-run tile-512-1024  env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=8192 BENCH_BS=4 FLASH_BLOCK_Q_KB=512 FLASH_BLOCK_K_KB=1024 FLASH_FUSED_BWD=0 python bench.py
-run tile-1024-1024 env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=8192 BENCH_BS=4 FLASH_BLOCK_Q_KB=1024 FLASH_BLOCK_K_KB=1024 FLASH_FUSED_BWD=0 python bench.py
-
-# 4. FLASH_CHUNK_MIN re-derive against the 2x-faster round-4 kernels.
-run crossover python scripts/bench_chunk_crossover.py 256 512 1024 2048 4096
-
-# 4b. Fused one-pass streaming backward: ON-DEVICE NUMERICS FIRST (the
-#     revisited-output flush ordering is unverifiable in interpret mode),
-#     then the A/B (PERF_NOTES predicts ~-30% VPU work at seq 8192;
-#     compare vs tile-512-1024 above). Skip the bench if numerics fail.
-run fused-bwd-verify python scripts/verify_fused_bwd.py 8192 && \
-run fused-bwd env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=8192 BENCH_BS=4 FLASH_FUSED_BWD=1 python bench.py
-
-# 4c. Grad-accum fragmentation lever A/B at the production shape
-#     (effective batch 4x at fixed per-micro memory; compare bert-base).
-run bert-accum4 env BENCH_WORKLOAD=bert BENCH_ACCUM=4 python bench.py
-
-# 5. Roofline close-out trace for the 2512-vs-2670 question.
-run trace env BENCH_TRACE=/tmp/bench_trace python bench.py
-
-# 6. Third-workload coverage: Inception-v3 at its recipe shapes
-#    (299px, RMSProp, aux head). Expect ~1959 img/s, HBM-bound.
-run inception env BENCH_WORKLOAD=inception python bench.py
-
-# 7. Whole-K takeover band (round 5): verify numerics on-device FIRST
-#    (per seq — gates only its own pair), then A/B fused-takeover vs
-#    whole-K two-pass. Pairs are independent so a transient failure in
-#    one cannot cancel the rest of an unattended window; each A/B is a
-#    same-epoch adjacent pair (PERF_NOTES variance rules).
-#    NOTE: since the precision-ladder arming the takeover default is now
-#    DTYPE-AWARE (ops/flash_attention.py fused_whole_k_min: bf16 inputs
-#    take the fused backward from 2048 up with NO env set; f32 stays
-#    parked above MAX_SEQ_VMEM). The bert bench runs bf16, so the
-#    "fused" arms below are env-less and the two-pass arms pin the old
-#    behavior with the explicit huge threshold; keep-or-revert
-#    FUSED_WHOLE_K_MIN_BF16 on this pair's delta.
-if run wk-verify-2048 python scripts/verify_fused_bwd.py 2048; then
-  run wk2048-fused env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=2048 BENCH_BS=16 python bench.py
-  run wk2048-two   env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=2048 BENCH_BS=16 FLASH_FUSED_WHOLE_K_MIN=1000000000 python bench.py
-fi
-if run wk-verify-4096 python scripts/verify_fused_bwd.py 4096; then
-  run wk4096-fused env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=4096 BENCH_BS=8 python bench.py
-  run wk4096-two   env BENCH_WORKLOAD=bert BENCH_ATTN=pallas BENCH_SEQ=4096 BENCH_BS=8 FLASH_FUSED_WHOLE_K_MIN=1000000000 python bench.py
-fi
-
-# 8. Pipeline-schedule A/B on a dp+pp mesh (docs/DISTRIBUTED.md): same
-#    mesh and microbatch budget — gpipe (bubble 3/11 at S=4,M=8) vs 1F1B
-#    (same analytic bubble, O(S) activation residency) vs interleaved
-#    (v=12/4=3 → bubble 3/27). Re-probe the tunnel with the stock bench
-#    first so a backend that died mid-window fails cheap, not mid-A/B.
-run pp-sanity python bench.py
-run pp-gpipe       env BENCH_WORKLOAD=bert BENCH_PP=4 BENCH_MICRO=8 BENCH_SCHEDULE=gpipe python bench.py
-run pp-1f1b        env BENCH_WORKLOAD=bert BENCH_PP=4 BENCH_MICRO=8 BENCH_SCHEDULE=1f1b python bench.py
-run pp-interleaved env BENCH_WORKLOAD=bert BENCH_PP=4 BENCH_MICRO=8 BENCH_SCHEDULE=interleaved python bench.py
-
-# 9. Quantized-collective wire-format A/B (docs/PERFORMANCE.md): each
-#    dial runs its OWN f32-wire shard_map baseline on the same ladder,
-#    so the JSON line is self-contained (wire-byte ratio + throughput
-#    delta) — CPU-verified ratio is ~3.6x for int8, the chip question is
-#    whether DCN/ICI time drops enough to show up in img/s at this
-#    scale. bench.py exits 3 (not 1) when the backend PROBE hangs:
-#    that is chip access flakiness, not a code regression — re-land the
-#    dial in the next window instead of reverting (BENCH_r04/r05 both
-#    died to a wedged tunnel, not to the code under test).
-run coll-f32  env BENCH_COLLECTIVE=f32 python bench.py
-run coll-bf16 env BENCH_COLLECTIVE=bf16 python bench.py
-run coll-int8 env BENCH_COLLECTIVE=int8 python bench.py
-
-# 10. Serving latency/throughput A/B (docs/SERVING.md): dynamic batching
-#     ON (max_batch_size=8) vs OFF (=1) against the same exported
-#     artifact — the win is the p99-vs-req/s spread between the two
-#     SERVE_BENCH json files (closed 32-way + open-loop 200 req/s each).
-#     Self-contained: short synthetic lenet train → export (the 1-device
-#     serving mesh makes serve.allow_reshard mandatory) → standing
-#     server per arm, drained via SIGTERM (exit 0 = clean drain).
-serve_ab() {
-  local label="$1" batch="$2"
-  rm -rf /tmp/chipq_serve/artifact/serve_logs
-  python -m distributed_tensorflow_framework_tpu.cli.serve \
-      --artifact /tmp/chipq_serve/artifact \
-      --set serve.port=0 --set serve.max_batch_size="$batch" \
-      --set serve.max_wait_ms=5 > /tmp/chipq_serve_"$label".log 2>&1 &
-  local pid=$!
-  for _ in $(seq 120); do
-    [ -f /tmp/chipq_serve/artifact/serve_logs/endpoint.json ] && break
-    sleep 1
-  done
-  run serve-"$label" python scripts/load_gen.py \
-      --endpoint /tmp/chipq_serve/artifact/serve_logs/endpoint.json \
-      --requests 512 --concurrency 32 --rate 200 --mode both \
-      --out SERVE_BENCH_"$label".json
-  kill -TERM "$pid" 2>/dev/null
-  wait "$pid"
-  echo "--- [serve-$label] drain rc=$? (0 = clean SIGTERM drain)"
-  run serve-"$label"-slo python scripts/analyze_trace.py \
-      /tmp/chipq_serve/artifact/serve_logs/events.jsonl
-}
-rm -rf /tmp/chipq_serve
-run serve-train python train.py --config configs/lenet_mnist.yaml \
-    --set data.name=synthetic_images --set train.total_steps=30 \
-    --set checkpoint.directory=/tmp/chipq_serve/ckpt \
-    --set checkpoint.save_interval_steps=30 --set checkpoint.async_save=false
-run serve-export python -m distributed_tensorflow_framework_tpu.cli.export \
-    --config configs/lenet_mnist.yaml \
-    --set data.name=synthetic_images \
-    --set checkpoint.directory=/tmp/chipq_serve/ckpt \
-    --set serve.allow_reshard=true --output /tmp/chipq_serve/artifact
-serve_ab batched 8
-serve_ab unbatched 1
-
-# 11. ZeRO weight-update sharding A/B (docs/PERFORMANCE.md): each dial
-#     runs its OWN replicated-optimizer shard_map baseline on the same
-#     ladder, so the JSON line is self-contained (per-chip opt-state
-#     byte ratio read off the placed shardings + throughput delta).
-#     CPU-verified: f32 update parity vs the monolithic all-reduce is
-#     ~1e-8 and slots land at 1/(data*fsdp) per device — the chip
-#     question is how much step time the bucketed reduce-scatter /
-#     all-gather pair costs once XLA overlaps the reverse-order buckets
-#     with the backward (plan_summary estimates (B-1)/B of RS hidden).
-#     Same exit-3 probe-hang rule as §9: re-land, don't revert.
-run zero-off       env BENCH_ZERO=off python bench.py
-run zero-shard_map env BENCH_ZERO=shard_map python bench.py
-
-# 12. HBM memory close-out (ROADMAP item 5, docs/OBSERVABILITY.md): one
-#     stock-bench run with its telemetry pinned to a known sink, then
-#     the machine-readable run summary. The JSON line's
-#     hbm_peak_bytes_per_chip / hbm_headroom_frac say how much batch
-#     headroom the 0.94-bw-util step has left on THIS chip (first
-#     on-chip read of device memory_stats — CPU rehearsals only ever
-#     saw the memory_analysis estimate), and the events file carries
-#     the raw KIND_MEMORY samples for the before/after of any round-6
-#     remat/donation dial.
-run mem-headline env BENCH_JSONL=/tmp/chipq_mem_events.jsonl python bench.py
-run mem-summary  python scripts/analyze_trace.py /tmp/chipq_mem_events.jsonl --json -
-
-# 13. Precision ladder (ISSUE 13, docs/PERFORMANCE.md "Flipping the
-#     bound"): four rungs on the same shard_map+ZeRO substrate, each
-#     dial running its OWN all-f32-compute baseline on the same batch
-#     ladder so every JSON line is self-contained (per-chip peak-HBM
-#     ratio + ai_flops_per_byte + throughput delta). CPU-verified:
-#     fused-update params are BITWISE equal to the unfused ZeRO walk
-#     over 3 steps, bf16 masters stay f32, int8 matmul error is inside
-#     the 2*maxabs/254 block-codec bound — the chip question is how
-#     much of the rungs' byte cut the roofline returns as img/s, and
-#     whether ai_flops_per_byte crosses the v5e ridge (~240) anywhere
-#     on the ladder. NOTE the budgets CPU caveat (tools/graftcheck/
-#     hlo_passes.py BUDGET_PROGRAMS): CPU float normalization stages
-#     bf16 math through f32 copies, so these rungs' memory win is only
-#     measurable HERE, on a chip with native bf16 kernels. Same exit-3
-#     probe-hang rule as §9: re-land, don't revert.
-run prec-f32        env BENCH_PRECISION=f32 python bench.py
-run prec-bf16       env BENCH_PRECISION=bf16 python bench.py
-run prec-bf16-fused env BENCH_PRECISION=bf16_fused python bench.py
-run prec-bf16-int8  env BENCH_PRECISION=bf16_int8 python bench.py
-
-# 14. Fleet-vs-single serving A/B (ISSUE 14, docs/SERVING.md): the same
-#     closed+open load against one engine (§10's artifact, batched arm)
-#     vs a 3-replica fleet behind the health-aware router. The win is
-#     the p99-vs-req/s spread between SERVE_BENCH_batched.json and
-#     SERVE_BENCH_fleet.json (the /2 schema's fleet section carries
-#     per-replica routing counts + router retry/shed deltas, so skew is
-#     readable straight off the JSON line). Reuses §10's artifact; a
-#     failed §10 export already aborted the queue. Drained via SIGTERM
-#     like every serving arm (exit 0 = clean fleet drain).
-python -m distributed_tensorflow_framework_tpu.cli.fleet \
-    --artifact /tmp/chipq_serve/artifact --replicas 3 \
-    --set serve.log_dir=/tmp/chipq_fleet \
-    --set serve.max_batch_size=8 --set serve.max_wait_ms=5 \
-    > /tmp/chipq_fleet.log 2>&1 &
-fleet_pid=$!
-for _ in $(seq 240); do
-  [ -f /tmp/chipq_fleet/endpoint.json ] && break
-  sleep 1
-done
-run serve-fleet python scripts/load_gen.py \
-    --endpoint /tmp/chipq_fleet/endpoint.json \
-    --requests 512 --concurrency 32 --rate 200 --mode both \
-    --out SERVE_BENCH_fleet.json
-kill -TERM "$fleet_pid" 2>/dev/null
-wait "$fleet_pid"
-echo "--- [serve-fleet] drain rc=$? (0 = clean fleet drain)"
-run serve-fleet-slo python scripts/analyze_trace.py \
-    /tmp/chipq_fleet/events.jsonl
-
-# 15. Two-host-sim gang A/B (ISSUE 15, docs/RESILIENCE.md "Gang
-#     supervision"): the same LeNet workload, same GLOBAL batch, as one
-#     process with 4 devices vs a 2-process jax.distributed gang with
-#     2 devices each through scripts/train_cluster.py — the DCN-path
-#     overhead (coordinator handshake, cross-process collectives, exit
-#     barrier) read off the two chiefs' step-time/goodput telemetry via
-#     the multi-dir analyze_trace join. Gated behind its own §0b-style
-#     preflight: cluster.probe_gang() is ONE cheap subprocess round-trip
-#     that detects backends whose compiler rejects multi-process
-#     programs (stock CPU jaxlib) — skip the section, don't burn the
-#     window on a gang that can never compile.
-if run gang-probe python -c "
-import sys
-from distributed_tensorflow_framework_tpu.core import cluster
-ok, detail = cluster.probe_gang(procs=2, devices_per_proc=2)
-if not ok:
-    print(detail[-800:], file=sys.stderr)
-sys.exit(0 if ok else 1)
-"; then
-  rm -rf /tmp/chipq_gang
-  run gang-1p python scripts/train_cluster.py \
-      --procs 1 --devices-per-proc 4 --workdir /tmp/chipq_gang/w1 \
-      --max-attempts 1 -- \
-      --config configs/lenet_mnist.yaml \
-      --set train.total_steps=200 --set train.log_interval=50 \
-      --set train.eval_steps=0 --set train.eval_interval=0 \
-      --set data.global_batch_size=32 --set mesh.data=-1 \
-      --set checkpoint.directory=/tmp/chipq_gang/ck1
-  run gang-2p python scripts/train_cluster.py \
-      --procs 2 --devices-per-proc 2 --workdir /tmp/chipq_gang/w2 \
-      --max-attempts 1 -- \
-      --config configs/lenet_mnist.yaml \
-      --set train.total_steps=200 --set train.log_interval=50 \
-      --set train.eval_steps=0 --set train.eval_interval=0 \
-      --set data.global_batch_size=32 --set mesh.data=-1 \
-      --set checkpoint.directory=/tmp/chipq_gang/ck2
-  run gang-ab python scripts/analyze_trace.py /tmp/chipq_gang/ck1
-  run gang-ab-2p python scripts/analyze_trace.py /tmp/chipq_gang/ck2
-else
-  echo "--- [gang-probe] backend cannot run multi-process gangs — skipping §15"
-fi
-
-# 16. Autoregressive decode A/Bs (ISSUE 18, docs/SERVING.md
-#     "Autoregressive decode"): one down-scaled BERT mlm artifact, then
-#     two self-contained dials against standing decode servers:
-#     (a) continuous batching vs the static batch-synchronous arm on
-#         the mixed-length workload (every 8th stream runs the full
-#         token budget, the rest an eighth) — the win is the tokens/s +
-#         TTFT spread between DECODE_BENCH_{continuous,static}.json
-#         (CPU-verified >= 2x; the chip question is what the ratio does
-#         when a decode step stops being CPU-dispatch-bound);
-#     (b) f32 vs int8 KV pages on the continuous arm — ~4x resident
-#         streams per replica for a per-token logit drift inside the
-#         block-codec bound; the JSON's ttft/tpot + decode_delta
-#         sections carry the capacity-vs-latency story. Drained via
-#     SIGTERM like every serving arm (exit 0 = clean drain).
-decode_ab() {
-  local label="$1"; shift
-  python -m distributed_tensorflow_framework_tpu.cli.serve \
-      --artifact /tmp/chipq_decode/artifact \
-      --set serve.port=0 \
-      --set serve.log_dir=/tmp/chipq_decode/logs_"$label" \
-      --set decode.enabled=true --set decode.max_len=128 \
-      --set decode.page_size=16 --set decode.num_pages=256 \
-      --set decode.max_streams=8 --set decode.max_new_tokens=96 \
-      --set decode.stream_interval=8 "$@" \
-      > /tmp/chipq_decode_"$label".log 2>&1 &
-  local pid=$!
-  for _ in $(seq 120); do
-    [ -f /tmp/chipq_decode/logs_"$label"/endpoint.json ] && break
-    sleep 1
-  done
-  run decode-"$label" python scripts/load_gen.py \
-      --endpoint /tmp/chipq_decode/logs_"$label"/endpoint.json \
-      --mode decode --requests 64 --concurrency 8 \
-      --max-new-tokens 96 --out DECODE_BENCH_"$label".json
-  kill -TERM "$pid" 2>/dev/null
-  wait "$pid"
-  echo "--- [decode-$label] drain rc=$? (0 = clean SIGTERM drain)"
-}
-rm -rf /tmp/chipq_decode
-run decode-train python train.py --config configs/bert_base_mlm.yaml \
-    --set data.name=synthetic_mlm --set train.total_steps=30 \
-    --set model.hidden_size=256 --set model.num_layers=4 \
-    --set model.num_heads=4 --set model.mlp_dim=1024 \
-    --set model.max_seq_len=128 --set data.seq_len=128 \
-    --set data.global_batch_size=32 --set train.eval_steps=0 \
-    --set train.eval_interval=0 \
-    --set checkpoint.directory=/tmp/chipq_decode/ckpt \
-    --set checkpoint.save_interval_steps=30 \
-    --set checkpoint.async_save=false
-run decode-export python -m distributed_tensorflow_framework_tpu.cli.export \
-    --config configs/bert_base_mlm.yaml \
-    --set data.name=synthetic_mlm \
-    --set model.hidden_size=256 --set model.num_layers=4 \
-    --set model.num_heads=4 --set model.mlp_dim=1024 \
-    --set model.max_seq_len=128 --set data.seq_len=128 \
-    --set checkpoint.directory=/tmp/chipq_decode/ckpt \
-    --set serve.allow_reshard=true --output /tmp/chipq_decode/artifact
-decode_ab continuous --set decode.scheduler=continuous
-decode_ab static     --set decode.scheduler=static
-decode_ab int8       --set decode.scheduler=continuous \
-                     --set decode.kv_dtype=int8
-
-# 17. Infeed A/B (ISSUE 19, docs/RESILIENCE.md "Exactly-once data"):
-#     the sharded/packed input path's two dials on the BERT mlm
-#     workload, behind the same §0b preflight (a wedged tunnel already
-#     aborted the queue above; nothing here re-probes).
-#     (a) sequence packing OFF vs ON (data.pack_factor 1 vs 4): the win
-#         is goodput per PADDED token — the packing rollup
-#         (KIND_DATA_PACKING: real/padded tokens, efficiency) in each
-#         run's summary says how much of the step budget stopped being
-#         spent on pad rows;
-#     (b) shard_mode block vs stride at the same shapes: the refit-safe
-#         block layout must price at parity — its per-batch host work is
-#         the same permutation slice, just a different window — so any
-#         step-time delta here is a regression, not a trade.
-#     Telemetry (data_shard / data_packing / goodput rollups) read back
-#     through analyze_trace per arm.
-infeed_ab() {
-  local label="$1"; shift
-  rm -rf /tmp/chipq_infeed/"$label"
-  run infeed-"$label" python train.py --config configs/bert_base_mlm.yaml \
-      --set data.name=synthetic_mlm --set train.total_steps=100 \
-      --set train.log_interval=25 --set train.eval_steps=0 \
-      --set train.eval_interval=0 \
-      --set model.hidden_size=256 --set model.num_layers=4 \
-      --set model.num_heads=4 --set model.mlp_dim=1024 \
-      --set model.max_seq_len=512 --set data.seq_len=512 \
-      --set data.global_batch_size=32 \
-      --set checkpoint.directory=/tmp/chipq_infeed/"$label" "$@"
-  run infeed-"$label"-summary python scripts/analyze_trace.py \
-      /tmp/chipq_infeed/"$label"
-}
-infeed_ab unpacked --set data.pack_factor=1
-infeed_ab packed   --set data.pack_factor=4
-infeed_ab block    --set data.pack_factor=4 --set data.shard_mode=block
-infeed_ab stride   --set data.pack_factor=4 --set data.shard_mode=stride
-
-echo "=== chip queue done $(date -u +%FT%TZ) ==="
+echo "=== chip queue start $(date -u +%FT%TZ) (autotune plan mode) ==="
+exec python scripts/autotune.py --plan chip_window "$@"

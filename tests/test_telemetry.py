@@ -550,6 +550,8 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     w.emit(telemetry.KIND_DATA_STATE, step=4,
            plan={"action": "repartition", "from_processes": 4,
                  "to_processes": 2, "watermark": 2})
+    w.emit(telemetry.KIND_AUTOTUNE_TRIAL, trial="sha256:abcd", status="done",
+           score=2418.0, unit="images/sec/chip")
     w.close()
 
     s = telemetry.summarize_events(path)
@@ -589,6 +591,8 @@ def test_summarize_rolls_up_every_kind(tmp_path):
     assert s["data"]["shard"]["shard_mode"] == "block"
     assert s["data"]["packing"]["packing_efficiency"] == 0.9
     assert s["recovery"]["data_restores"][0]["action"] == "repartition"
+    assert s["autotune"]["ran"] == 1
+    assert s["autotune"]["best"]["trial"] == "sha256:abcd"
     text = telemetry.format_run_summary(s)
     assert "run: config_name=lenet" in text
     assert "evals: 1 (last at step 2)" in text

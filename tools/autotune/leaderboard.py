@@ -1,0 +1,103 @@
+"""dtf-leaderboard/1 — the regression-pinned incumbent board.
+
+``configs/leaderboard.json`` holds one entry per workload: the winning
+config (as the override dict the tuner searched), a content digest of
+that config, the goodput-weighted score it earned, the roofline verdict
+and chip it was measured on, and provenance (run id, journal path).
+bench.py reads the board on every headline run and flags a regression
+when the fresh number undershoots the pinned incumbent by more than the
+entry's margin (bench._check_leaderboard); scripts/autotune.py is the
+only writer. The digest is re-verified on read — an entry whose digest
+doesn't match its own config dict was edited by hand and can't serve as
+a pin.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+LEADERBOARD_SCHEMA = "dtf-leaderboard/1"
+
+
+def config_digest(config: dict) -> str:
+    """Content digest of a config-override dict: sha256 over canonical
+    JSON (sorted keys, no whitespace), truncated for legibility. The same
+    function pins entries at write time and verifies them at read time
+    (bench.py), so a hand-edited board is detectable."""
+    blob = json.dumps(config, sort_keys=True, separators=(",", ":"))
+    return "sha256:" + hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+def load_board(path: str) -> dict:
+    """Parse the board, or an empty one when the file doesn't exist yet."""
+    try:
+        with open(path) as fh:
+            board = json.load(fh)
+    except (OSError, ValueError):
+        return {"schema": LEADERBOARD_SCHEMA, "entries": {}}
+    board.setdefault("schema", LEADERBOARD_SCHEMA)
+    board.setdefault("entries", {})
+    return board
+
+
+def pin_entry(path: str, workload: str, *, config: dict, score: float,
+              unit: str, bound: str | None, chip: str | None,
+              provenance: dict, regression_margin: float = 0.05) -> dict:
+    """Install/replace the incumbent for ``workload`` and rewrite the
+    board atomically (tmp + rename — a crashed tuner must not leave a
+    half-written pin for bench.py to choke on). Returns the new entry."""
+    board = load_board(path)
+    entry = {
+        "config": dict(config),
+        "config_digest": config_digest(config),
+        "score": round(float(score), 4),
+        "unit": unit,
+        "bound": bound,
+        "chip": chip,
+        "provenance": dict(provenance),
+        "regression_margin": float(regression_margin),
+    }
+    board["entries"][workload] = entry
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(tmp, "w") as fh:
+        json.dump(board, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return entry
+
+
+def _yaml_scalar(value) -> str:
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if value is None or value == "":
+        return '""'
+    return str(value)
+
+
+def write_best_yaml(path: str, workload: str, overrides: dict,
+                    *, score: float, digest: str) -> None:
+    """``configs/best_<workload>.yaml``: the winning overrides as a YAML
+    fragment in the repo's ``section.field`` config layout, with the
+    provenance in a comment header. Overrides arrive keyed by dotted
+    path ("precision.activation_dtype") and are grouped by section."""
+    sections: dict[str, dict[str, object]] = {}
+    for dotted, value in sorted(overrides.items()):
+        section, _, field = dotted.partition(".")
+        sections.setdefault(section, {})[field] = value
+    lines = [
+        f"# Autotune winner for {workload} — written by scripts/autotune.py.",
+        f"# goodput-weighted score {round(float(score), 4)}, "
+        f"config digest {digest}.",
+        "# Apply on top of the workload's base config "
+        "(configs/leaderboard.json is the pin).",
+    ]
+    for section, fields in sorted(sections.items()):
+        lines.append(f"{section}:")
+        for field, value in sorted(fields.items()):
+            lines.append(f"  {field}: {_yaml_scalar(value)}")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")

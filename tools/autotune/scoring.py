@@ -1,0 +1,53 @@
+"""Trial scoring: goodput-weighted throughput from dtf-run-summary/1.
+
+Raw img/s is the wrong objective — PR 12's goodput ledger exists because
+a config can win the compiled step and lose the run to infeed stall or
+checkpoint blocking. A trial's score is therefore
+
+    score = headline value (img|examples/sec/chip) x goodput_frac
+
+with goodput_frac taken from the run summary's goodput ledger
+(``scripts/analyze_trace.py --json`` → ``goodput_ledger.goodput_frac``).
+A trial that produced no events stream scores at goodput 1.0 — the bench
+is a synthetic-infeed closed loop, so its ledger is flat by construction
+and penalizing its absence would just bias the search toward trials that
+happened to write telemetry.
+"""
+
+from __future__ import annotations
+
+RUN_SUMMARY_SCHEMA = "dtf-run-summary/1"
+
+
+def goodput_frac(summary: dict | None) -> float:
+    """goodput_frac from a dtf-run-summary/1 object, clamped to [0, 1];
+    1.0 when no summary/ledger exists (see module docstring)."""
+    ledger = (summary or {}).get("goodput_ledger") or {}
+    frac = ledger.get("goodput_frac")
+    if frac is None:
+        return 1.0
+    try:
+        return min(1.0, max(0.0, float(frac)))
+    except (TypeError, ValueError):
+        return 1.0
+
+
+def score_trial(payload: dict | None, summary: dict | None = None) -> dict:
+    """Score one trial from its bench JSON line (+ optional run summary).
+
+    Returns {"score", "value", "goodput_frac", "unit"}; score 0.0 when
+    the bench produced no value (failure lines carry value 0.0 already,
+    so a failed trial can never outrank a measured one).
+    """
+    payload = payload or {}
+    try:
+        value = float(payload.get("value") or 0.0)
+    except (TypeError, ValueError):
+        value = 0.0
+    frac = goodput_frac(summary)
+    return {
+        "score": round(value * frac, 4),
+        "value": value,
+        "goodput_frac": frac,
+        "unit": payload.get("unit"),
+    }

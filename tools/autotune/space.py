@@ -1,0 +1,118 @@
+"""Typed SearchSpace over the REAL config dataclasses.
+
+A knob is a dotted ``section.field`` path into ExperimentConfig
+(core/config.py) plus the candidate values to try; the space is their
+cartesian product. Paths are validated against the actual
+``@config_dataclass`` definitions at construction — a tuner that
+enumerates knobs the config system doesn't have would spend chip time
+benchmarking typos. Knobs optionally carry the BENCH_* env var that
+feeds the setting to bench.py's driver contract, so a trial can be
+launched as a supervised subprocess without editing config files.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+
+
+class SearchSpaceError(ValueError):
+    """An invalid knob spec: unknown config section/field, empty value
+    list, or an unparsable space file. Raised while BUILDING the space —
+    before any chip time is spent — and surfaced by scripts/autotune.py
+    as a config error (exit 1)."""
+
+
+def _config_sections() -> dict[str, list[str]]:
+    """{section: [field, ...]} from the real ExperimentConfig tree."""
+    from distributed_tensorflow_framework_tpu.core.config import (
+        ExperimentConfig,
+    )
+
+    sections: dict[str, list[str]] = {}
+    for sec in dataclasses.fields(ExperimentConfig):
+        factory = sec.default_factory if sec.default_factory is not dataclasses.MISSING else None
+        if factory is not None and dataclasses.is_dataclass(factory):
+            sections[sec.name] = [f.name for f in dataclasses.fields(factory)]
+    # Optional sections (eval_data: DataConfig | None) share DataConfig's
+    # fields with their non-optional sibling; scalar fields (name) are not
+    # tunable sections and are deliberately absent.
+    return sections
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One searchable dimension: ``path`` = dotted section.field into
+    ExperimentConfig, ``values`` = settings to try (first value = the
+    baseline the incumbent is assumed to run), ``env`` = the BENCH_* env
+    var that carries the setting to a bench.py subprocess ("" = config
+    override only)."""
+
+    path: str
+    values: tuple
+    env: str = ""
+
+
+class SearchSpace:
+    def __init__(self, workload: str, knobs: list[Knob]):
+        self.workload = workload
+        self.knobs = list(knobs)
+        self.validate()
+
+    def validate(self) -> None:
+        sections = _config_sections()
+        for knob in self.knobs:
+            section, _, field = knob.path.partition(".")
+            if section not in sections:
+                raise SearchSpaceError(
+                    f"knob {knob.path!r}: {section!r} is not a config "
+                    f"section (have: {sorted(sections)})")
+            if field not in sections[section]:
+                raise SearchSpaceError(
+                    f"knob {knob.path!r}: {section!r} has no field "
+                    f"{field!r} (have: {sorted(sections[section])})")
+            if not knob.values:
+                raise SearchSpaceError(f"knob {knob.path!r}: empty values")
+
+    def baseline(self) -> dict[str, object]:
+        """The incumbent's assumed settings: each knob's first value."""
+        return {k.path: k.values[0] for k in self.knobs}
+
+    def enumerate(self) -> list[dict[str, object]]:
+        """All candidate override dicts, baseline first (itertools
+        product order with each knob's values in spec order)."""
+        paths = [k.path for k in self.knobs]
+        combos = itertools.product(*(k.values for k in self.knobs))
+        return [dict(zip(paths, combo)) for combo in combos]
+
+    def trial_env(self, overrides: dict[str, object]) -> dict[str, str]:
+        """BENCH_* env assignments for one candidate (env-mapped knobs
+        only; empty-string values still exported — bench treats "" as
+        unset, which IS the baseline arm for mode-owning envs)."""
+        env = {}
+        for knob in self.knobs:
+            if knob.env:
+                env[knob.env] = str(overrides[knob.path])
+        return env
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "SearchSpace":
+        """Build from a parsed JSON spec: {"workload": ..., "knobs":
+        [{"path": ..., "values": [...], "env": ...}, ...]}."""
+        try:
+            knobs = [Knob(path=k["path"], values=tuple(k["values"]),
+                          env=k.get("env", ""))
+                     for k in spec["knobs"]]
+            return cls(str(spec["workload"]), knobs)
+        except (KeyError, TypeError) as e:
+            raise SearchSpaceError(f"malformed space spec: {e}") from e
+
+    @classmethod
+    def from_file(cls, path: str) -> "SearchSpace":
+        try:
+            with open(path) as fh:
+                spec = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise SearchSpaceError(f"space file {path}: {e}") from e
+        return cls.from_spec(spec)
